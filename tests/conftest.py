@@ -1,0 +1,46 @@
+"""Test substrate: force an 8-device virtual CPU mesh.
+
+The reference's distributed tests require real GPUs (SURVEY.md §4); here
+the same differential tests run anywhere: Pallas kernels execute in the
+TPU interpreter (remote DMA + semaphores simulated faithfully, optional
+race detection) over 8 virtual CPU devices. On a real TPU slice the same
+tests run compiled by setting TDTPU_REAL_DEVICES=1.
+"""
+
+import os
+
+_real = os.environ.get("TDTPU_REAL_DEVICES") == "1"
+if not _real:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+if not _real:
+    jax.config.update("jax_platforms", "cpu")
+    # The environment may have eagerly registered an accelerator backend
+    # (sitecustomize); drop initialized backends so the cpu override takes.
+    try:
+        import jax.extend as jex
+        jex.backend.clear_backends()
+    except Exception:
+        pass
+    assert jax.default_backend() == "cpu", jax.default_backend()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ndev():
+    return len(jax.devices())
+
+
+@pytest.fixture()
+def ctx8():
+    """Fresh 8-way TP context."""
+    from triton_dist_tpu import initialize_distributed, finalize_distributed
+    ctx = initialize_distributed({"tp": len(jax.devices())})
+    yield ctx
+    finalize_distributed()

@@ -1,0 +1,126 @@
+"""Language-layer gates (SURVEY.md §7 stage 2): ring put, one-shot
+all-peer put (allgather), barrier_all ordering. Ports of the reference's
+test_distributed_wait.py / test_nvshmem_api.py roles onto the CPU mesh."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import interpret_mode, shmem_compiler_params
+from triton_dist_tpu.utils import assert_allclose, bitwise_equal
+
+mesh = None
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+def _shmem_call(kernel, out_shape, scratch_shapes, collective_id=None):
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch_shapes,
+        compiler_params=shmem_compiler_params(collective_id),
+        interpret=interpret_mode(),
+    )
+
+
+def test_ring_put():
+    """Each device puts its shard to its right neighbor; result is a ring
+    shift (gate from SURVEY.md §7 stage 1: `test_ring_put`)."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        _, right = dl.ring_neighbors("tp")
+        dl.putmem_signal(o_ref, x_ref, send_sem, recv_sem, right)
+        dl.dma_wait(recv_sem, o_ref)
+        dl.quiet(send_sem, x_ref)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+             check_vma=False)
+    def f(x):
+        return _shmem_call(
+            kernel, jax.ShapeDtypeStruct(x.shape, x.dtype),
+            [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])(x)
+
+    n = mesh.shape["tp"]
+    x = jnp.arange(n * 8, dtype=jnp.float32).reshape(n, 8)
+    y = jax.jit(f)(x)
+    assert_allclose(y, jnp.roll(x, 1, axis=0))
+
+
+def test_put_all_peers_one_shot_allgather():
+    """Every device puts its rows into slot `me` on every peer; all devices
+    end with the identical full array. Comm-only -> bitwise comparison,
+    like the reference's comm-op tests (SURVEY.md §4)."""
+
+    n = mesh.shape["tp"]
+    rows, cols = 2, 128
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.my_pe("tp")
+        for p in range(n):
+            dl.putmem_signal(o_ref.at[pl.ds(me * rows, rows)], x_ref,
+                             send_sem, recv_sem, jnp.int32(p))
+        dl.dma_wait(recv_sem, o_ref)
+        dl.quiet(send_sem, x_ref, n)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("tp"), out_specs=P(),
+             check_vma=False)
+    def f(x):
+        return _shmem_call(
+            kernel, jax.ShapeDtypeStruct((n * rows, cols), x.dtype),
+            [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])(x)
+
+    x = np.random.RandomState(0).randn(n * rows, cols).astype(np.float32)
+    y = jax.jit(f)(jnp.asarray(x))
+    assert bitwise_equal(y, x)
+
+
+def test_barrier_all_orders_puts():
+    """After barrier_all, puts issued by every peer before its own barrier
+    are visible everywhere (ordering semantics; ref: test_nvshmem_api
+    barrier cases)."""
+
+    n = mesh.shape["tp"]
+    rows, cols = 4, 8
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.my_pe("tp")
+        for p in range(n):
+            dl.putmem_signal(o_ref.at[pl.ds(me * rows, rows)], x_ref,
+                             send_sem, recv_sem, jnp.int32(p))
+        dl.dma_wait(recv_sem, o_ref)
+        dl.quiet(send_sem, x_ref, n)
+        dl.barrier_all("tp")
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+             check_vma=False)
+    def f(x):
+        full = _shmem_call(
+            kernel, jax.ShapeDtypeStruct((n * rows, cols), x.dtype),
+            [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+            collective_id=7)(x)
+        me = jax.lax.axis_index("tp")
+        # every device returns its *right neighbor's* slice: only valid if
+        # the barrier made all remote puts visible
+        return jax.lax.dynamic_slice_in_dim(full, (me + 1) % n * rows, rows)
+
+    x = jnp.arange(n * rows * cols, dtype=jnp.float32).reshape(n * rows, cols)
+    y = jax.jit(f)(x)
+    expect = jnp.roll(x.reshape(n, rows, cols), -1, axis=0).reshape(n * rows, cols)
+    assert_allclose(y, expect)
+
+
+def test_consume_token_identity():
+    assert dl.consume_token(5, ()) == 5

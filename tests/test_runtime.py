@@ -1,0 +1,65 @@
+"""Runtime bootstrap tests (reference analog: the implicit contract that
+every test starts with initialize_distributed, SURVEY.md §3.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu import (DistContext, finalize_distributed, get_context,
+                             initialize_distributed)
+from triton_dist_tpu.runtime import create_symm_buffer
+from triton_dist_tpu.runtime.bootstrap import make_mesh
+from triton_dist_tpu.utils import assert_allclose, init_seed
+
+
+def test_initialize_distributed_default():
+    ctx = initialize_distributed()
+    assert isinstance(ctx, DistContext)
+    assert ctx.tp_size() == len(jax.devices())
+    assert get_context() is ctx
+    finalize_distributed()
+    with pytest.raises(RuntimeError):
+        get_context()
+
+
+def test_mesh_shapes():
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs >=4 devices")
+    ctx = initialize_distributed({"dp": 2, "tp": n // 2})
+    assert ctx.axis_size("dp") == 2
+    assert ctx.axis_size("tp") == n // 2
+    assert ctx.axis_size("pp") == 1  # absent axis -> 1
+    finalize_distributed()
+
+
+def test_mesh_shape_mismatch():
+    n = len(jax.devices())
+    with pytest.raises(ValueError):
+        make_mesh({"tp": n + 1})
+
+
+def test_symm_buffer_registry(ctx8):
+    ws1 = create_symm_buffer("w", (4, 8), jnp.float32, mesh=ctx8.mesh)
+    ws2 = create_symm_buffer("w", (4, 8), jnp.float32, mesh=ctx8.mesh)
+    assert ws1 is ws2  # cached
+    ws3 = create_symm_buffer("w", (8, 8), jnp.float32, mesh=ctx8.mesh)
+    assert ws3 is not ws1
+    # finalize clears the registry (no stale workspaces across contexts)
+    from triton_dist_tpu import finalize_distributed, initialize_distributed
+    finalize_distributed()
+    ctx2 = initialize_distributed({"tp": ctx8.mesh.size})
+    ws4 = create_symm_buffer("w", (4, 8), jnp.float32, mesh=ctx2.mesh)
+    assert ws4 is not ws1
+    n = ctx8.tp_size()
+    assert ws1.array.shape == (4 * n, 8)
+    assert ws1.local_shape == (4, 8)
+
+
+def test_seeding_deterministic():
+    k1 = init_seed(123, rank=0)
+    k2 = init_seed(123, rank=0)
+    assert_allclose(jax.random.normal(k1, (4,)), jax.random.normal(k2, (4,)))
+    k3 = init_seed(123, rank=1)
+    assert not np.allclose(jax.random.normal(k1, (4,)), jax.random.normal(k3, (4,)))

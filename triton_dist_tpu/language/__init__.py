@@ -1,0 +1,32 @@
+"""`dl`-style language facade (reference: python/triton_dist/language/__init__.py:26-50).
+
+Usage inside Pallas kernels:
+
+    from triton_dist_tpu import language as dl
+    me = dl.my_pe("tp")
+    dl.putmem_signal(dst, src, send_sem, recv_sem.at[slot], pe)
+    dl.signal_wait_until(recv_sem.at[slot], 1)
+"""
+
+from triton_dist_tpu.language.shmem_device import (  # noqa: F401
+    my_pe,
+    n_pes,
+    ring_neighbors,
+    putmem_nbi,
+    putmem_signal,
+    local_copy,
+    local_copy_nbi,
+    signal_op,
+    signal_wait_until,
+    dma_wait,
+    wait,
+    consume_token,
+    quiet,
+    barrier_all,
+    sem_value,
+)
+
+# aliases matching the reference `dl.` surface (language/__init__.py:26-50)
+rank = my_pe
+num_ranks = n_pes
+notify = signal_op

@@ -1,0 +1,180 @@
+"""Device-side one-sided communication facade ("icishmem").
+
+TPU-native re-design of the reference's OpenSHMEM-style device API
+(`language/extra/libshmem_device.py`, surface documented at
+docs/primitives.md:23-56). The reference dispatches ~80 functions to
+NVSHMEM/rocSHMEM bitcode; on TPU the one-sided model is native to Pallas:
+
+  reference (NVSHMEM)             | here (Pallas over ICI)
+  --------------------------------+--------------------------------------
+  my_pe() / n_pes()               | my_pe(axis) / n_pes(axis) via
+                                  |   lax.axis_index/axis_size
+  putmem_nbi(dst, src, pe)        | putmem_nbi -> make_async_remote_copy
+  putmem_signal_nbi(.., sig, pe)  | putmem_signal -> remote copy whose
+                                  |   recv_sem IS the signal flag
+  signal_op(flag, v, SIG_ADD, pe) | signal_op -> pltpu.semaphore_signal
+  signal_wait_until(flag, EQ, v)  | signal_wait_until -> semaphore_wait
+  fence()/quiet()                 | quiet -> wait on outstanding send sems
+  barrier_all() / sync_all()      | barrier_all -> neighbor barrier round
+                                  |   on pltpu.get_barrier_semaphore()
+
+All functions are meant to be called *inside* a Pallas kernel body that
+runs under shard_map over a named mesh axis. Semaphores are explicit
+arguments (Pallas scratch), because on TPU semaphores are typed hardware
+resources, not addressable flag memory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import pallas as pl  # noqa: F401  (re-exported)
+from jax.experimental.pallas import tpu as pltpu
+
+
+def my_pe(axis: str) -> jax.Array:
+    """This device's rank along `axis` (ref: nvshmem_my_pe)."""
+    return jax.lax.axis_index(axis)
+
+
+def n_pes(axis: str) -> jax.Array:
+    """World size along `axis` (ref: nvshmem_n_pes)."""
+    return jax.lax.axis_size(axis)
+
+
+def ring_neighbors(axis: str):
+    """(left, right) neighbor ranks along a ring on `axis`."""
+    me = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me + n - 1, n)
+    return left, right
+
+
+def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe) -> "pltpu.AsyncCopyDescriptor":
+    """Non-blocking one-sided put: write src_ref (local) into dst_ref on
+    device `pe` of the same kernel instance (ref: nvshmem_putmem_nbi_block,
+    libshmem_device.py). Returns the descriptor; call .wait_send()/.wait()
+    or use quiet() on the send semaphore."""
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=src_ref, dst_ref=dst_ref,
+        send_sem=send_sem, recv_sem=recv_sem,
+        device_id=pe, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    rdma.start()
+    return rdma
+
+
+def putmem_signal(dst_ref, src_ref, send_sem, recv_sem, pe) -> "pltpu.AsyncCopyDescriptor":
+    """Put-with-signal (ref: nvshmem_putmem_signal_nbi_block): on TPU the
+    receive semaphore *is* the signal — the receiver's semaphore_wait on
+    `recv_sem` is the `signal_wait_until` of the reference."""
+    return putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe)
+
+
+def local_copy(dst_ref, src_ref, sem) -> None:
+    """Local async copy, blocking until complete (HBM<->VMEM staging).
+
+    Deliberately NOT named getmem: Pallas has no one-sided remote *get*
+    (remote DMA is put-only); the reference's getmem call sites map to
+    either a put from the data owner or a pull expressed as
+    putmem from the peer's program instance. Keeping the name honest
+    avoids silently-local 'gets' in ported kernels.
+    """
+    dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    dma.start()
+    dma.wait()
+
+
+def local_copy_nbi(dst_ref, src_ref, sem):
+    dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    dma.start()
+    return dma
+
+
+def signal_op(sem, inc: int = 1, pe=None) -> None:
+    """Increment a (possibly remote) semaphore (ref: nvshmemx_signal_op
+    with NVSHMEM_SIGNAL_ADD)."""
+    if pe is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(sem, inc=inc, device_id=pe,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def signal_wait_until(sem, value: int) -> None:
+    """Block until a REGULAR/BARRIER semaphore reaches `value`, consuming
+    it (ref: nvshmem_signal_wait_until(EQ)). Pallas semaphore_wait
+    decrements by `value`, which matches the reference's reset-after-wait
+    idiom. For DMA-completion semaphores use dma_wait()."""
+    pltpu.semaphore_wait(sem, value)
+
+
+def dma_wait(sem, ref, count: int = 1) -> None:
+    """Wait for `count` completed DMAs of `ref`'s byte size on a DMA
+    semaphore. TPU DMA semaphores count *bytes*, so the wait is expressed
+    by a descriptor of matching shape (the canonical Pallas idiom: a
+    self-copy descriptor used only for its wait)."""
+    for _ in range(count):
+        pltpu.make_async_copy(ref, ref, sem).wait()
+
+
+def wait(sem, value: int = 1):
+    """`dl.wait` analog (ref: language/distributed_ops.py:57): wait for a
+    per-tile signal and return a token ordering subsequent loads. On TPU
+    semaphore_wait already orders the DMA's data, so the token is ()."""
+    pltpu.semaphore_wait(sem, value)
+    return ()
+
+
+def consume_token(x, token):
+    """`dl.consume_token` analog (ref: language/distributed_ops.py:74).
+    A no-op on TPU — kept so kernel structure ports 1:1; Pallas semaphore
+    waits already order DMA-delivered data."""
+    del token
+    return x
+
+
+def quiet(send_sem, src_ref, count: int = 1) -> None:
+    """Drain outstanding puts (ref: nvshmem_quiet): wait the send
+    semaphore for `count` puts of `src_ref`'s byte size."""
+    dma_wait(send_sem, src_ref, count)
+
+
+def barrier_all(axis: str, barrier_sem=None) -> None:
+    """Full barrier over the mesh axis (ref: nvshmem_barrier_all /
+    barrier_all_intra_node). Dissemination barrier on the global barrier
+    semaphore: ceil(log2(n)) rounds, each signaling rank +2^k and waiting
+    for the matching signal — O(log n) ICI hops, no host involvement.
+
+    Requires the enclosing pallas_call to set
+    compiler_params=pltpu.CompilerParams(collective_id=...).
+    """
+    sem = barrier_sem if barrier_sem is not None else pltpu.get_barrier_semaphore()
+    me = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    # static unroll over log2 rounds: n is static at trace time
+    import math
+    n_static = _static_axis_size(axis)
+    rounds = max(1, math.ceil(math.log2(n_static))) if n_static > 1 else 0
+    for k in range(rounds):
+        dist = 1 << k
+        dst = jax.lax.rem(me + dist, n)
+        pltpu.semaphore_signal(sem, inc=1, device_id=dst,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(sem, 1)
+
+
+def _static_axis_size(axis: str) -> int:
+    """Axis size as a Python int (sizes are static under shard_map)."""
+    size = jax.lax.axis_size(axis)
+    try:
+        return int(size)
+    except Exception:  # pragma: no cover - should not happen under shard_map
+        import jax.core as jc
+        return int(jc.get_aval(size).val)
+
+
+def sem_value(sem) -> jax.Array:
+    """Non-destructive semaphore read (ref: ld of the flag word)."""
+    return pltpu.semaphore_read(sem)

@@ -1,0 +1,12 @@
+from triton_dist_tpu.runtime.bootstrap import (  # noqa: F401
+    initialize_distributed,
+    finalize_distributed,
+    get_context,
+    DistContext,
+    interpret_mode,
+    shmem_compiler_params,
+)
+from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
+    SymmetricWorkspace,
+    create_symm_buffer,
+)

@@ -1,0 +1,64 @@
+"""Symmetric-memory registry: named, reusable per-device workspaces.
+
+Reference analog: `nvshmem_create_tensor(s)` (utils.py:232-260) + the
+LazyTensor/LazyAllocator deferred symmetric allocations (utils.py:1018+).
+
+On TPU there is no symmetric heap to map: one-sided remote DMA targets the
+*same Ref* of a shard_map'ed Pallas kernel on the peer device, which is
+symmetric by construction (same program, same allocation on every device).
+What survives from the reference design is the *host-side registry*: ops
+create contexts once (`create_*_context`) holding workspaces sized to
+max_M so repeated calls reuse device memory instead of reallocating — the
+registry provides that, keyed by (name, shape, dtype, sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class SymmetricWorkspace:
+    """A named workspace replicated (identically shaped) on every device of
+    a mesh axis — the TPU stand-in for an NVSHMEM symmetric tensor."""
+
+    name: str
+    array: jax.Array
+    mesh: Mesh
+    spec: P
+
+    @property
+    def local_shape(self) -> Tuple[int, ...]:
+        return self.array.sharding.shard_shape(self.array.shape)
+
+
+_REGISTRY: Dict[Tuple, SymmetricWorkspace] = {}
+
+
+def create_symm_buffer(name: str, local_shape: Tuple[int, ...],
+                       dtype=jnp.float32, *, mesh: Mesh,
+                       axis: str = "tp",
+                       reuse: bool = True) -> SymmetricWorkspace:
+    """Allocate (or fetch cached) a per-device buffer of `local_shape` on
+    every device along `axis` (reference: nvshmem_create_tensor,
+    utils.py:232)."""
+    n = mesh.shape[axis]
+    key = (name, tuple(local_shape), jnp.dtype(dtype).name, mesh, axis)
+    if reuse and key in _REGISTRY:
+        return _REGISTRY[key]
+    global_shape = (n * local_shape[0],) + tuple(local_shape[1:])
+    sharding = NamedSharding(mesh, P(axis))
+    arr = jax.device_put(jnp.zeros(global_shape, dtype), sharding)
+    ws = SymmetricWorkspace(name=name, array=arr, mesh=mesh, spec=P(axis))
+    if reuse:
+        _REGISTRY[key] = ws
+    return ws
+
+
+def clear_registry() -> None:
+    _REGISTRY.clear()

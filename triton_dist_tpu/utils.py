@@ -1,0 +1,89 @@
+"""Host-side utilities: rank-filtered printing, deterministic seeding,
+numerical comparison helpers.
+
+TPU-native re-design of the reference's `python/triton_dist/utils.py`
+(`dist_print` at utils.py:407, `init_seed` at utils.py:150,
+`assert_allclose` at test/utils.py:42).  Unlike the reference there is no
+torch involved: everything is numpy/JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+
+
+def process_rank() -> int:
+    """Host process index (0 on single-host)."""
+    return jax.process_index()
+
+
+def process_world_size() -> int:
+    return jax.process_count()
+
+
+def dist_print(*args: Any, ranks: Optional[Iterable[int]] = None,
+               prefix: bool = True, file=None, **kwargs: Any) -> None:
+    """Print only on selected host processes, with a rank prefix.
+
+    Mirrors the behavior of the reference `dist_print` (utils.py:407),
+    but ranks here are *process* (host) ranks: device-level work on TPU is
+    SPMD inside one process per host, so there is exactly one print site.
+    """
+    rank = process_rank()
+    allowed = {0} if ranks is None else set(ranks)
+    if rank not in allowed:
+        return
+    out = file or sys.stdout
+    if prefix:
+        print(f"[rank {rank}/{process_world_size()}]", *args, file=out, **kwargs)
+    else:
+        print(*args, file=out, **kwargs)
+
+
+def init_seed(seed: int = 42, rank: Optional[int] = None) -> jax.Array:
+    """Deterministic per-process seeding (reference: utils.py:150).
+
+    Returns a JAX PRNG key folded with the process rank so every host draws
+    distinct-but-reproducible streams; numpy's global RNG is seeded too for
+    test-harness convenience.
+    """
+    r = process_rank() if rank is None else rank
+    np.random.seed(seed + r)
+    key = jax.random.key(seed)
+    return jax.random.fold_in(key, r)
+
+
+def assert_allclose(actual, expected, atol: float = 1e-4, rtol: float = 1e-4,
+                    err_msg: str = "") -> None:
+    """Differential-test comparison (reference: test/utils.py:42)."""
+    actual = np.asarray(actual, dtype=np.float64)
+    expected = np.asarray(expected, dtype=np.float64)
+    np.testing.assert_allclose(actual, expected, atol=atol, rtol=rtol,
+                               err_msg=err_msg)
+
+
+def bitwise_equal(a, b) -> bool:
+    """Bitwise comparison for comm-only ops (reference: test/utils.py)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    return a.shape == b.shape and bool(np.all(a.view(np.uint8) == b.view(np.uint8)))
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "off", "")
