@@ -8,8 +8,34 @@ tests run compiled by setting TDTPU_REAL_DEVICES=1.
 """
 
 import os
+import subprocess
+import sys
 
 _real = os.environ.get("TDTPU_REAL_DEVICES") == "1"
+
+# --- CPU-substrate thread-pool fix (must run BEFORE importing jax) ---
+# XLA's CPU client sizes its compute pool from the visible CPU count. The
+# Pallas TPU interpreter blocks one pool thread per virtual device inside
+# io_callbacks (semaphore waits), so on a small machine 8 device programs
+# consume the whole pool and any queued sub-computation (operand
+# materialization for an io_callback) deadlocks. The fakecpus.so LD_PRELOAD
+# shim reports FAKE_NPROC CPUs so the pool is big enough; threads timeshare
+# the real cores. Re-exec once with the shim when the machine is small.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SHIM_SRC = os.path.join(_REPO, "tools", "fakecpus.c")
+_SHIM = os.path.join(_REPO, "tools", "fakecpus.so")
+if (not _real and (os.cpu_count() or 1) < 4 * 8
+        and "fakecpus" not in os.environ.get("LD_PRELOAD", "")
+        and os.environ.get("TDTPU_NO_FAKECPUS") != "1"):
+    if not os.path.exists(_SHIM) and os.path.exists(_SHIM_SRC):
+        subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", _SHIM,
+                        _SHIM_SRC], check=False)
+    if os.path.exists(_SHIM):
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
+        env.setdefault("FAKE_NPROC", "32")
+        os.execve(sys.executable, [sys.executable, "-m", "pytest"]
+                  + sys.argv[1:], env)
 if not _real:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (

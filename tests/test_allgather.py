@@ -1,0 +1,49 @@
+"""AllGather op tests (reference analog: the comm-only correctness cases
+of test/nvidia/test_ag_gemm.py + the cp-engine producer checks,
+SURVEY.md §4: comm-only ops compare bitwise)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels import AllGatherMethod, all_gather
+from triton_dist_tpu.kernels.allgather import get_auto_all_gather_method
+from triton_dist_tpu.utils import bitwise_equal
+
+mesh = None
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+@pytest.mark.parametrize("method", [AllGatherMethod.ONE_SHOT,
+                                    AllGatherMethod.RING])
+@pytest.mark.parametrize("rows,cols", [(2, 128), (8, 256)])
+def test_all_gather_matches_input(method, rows, cols):
+    n = mesh.shape["tp"]
+    x = np.random.RandomState(0).randn(n * rows, cols).astype(np.float32)
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("tp")))
+    y = jax.jit(lambda v: all_gather(v, mesh=mesh, method=method))(xs)
+    assert bitwise_equal(y, x)
+
+
+def test_all_gather_bf16():
+    n = mesh.shape["tp"]
+    x = np.random.RandomState(1).randn(n * 4, 128).astype(jnp.bfloat16)
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("tp")))
+    y = jax.jit(lambda v: all_gather(v, mesh=mesh,
+                                     method=AllGatherMethod.RING))(xs)
+    assert bitwise_equal(np.asarray(y, dtype=np.float32),
+                         np.asarray(x, dtype=np.float32))
+
+
+def test_auto_method_selection():
+    assert get_auto_all_gather_method(1024, 8) == AllGatherMethod.ONE_SHOT
+    assert get_auto_all_gather_method(64 << 20, 8) == AllGatherMethod.RING
+    # tiny worlds never need the ring
+    assert get_auto_all_gather_method(64 << 20, 2) == AllGatherMethod.ONE_SHOT

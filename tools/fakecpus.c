@@ -1,0 +1,42 @@
+/* LD_PRELOAD shim for the CPU test substrate: report FAKE_NPROC (default 8)
+ * CPUs so XLA's PJRT CPU client sizes its thread pools large enough for the
+ * Pallas TPU interpreter's blocking io_callbacks (one per virtual device)
+ * plus async d2h transfers. On the 1-core CI machine the default pool of 1
+ * deadlocks as soon as a >16KB buffer transfer queues behind a blocked
+ * device callback. Threads timeshare the single core; correctness over
+ * speed — this is a test substrate, not the TPU path. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static int fake_n(void) {
+  const char *e = getenv("FAKE_NPROC");
+  int n = e ? atoi(e) : 8;
+  return n > 0 ? n : 8;
+}
+
+int sched_getaffinity(pid_t pid, size_t cpusetsize, cpu_set_t *mask) {
+  (void)pid;
+  int n = fake_n();
+  if (cpusetsize < CPU_ALLOC_SIZE(n)) n = 8 * (int)cpusetsize;
+  CPU_ZERO_S(cpusetsize, mask);
+  for (int i = 0; i < n; i++) CPU_SET_S(i, cpusetsize, mask);
+  return 0;
+}
+
+int get_nprocs(void) { return fake_n(); }
+int get_nprocs_conf(void) { return fake_n(); }
+
+long sysconf(int name) {
+  if (name == _SC_NPROCESSORS_ONLN || name == _SC_NPROCESSORS_CONF)
+    return fake_n();
+  /* forward everything else */
+  long (*real)(int) = NULL;
+  if (!real) {
+    extern long __sysconf(int);
+    return __sysconf(name);
+  }
+  return real(name);
+}

@@ -1,0 +1,36 @@
+"""Overlapped kernel library (reference analog: python/triton_dist/kernels/,
+SURVEY.md §2.3). Every op follows the shared reference pattern re-designed
+for TPU: a dataclass Context created once (holding tile sizes, the mesh
+axis, and a collective_id), a producer side expressed as async remote DMAs
+over ICI, and a consumer compute loop whose tiles wait on DMA/semaphore
+arrival before the MXU touches the data.
+"""
+
+from triton_dist_tpu.kernels.allgather import (  # noqa: F401
+    AllGatherMethod,
+    all_gather,
+    get_auto_all_gather_method,
+)
+from triton_dist_tpu.kernels.allgather_gemm import (  # noqa: F401
+    AllGatherGEMMTensorParallelContext,
+    create_ag_gemm_context,
+    ag_gemm,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: F401
+    reduce_scatter,
+)
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
+    GEMMReduceScatterTensorParallelContext,
+    create_gemm_rs_context,
+    gemm_rs,
+)
+from triton_dist_tpu.kernels.allreduce import (  # noqa: F401
+    AllReduceMethod,
+    all_reduce,
+    get_auto_allreduce_method,
+)
+from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
+    GemmARContext,
+    create_gemm_ar_context,
+    gemm_allreduce,
+)

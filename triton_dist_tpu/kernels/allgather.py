@@ -1,0 +1,161 @@
+"""AllGather over ICI: one-shot push and ring methods.
+
+TPU-native re-design of the reference AllGather family
+(`python/triton_dist/kernels/nvidia/allgather.py`: `AllGatherMethod`
+enum :46, cp-engine producers :82-293, 2D put kernel :294-386, auto
+method selection by topology :56-72).
+
+Design mapping:
+  - cp-engine per-peer `.copy_()` producers  ->  one-shot kernel: every
+    device issues n async remote DMAs (its shard into slot `me` of every
+    peer) and waits for n arrivals. Latency-bound: one ICI hop, n-1
+    concurrent transfers. Best for small messages (decode activations).
+  - NVSHMEM ring kernels                    ->  ring kernel: n-1 steps of
+    neighbor put, each step forwarding the chunk received last step.
+    Bandwidth-bound: each link carries 1/n of the data per step, which is
+    how ICI (a torus of point-to-point links) reaches peak. Best for
+    large messages (prefill activations).
+  - topology-based auto selection (:56)     ->  byte-size threshold (ICI
+    is a homogeneous torus; there is no NVLink-vs-PCIe asymmetry to
+    probe, so size is the deciding feature).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+class AllGatherMethod(enum.Enum):
+    """Reference analog: AllGatherMethod enum (allgather.py:46)."""
+    AUTO = "auto"
+    ONE_SHOT = "one_shot"   # all-peer push, latency-optimal
+    RING = "ring"           # neighbor forwarding, bandwidth-optimal
+
+
+# One ICI hop is ~1us-class; a full one-shot push of B bytes loads one
+# link with (n-1)*B while the ring loads each link with ~B. Crossover is
+# set where ring's (n-1) extra hop latencies stop mattering.
+_ONE_SHOT_MAX_BYTES = 1 << 20
+
+
+def get_auto_all_gather_method(nbytes_per_shard: int, n: int) -> AllGatherMethod:
+    """Size-based method selection (reference: get_auto_all_gather_method,
+    allgather.py:56-72, which keys on NVLink topology; on a homogeneous
+    ICI torus the deciding feature is message size)."""
+    if n <= 2 or nbytes_per_shard * (n - 1) <= _ONE_SHOT_MAX_BYTES:
+        return AllGatherMethod.ONE_SHOT
+    return AllGatherMethod.RING
+
+
+def _one_shot_kernel(n: int, axis: str, x_ref, o_ref, send_sem, recv_sem):
+    """Every device puts its shard into slot `me` on every peer (including
+    itself) and waits for all n slots (ref: cp-engine producer
+    allgather.py:93-124, one put per peer on a side stream)."""
+    me = dl.my_pe(axis)
+    rows = x_ref.shape[0]
+    dl.barrier_all(axis)
+    for p in range(n):
+        dl.putmem_signal(o_ref.at[pl.ds(me * rows, rows)], x_ref,
+                         send_sem, recv_sem, jnp.int32(p), axis)
+    # n DMAs of our shard landed here (one from each peer, incl. self)
+    for _ in range(n):
+        pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+    dl.quiet(send_sem, x_ref, n)
+
+
+def _ring_kernel(n: int, axis: str, x_ref, o_ref, copy_sem, send_sem,
+                 recv_sems):
+    """n-1 neighbor-forwarding steps (ref: NVSHMEM ring kernels,
+    allgather.py:294-386). Step s sends chunk (me-s)%n — the chunk that
+    arrived at step s-1 — to the right neighbor.
+
+    One receive semaphore PER CHUNK: sends are issued without waiting for
+    the previous send's completion, so arrivals can complete out of order
+    — a single shared semaphore would let a device forward a chunk that
+    has not landed yet (the role the reference's per-chunk signal flags
+    play, allgather.py:294-386)."""
+    me = dl.my_pe(axis)
+    rows = x_ref.shape[0]
+    _, right = dl.ring_neighbors(axis)
+    cp = pltpu.make_async_copy(x_ref, o_ref.at[pl.ds(me * rows, rows)],
+                               copy_sem)
+    cp.start()
+    cp.wait()
+    dl.barrier_all(axis)
+    for s in range(n - 1):
+        src = jax.lax.rem(me - s + n, jnp.int32(n))
+        dl.putmem_nbi(o_ref.at[pl.ds(src * rows, rows)],
+                      o_ref.at[pl.ds(src * rows, rows)],
+                      send_sem, recv_sems.at[src], right, axis)
+        # wait arrival of chunk (me-s-1)%n from the left neighbor
+        nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
+        pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
+    dl.quiet(send_sem, x_ref, n - 1)
+
+
+def _ag_pallas(x_shard, *, n: int, axis: str, method: AllGatherMethod,
+               collective_id: int):
+    rows = x_shard.shape[0]
+    out_shape = jax.ShapeDtypeStruct((n * rows,) + x_shard.shape[1:],
+                                     x_shard.dtype)
+    if method == AllGatherMethod.ONE_SHOT:
+        kernel = functools.partial(_one_shot_kernel, n, axis)
+        scratch = [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())]
+    else:
+        kernel = functools.partial(_ring_kernel, n, axis)
+        scratch = [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(()),
+                   pltpu.SemaphoreType.DMA((n,))]
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch,
+        compiler_params=shmem_compiler_params(collective_id),
+        interpret=interpret_mode(),
+    )(x_shard)
+
+
+def all_gather(x, *, mesh: Mesh, axis: str = "tp",
+               method: AllGatherMethod = AllGatherMethod.AUTO,
+               collective_id: Optional[int] = None):
+    """AllGather a tensor sharded on dim 0 along `axis`; returns the full
+    tensor replicated on every device of the axis.
+
+    Host-level op (reference analog: the `ag` paths the contexts drive).
+    Called outside shard_map; shard_maps internally.
+    """
+    n = mesh.shape[axis]
+    if collective_id is None:
+        collective_id = next_collective_id()
+    shard_rows = x.shape[0] // n
+    if method == AllGatherMethod.AUTO:
+        nbytes = shard_rows * int(jnp.prod(jnp.array(x.shape[1:]))) \
+            * x.dtype.itemsize if x.ndim > 1 else shard_rows * x.dtype.itemsize
+        method = get_auto_all_gather_method(int(nbytes), n)
+
+    other = tuple(a for a in mesh.axis_names if a != axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(*((None,) * x.ndim)),
+        check_vma=False)
+    def _f(x_shard):
+        return _ag_pallas(x_shard, n=n, axis=axis, method=method,
+                          collective_id=collective_id)
+
+    del other
+    return _f(x)

@@ -1,0 +1,237 @@
+"""AllGather-GEMM: TP forward with communication hidden behind the MXU.
+
+TPU-native re-design of the reference flagship op
+(`python/triton_dist/kernels/nvidia/allgather_gemm.py`:
+`AllGatherGEMMTensorParallelContext` :447, persistent consumer
+`kernel_consumer_gemm_persistent` :199, host op `ag_gemm` :568).
+
+Reference architecture: a cp-engine producer pushes A shards peer-to-peer
+on a side stream, setting per-rank barrier flags; a persistent GEMM kernel
+waits per-tile on the flags (rank-swizzled so tiles over local data run
+first) and consumes via `dl.consume_token`.
+
+TPU re-design: there are no independent streams — overlap lives *inside*
+one Pallas kernel. A ring of async remote DMAs forwards A chunks
+neighbor-to-neighbor while the MXU computes the GEMM tile for the chunk
+that already arrived (the swizzle falls out naturally: step s computes
+chunk (me-s) mod n, so every device starts on its local chunk, exactly
+the reference's rank-swizzled tile order, allgather_gemm.py:173).
+
+    step s:   RDMA chunk (me-s)%n -> right neighbor     (ICI, async)
+              MXU: out[(me-s)%n] = A_chunk @ B          (overlapped)
+              wait recv of chunk (me-s-1)%n             (DMA semaphore)
+
+Per-step ICI traffic = m_loc*K bytes per link; per-step compute =
+2*m_loc*K*n_loc FLOPs. Compute hides comm whenever
+(2*m_loc*K*n_loc)/MXU_flops > (m_loc*K*bytes)/ICI_bw, i.e. for any
+realistic n_loc on v5p-class links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+from triton_dist_tpu.utils import cdiv
+
+
+@dataclasses.dataclass
+class AllGatherGEMMTensorParallelContext:
+    """Per-op context (reference: AllGatherGEMMTensorParallelContext,
+    allgather_gemm.py:447 — symm workspace + barriers + streams). On TPU
+    the workspace is the kernel's own output allocation and the "streams"
+    are DMA engines, so the context carries only static config."""
+
+    mesh: Mesh
+    axis: str
+    n: int
+    block_n: int
+    collective_id: int
+
+    @property
+    def rank(self) -> int:
+        return 0  # SPMD: rank is resolved inside the kernel
+
+
+def _pick_block_n(K: int, n_loc: int, itemsize: int,
+                  vmem_budget: int = 4 << 20) -> int:
+    """Largest N tile (multiple of 128, <= n_loc) whose B panel [K, BN]
+    fits the VMEM budget."""
+    bn = max(128, (vmem_budget // max(1, K * itemsize)) // 128 * 128)
+    return int(min(n_loc, bn))
+
+
+def create_ag_gemm_context(mesh: Mesh, axis: str = "tp", *,
+                           K: Optional[int] = None,
+                           N_local: Optional[int] = None,
+                           dtype=jnp.bfloat16,
+                           block_n: Optional[int] = None,
+                           collective_id: Optional[int] = None,
+                           ) -> AllGatherGEMMTensorParallelContext:
+    """Reference: create_ag_gemm_context (allgather_gemm.py:447+)."""
+    if block_n is None:
+        if K is not None and N_local is not None:
+            block_n = _pick_block_n(K, N_local, jnp.dtype(dtype).itemsize)
+        else:
+            block_n = 512
+    return AllGatherGEMMTensorParallelContext(
+        mesh=mesh, axis=axis, n=mesh.shape[axis], block_n=block_n,
+        collective_id=(collective_id if collective_id is not None
+                       else next_collective_id()))
+
+
+def _ag_gemm_kernel(n: int, axis: str, block_n: int,
+                    a_ref, b_ref, ag_ref, o_ref,
+                    a_vmem, b_vmem, o_vmem,
+                    copy_sem, b_sem, o_sem, send_sem, recv_sems):
+    """Fused ring-AG + GEMM (consumer analog: kernel_consumer_gemm_persistent,
+    allgather_gemm.py:199; producer analog: cp_engine_producer_all_gather,
+    allgather.py:202 — both folded into one kernel here)."""
+    me = dl.my_pe(axis)
+    m_loc, K = a_ref.shape
+    n_loc = b_ref.shape[1]
+    nt = cdiv(n_loc, block_n)
+
+    # Stage the local shard: into the gathered output and into VMEM slot 0.
+    cp_ag = pltpu.make_async_copy(
+        a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
+    cp_ag.start()
+    cp_a = pltpu.make_async_copy(a_ref, a_vmem.at[0], copy_sem)
+    cp_a.start()
+    if nt == 1:
+        # B panel fits VMEM: resident for the whole kernel.
+        cp_b = pltpu.make_async_copy(b_ref, b_vmem, b_sem)
+        cp_b.start()
+        cp_b.wait()
+    cp_ag.wait()
+    cp_a.wait()
+    dl.barrier_all(axis)
+
+    _, right = dl.ring_neighbors(axis)
+    for s in range(n):
+        cur, nxt = s % 2, (s + 1) % 2
+        src = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+        if s < n - 1:
+            # Producer: forward the chunk we just computed-from to the
+            # right neighbor while the MXU works (the overlap). One recv
+            # semaphore per chunk: arrivals may complete out of order, so
+            # a shared semaphore could unblock on the wrong chunk (same
+            # role as the reference's per-rank barrier flags).
+            dl.putmem_nbi(ag_ref.at[pl.ds(src * m_loc, m_loc)],
+                          ag_ref.at[pl.ds(src * m_loc, m_loc)],
+                          send_sem, recv_sems.at[src], right, axis)
+        for j in range(nt):
+            if nt > 1:
+                cp_b = pltpu.make_async_copy(
+                    b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem, b_sem)
+                cp_b.start()
+                cp_b.wait()
+            acc = jnp.dot(a_vmem[cur], b_vmem[...],
+                          preferred_element_type=jnp.float32)
+            o_vmem[...] = acc.astype(o_vmem.dtype)
+            cp_o = pltpu.make_async_copy(
+                o_vmem,
+                o_ref.at[pl.ds(src * m_loc, m_loc),
+                         pl.ds(j * block_n, block_n)],
+                o_sem)
+            cp_o.start()
+            cp_o.wait()
+        if s < n - 1:
+            # Consumer wait (analog of dl.wait on the rank barrier,
+            # allgather_gemm.py:209): next chunk landed from the left.
+            nxt_src = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
+            pltpu.make_async_copy(a_ref, a_ref, recv_sems.at[nxt_src]).wait()
+            cp_a = pltpu.make_async_copy(
+                ag_ref.at[pl.ds(nxt_src * m_loc, m_loc)], a_vmem.at[nxt],
+                copy_sem)
+            cp_a.start()
+            cp_a.wait()
+    dl.quiet(send_sem, a_ref, n - 1)
+
+
+def _divisor_block(n_loc: int, block_n: int) -> int:
+    """Shrink block_n (in lane-width steps) until it divides n_loc; tiles
+    must cover n_loc exactly since the DMA slices are unmasked."""
+    b = min(block_n, n_loc)
+    if n_loc < 128:
+        return n_loc
+    b = b // 128 * 128
+    while b > 0 and n_loc % b:
+        b -= 128
+    return b if b > 0 else n_loc
+
+
+def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext):
+    m_loc, K = a_shard.shape
+    n_loc = b_shard.shape[1]
+    n = ctx.n
+    block_n = _divisor_block(n_loc, ctx.block_n)
+    M = n * m_loc
+    kernel = functools.partial(_ag_gemm_kernel, n, ctx.axis, block_n)
+    ag, out = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((M, K), a_shard.dtype),
+            jax.ShapeDtypeStruct((M, n_loc), a_shard.dtype),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((2, m_loc, K), a_shard.dtype),
+            pltpu.VMEM((K, block_n), b_shard.dtype),
+            pltpu.VMEM((m_loc, block_n), a_shard.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=shmem_compiler_params(ctx.collective_id),
+        interpret=interpret_mode(),
+    )(a_shard, b_shard)
+    return ag, out
+
+
+def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
+            *, mesh: Optional[Mesh] = None, axis: str = "tp",
+            return_ag: bool = False):
+    """C = allgather(A) @ B with comm/compute overlap (reference: ag_gemm,
+    allgather_gemm.py:568).
+
+    A: [M, K] sharded on rows over `axis`; B: [K, N] sharded on cols
+    (column-parallel weight). Returns C: [M, N] sharded on cols, and
+    optionally the gathered A (replicated) — the reference keeps gathered
+    A in the ctx workspace for reuse by the attention path.
+    """
+    if ctx is None:
+        assert mesh is not None, "pass ctx or mesh"
+        ctx = create_ag_gemm_context(mesh, axis, K=a.shape[1],
+                                     N_local=b.shape[1] // mesh.shape[axis],
+                                     dtype=a.dtype)
+    mesh = ctx.mesh
+    axis = ctx.axis
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=(P(None, None), P(None, axis)),
+        check_vma=False)
+    def _f(a_shard, b_shard):
+        return _ag_gemm_call(a_shard, b_shard, ctx)
+
+    ag, out = _f(a, b)
+    if return_ag:
+        return out, ag
+    return out

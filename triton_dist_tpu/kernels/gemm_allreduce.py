@@ -1,0 +1,179 @@
+"""Fused GEMM+AllReduce: the small-batch TP decode path.
+
+TPU-native re-design of the reference
+(`python/triton_dist/kernels/nvidia/gemm_allreduce.py`: `GemmARContext`
+:43, persistent GEMM with tile notify :383-564, fused single-kernel
+GEMM+AR :566, host op `gemm_allreduce_op` :732).
+
+Decode GEMMs are tiny (M = batch), so the reference fuses the one-shot
+AR into the GEMM kernel to kill launch+sync latency. Same here: one
+Pallas kernel computes the row-parallel partial product, pushes it to
+every peer over ICI, and reduces the n landed contributions — no second
+kernel, no XLA collective.
+
+A: [M, k_loc] (activations sharded on K); B: [k_loc, N]; out: [M, N]
+replicated = sum over devices of A_loc @ B_loc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+from triton_dist_tpu.utils import cdiv
+
+
+@dataclasses.dataclass
+class GemmARContext:
+    """Reference: GemmARContext (gemm_allreduce.py:43)."""
+    mesh: Mesh
+    axis: str
+    n: int
+    block_n: int
+    collective_id: int
+
+
+def create_gemm_ar_context(mesh: Mesh, axis: str = "tp", *,
+                           block_n: int = 512,
+                           collective_id: Optional[int] = None,
+                           ) -> GemmARContext:
+    return GemmARContext(
+        mesh=mesh, axis=axis, n=mesh.shape[axis], block_n=block_n,
+        collective_id=(collective_id if collective_id is not None
+                       else next_collective_id()))
+
+
+def _divisor_block(n_total: int, block: int) -> int:
+    b = min(block, n_total)
+    if n_total < 128:
+        return n_total
+    b = b // 128 * 128
+    while b > 0 and n_total % b:
+        b -= 128
+    return b if b > 0 else n_total
+
+
+def _gemm_ar_kernel(n: int, axis: str, block_n: int,
+                    a_ref, b_ref, o_ref, land_ref, send_buf,
+                    a_vmem, b_vmem, p_vmem, tmp_vmem,
+                    copy_sem, send_sem, recv_sem):
+    """GEMM -> one-shot push -> VPU reduce (ref: fused GEMM+AR kernel,
+    gemm_allreduce.py:566). The pushes of tile j overlap the dots of
+    tile j+1."""
+    me = dl.my_pe(axis)
+    M, N = o_ref.shape
+    nt = cdiv(N, block_n)
+    dl.barrier_all(axis)
+    cp = pltpu.make_async_copy(a_ref, a_vmem, copy_sem)
+    cp.start()
+    cp.wait()
+    for j in range(nt):
+        cp = pltpu.make_async_copy(
+            b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem, copy_sem)
+        cp.start()
+        cp.wait()
+        p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
+                              preferred_element_type=jnp.float32)
+        tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+        cp = pltpu.make_async_copy(
+            tmp_vmem, send_buf.at[:, pl.ds(j * block_n, block_n)], copy_sem)
+        cp.start()
+        cp.wait()
+        # push this finished tile to every peer while later tiles compute
+        for p in range(n):
+            dl.putmem_nbi(
+                land_ref.at[me, :, pl.ds(j * block_n, block_n)],
+                send_buf.at[:, pl.ds(j * block_n, block_n)],
+                send_sem, recv_sem, jnp.int32(p), axis)
+    # n peers x nt tiles landed here
+    for _ in range(n * nt):
+        pltpu.make_async_copy(send_buf.at[:, pl.ds(0, block_n)],
+                              send_buf.at[:, pl.ds(0, block_n)],
+                              recv_sem).wait()
+    for j in range(nt):
+        cp = pltpu.make_async_copy(
+            land_ref.at[0, :, pl.ds(j * block_n, block_n)], tmp_vmem,
+            copy_sem)
+        cp.start()
+        cp.wait()
+        p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
+        for i in range(1, n):
+            cp = pltpu.make_async_copy(
+                land_ref.at[i, :, pl.ds(j * block_n, block_n)], tmp_vmem,
+                copy_sem)
+            cp.start()
+            cp.wait()
+            p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(jnp.float32)
+        tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+        cp = pltpu.make_async_copy(
+            tmp_vmem, o_ref.at[:, pl.ds(j * block_n, block_n)], copy_sem)
+        cp.start()
+        cp.wait()
+    for _ in range(n * nt):
+        pltpu.make_async_copy(send_buf.at[:, pl.ds(0, block_n)],
+                              send_buf.at[:, pl.ds(0, block_n)],
+                              send_sem).wait()
+
+
+def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
+    M, k_loc = a_shard.shape
+    N = b_shard.shape[1]
+    n = ctx.n
+    block_n = _divisor_block(N, ctx.block_n)
+    kernel = functools.partial(_gemm_ar_kernel, n, ctx.axis, block_n)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), a_shard.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.HBM((n, M, N), a_shard.dtype),
+            pltpu.HBM((M, N), a_shard.dtype),
+            pltpu.VMEM((M, k_loc), a_shard.dtype),
+            pltpu.VMEM((k_loc, block_n), b_shard.dtype),
+            pltpu.VMEM((M, block_n), jnp.float32),
+            pltpu.VMEM((M, block_n), a_shard.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=shmem_compiler_params(ctx.collective_id),
+        interpret=interpret_mode(),
+    )(a_shard, b_shard)
+
+
+def gemm_allreduce(a, b, ctx: Optional[GemmARContext] = None, *,
+                   mesh: Optional[Mesh] = None, axis: str = "tp"):
+    """C = allreduce(A @ B) fused in one kernel (reference:
+    gemm_allreduce_op, gemm_allreduce.py:732).
+
+    A: [M, K] sharded on cols; B: [K, N] sharded on rows. Returns C
+    [M, N] replicated over `axis` — the torch-AR-equivalent TP epilogue
+    but without a separate collective.
+    """
+    if ctx is None:
+        assert mesh is not None, "pass ctx or mesh"
+        ctx = create_gemm_ar_context(mesh, axis)
+    mesh = ctx.mesh
+    axis = ctx.axis
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(None, None),
+        check_vma=False)
+    def _f(a_shard, b_shard):
+        return _gemm_ar_call(a_shard, b_shard, ctx)
+
+    return _f(a, b)

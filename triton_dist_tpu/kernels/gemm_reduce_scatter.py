@@ -1,0 +1,216 @@
+"""GEMM-ReduceScatter: row-parallel TP epilogue with comm hidden behind
+the MXU.
+
+TPU-native re-design of the reference
+(`python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py`:
+`GEMMReduceScatterTensorParallelContext` :47, producer GEMM notifying
+per-tile :125-333, RS consumer `reduce_scatter.py` :471-822, host op
+`gemm_rs` :723).
+
+Reference architecture: the GEMM is the *producer* — as each output tile
+finishes it notifies per-segment flags; a reduce-scatter consumer streams
+segments as they become ready.
+
+TPU re-design: one kernel pipelines the ring reduce-scatter against the
+GEMM. The output rows are computed chunk-by-chunk in ring order — step s
+computes the chunk destined for device (me-s-1)%n, exactly when the ring
+needs to forward it — so each remote DMA is in flight while the MXU
+computes the next chunk:
+
+    step s:  MXU: P = A @ B rows of chunk (me-s-1)%n
+             (s>=1) wait recv; P += chunk arrived from left
+             (s<n-1) RDMA P -> right neighbor        (overlaps step s+1 GEMM)
+             (s=n-1) P is the fully-reduced local output chunk
+
+A (row-parallel): [M, k_loc] local; B: [k_loc, N] local; out: [m_loc, N].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+from triton_dist_tpu.utils import cdiv
+
+
+@dataclasses.dataclass
+class GEMMReduceScatterTensorParallelContext:
+    """Reference: GEMMReduceScatterTensorParallelContext
+    (gemm_reduce_scatter.py:47)."""
+    mesh: Mesh
+    axis: str
+    n: int
+    block_n: int
+    collective_id: int
+
+
+def create_gemm_rs_context(mesh: Mesh, axis: str = "tp", *,
+                           block_n: int = 512,
+                           collective_id: Optional[int] = None,
+                           ) -> GEMMReduceScatterTensorParallelContext:
+    return GEMMReduceScatterTensorParallelContext(
+        mesh=mesh, axis=axis, n=mesh.shape[axis], block_n=block_n,
+        collective_id=(collective_id if collective_id is not None
+                       else next_collective_id()))
+
+
+def _divisor_block(n_total: int, block: int) -> int:
+    b = min(block, n_total)
+    if n_total < 128:
+        return n_total
+    b = b // 128 * 128
+    while b > 0 and n_total % b:
+        b -= 128
+    return b if b > 0 else n_total
+
+
+def _gemm_rs_kernel(n: int, axis: str, block_n: int,
+                    a_ref, b_ref, o_ref,
+                    land_ref, send_buf,
+                    a_vmem, b_vmem, p_vmem, tmp_vmem,
+                    copy_sem, send_sems, recv_sems, credit_sem):
+    me = dl.my_pe(axis)
+    m_loc, N = o_ref.shape
+    k_loc = a_ref.shape[1]
+    nt = cdiv(N, block_n)
+    left, right = dl.ring_neighbors(axis)
+    dl.barrier_all(axis)
+
+    if nt == 1:
+        cp = pltpu.make_async_copy(b_ref, b_vmem, copy_sem)
+        cp.start()
+        cp.wait()
+
+    for s in range(n):
+        slot = s % 2
+        last = s == n - 1
+        chunk = jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
+        dest = o_ref if last else send_buf.at[slot]
+        if s >= 2 and not last:
+            # this slot's previous RDMA must finish reading send_buf
+            dl.quiet(send_sems.at[slot], send_buf.at[slot], 1)
+        # --- producer GEMM for this chunk (ref: per-tile notify GEMM,
+        # gemm_reduce_scatter.py:125-333); the RDMA from step s-1 is in
+        # flight under these dots -> the overlap.
+        cp = pltpu.make_async_copy(
+            a_ref.at[pl.ds(chunk * m_loc, m_loc)], a_vmem, copy_sem)
+        cp.start()
+        cp.wait()
+        for j in range(nt):
+            if nt > 1:
+                cpb = pltpu.make_async_copy(
+                    b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem,
+                    copy_sem)
+                cpb.start()
+                cpb.wait()
+            p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
+                                  preferred_element_type=jnp.float32)
+            tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+            cp = pltpu.make_async_copy(
+                tmp_vmem, dest.at[:, pl.ds(j * block_n, block_n)], copy_sem)
+            cp.start()
+            cp.wait()
+        if s >= 1:
+            # consumer: add the accumulated chunk from the left (per-slot
+            # recv semaphore against out-of-order arrival)
+            pltpu.make_async_copy(o_ref, o_ref,
+                                  recv_sems.at[(s - 1) % 2]).wait()
+            prev_slot = (s - 1) % 2
+            for j in range(nt):
+                cp = pltpu.make_async_copy(
+                    dest.at[:, pl.ds(j * block_n, block_n)], tmp_vmem,
+                    copy_sem)
+                cp.start()
+                cp.wait()
+                p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
+                cp = pltpu.make_async_copy(
+                    land_ref.at[prev_slot, :, pl.ds(j * block_n, block_n)],
+                    tmp_vmem, copy_sem)
+                cp.start()
+                cp.wait()
+                p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(jnp.float32)
+                tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+                cp = pltpu.make_async_copy(
+                    tmp_vmem, dest.at[:, pl.ds(j * block_n, block_n)],
+                    copy_sem)
+                cp.start()
+                cp.wait()
+            dl.signal_op(credit_sem, 1, left, axis)
+        if not last:
+            if s >= 2:
+                # right neighbor must have consumed this slot's previous load
+                pltpu.semaphore_wait(credit_sem, 1)
+            dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
+                          send_sems.at[slot], recv_sems.at[slot], right, axis)
+    # drain the last outstanding send on each slot
+    dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
+    if n > 2:
+        dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
+    pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+
+
+def _gemm_rs_call(a_shard, b_shard,
+                  ctx: GEMMReduceScatterTensorParallelContext):
+    M, k_loc = a_shard.shape
+    N = b_shard.shape[1]
+    n = ctx.n
+    m_loc = M // n
+    block_n = _divisor_block(N, ctx.block_n)
+    kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m_loc, N), a_shard.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.HBM((2, m_loc, N), a_shard.dtype),
+            pltpu.HBM((2, m_loc, N), a_shard.dtype),
+            pltpu.VMEM((m_loc, k_loc), a_shard.dtype),
+            pltpu.VMEM((k_loc, block_n), b_shard.dtype),
+            pltpu.VMEM((m_loc, block_n), jnp.float32),
+            pltpu.VMEM((m_loc, block_n), a_shard.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=shmem_compiler_params(ctx.collective_id),
+        interpret=interpret_mode(),
+    )(a_shard, b_shard)
+
+
+def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
+            *, mesh: Optional[Mesh] = None, axis: str = "tp"):
+    """C = reduce_scatter(A @ B) with comm/compute overlap (reference:
+    gemm_rs, gemm_reduce_scatter.py:723).
+
+    A: [M, K] sharded on cols (row-parallel activations); B: [K, N]
+    sharded on rows (row-parallel weight). Returns C: [M, N] sharded on
+    rows over `axis` — the TP MLP/attention epilogue.
+    """
+    if ctx is None:
+        assert mesh is not None, "pass ctx or mesh"
+        ctx = create_gemm_rs_context(mesh, axis)
+    mesh = ctx.mesh
+    axis = ctx.axis
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False)
+    def _f(a_shard, b_shard):
+        return _gemm_rs_call(a_shard, b_shard, ctx)
+
+    return _f(a, b)

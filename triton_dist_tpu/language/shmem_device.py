@@ -52,24 +52,41 @@ def ring_neighbors(axis: str):
     return left, right
 
 
-def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe) -> "pltpu.AsyncCopyDescriptor":
+def _device_id(pe, axis: Optional[str]):
+    """Normalize a peer rank into a Pallas device_id.
+
+    With `axis`, address by mesh coordinate ({axis: pe}, MESH type) so the
+    peer is `pe` along that axis and *this device's own* coordinates along
+    every other mesh axis — correct on N-D meshes (dp×tp etc.), where a
+    flat LOGICAL id would cross shard groups. Without `axis`, `pe` is the
+    flattened logical id (only correct on 1-D meshes).
+    """
+    if axis is None:
+        return pe, pltpu.DeviceIdType.LOGICAL
+    return {axis: pe}, pltpu.DeviceIdType.MESH
+
+
+def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,
+               axis: Optional[str] = None) -> "pltpu.AsyncCopyDescriptor":
     """Non-blocking one-sided put: write src_ref (local) into dst_ref on
     device `pe` of the same kernel instance (ref: nvshmem_putmem_nbi_block,
     libshmem_device.py). Returns the descriptor; call .wait_send()/.wait()
     or use quiet() on the send semaphore."""
+    device_id, did_type = _device_id(pe, axis)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref, dst_ref=dst_ref,
         send_sem=send_sem, recv_sem=recv_sem,
-        device_id=pe, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        device_id=device_id, device_id_type=did_type)
     rdma.start()
     return rdma
 
 
-def putmem_signal(dst_ref, src_ref, send_sem, recv_sem, pe) -> "pltpu.AsyncCopyDescriptor":
+def putmem_signal(dst_ref, src_ref, send_sem, recv_sem, pe,
+                  axis: Optional[str] = None) -> "pltpu.AsyncCopyDescriptor":
     """Put-with-signal (ref: nvshmem_putmem_signal_nbi_block): on TPU the
     receive semaphore *is* the signal — the receiver's semaphore_wait on
     `recv_sem` is the `signal_wait_until` of the reference."""
-    return putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe)
+    return putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe, axis)
 
 
 def local_copy(dst_ref, src_ref, sem) -> None:
@@ -92,14 +109,15 @@ def local_copy_nbi(dst_ref, src_ref, sem):
     return dma
 
 
-def signal_op(sem, inc: int = 1, pe=None) -> None:
+def signal_op(sem, inc: int = 1, pe=None, axis: Optional[str] = None) -> None:
     """Increment a (possibly remote) semaphore (ref: nvshmemx_signal_op
     with NVSHMEM_SIGNAL_ADD)."""
     if pe is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
-        pltpu.semaphore_signal(sem, inc=inc, device_id=pe,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        device_id, did_type = _device_id(pe, axis)
+        pltpu.semaphore_signal(sem, inc=inc, device_id=device_id,
+                               device_id_type=did_type)
 
 
 def signal_wait_until(sem, value: int) -> None:
@@ -160,8 +178,8 @@ def barrier_all(axis: str, barrier_sem=None) -> None:
     for k in range(rounds):
         dist = 1 << k
         dst = jax.lax.rem(me + dist, n)
-        pltpu.semaphore_signal(sem, inc=1, device_id=dst,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(sem, inc=1, device_id={axis: dst},
+                               device_id_type=pltpu.DeviceIdType.MESH)
         pltpu.semaphore_wait(sem, 1)
 
 

@@ -5,8 +5,12 @@ from triton_dist_tpu.runtime.bootstrap import (  # noqa: F401
     DistContext,
     interpret_mode,
     shmem_compiler_params,
+    make_mesh,
+    on_tpu,
+    next_collective_id,
 )
 from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
     SymmetricWorkspace,
     create_symm_buffer,
+    clear_registry,
 )

@@ -148,6 +148,21 @@ def on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+_NEXT_COLLECTIVE_ID = 0
+
+
+def next_collective_id() -> int:
+    """Allocate a fresh collective_id for a kernel family using the global
+    barrier semaphore. Mosaic matches barrier semaphores across devices by
+    collective_id, so two *different* concurrently-running collective
+    kernels must not share one (reference analog: NVSHMEM's per-context
+    signal buffers keeping ops' flags disjoint)."""
+    global _NEXT_COLLECTIVE_ID
+    cid = _NEXT_COLLECTIVE_ID
+    _NEXT_COLLECTIVE_ID = (_NEXT_COLLECTIVE_ID + 1) % 16384
+    return cid
+
+
 def shmem_compiler_params(collective_id: Optional[int] = None, **kwargs):
     """CompilerParams for communication kernels.
 
