@@ -20,52 +20,75 @@ _real = os.environ.get("TDTPU_REAL_DEVICES") == "1"
 # consume the whole pool and any queued sub-computation (operand
 # materialization for an io_callback) deadlocks. The fakecpus.so LD_PRELOAD
 # shim reports FAKE_NPROC CPUs so the pool is big enough; threads timeshare
-# the real cores. Re-exec once with the shim when the machine is small.
+# the real cores. We must re-exec for LD_PRELOAD to take effect; that
+# happens in pytest_configure (below) so pytest's fd-capture can be stopped
+# first (otherwise the re-exec'ed process writes into the dead process's
+# capture tempfile and the terminal shows nothing).
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SHIM_SRC = os.path.join(_REPO, "tools", "fakecpus.c")
 _SHIM = os.path.join(_REPO, "tools", "fakecpus.so")
-if (not _real and (os.cpu_count() or 1) < 4 * 8
-        and "fakecpus" not in os.environ.get("LD_PRELOAD", "")
-        and os.environ.get("TDTPU_NO_FAKECPUS") != "1"):
+_NEEDS_SHIM = (not _real and (os.cpu_count() or 1) < 4 * 8
+               and "fakecpus" not in os.environ.get("LD_PRELOAD", "")
+               and os.environ.get("TDTPU_NO_FAKECPUS") != "1")
+
+
+def pytest_configure(config):
+    if not _NEEDS_SHIM:
+        return
     if not os.path.exists(_SHIM) and os.path.exists(_SHIM_SRC):
         subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", _SHIM,
                         _SHIM_SRC], check=False)
-    if os.path.exists(_SHIM):
-        env = dict(os.environ)
-        env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
-        env.setdefault("FAKE_NPROC", "32")
-        os.execve(sys.executable, [sys.executable, "-m", "pytest"]
-                  + sys.argv[1:], env)
+    if not os.path.exists(_SHIM):
+        return
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
+    env.setdefault("FAKE_NPROC", "32")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"]
+              + sys.argv[1:], env)
+
+
 if not _real:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") +
         " --xla_force_host_platform_device_count=8")
 
-import jax  # noqa: E402
+if not _NEEDS_SHIM:
+    import jax
 
-if not _real:
-    jax.config.update("jax_platforms", "cpu")
-    # The environment may have eagerly registered an accelerator backend
-    # (sitecustomize); drop initialized backends so the cpu override takes.
-    try:
-        import jax.extend as jex
-        jex.backend.clear_backends()
-    except Exception:
-        pass
-    assert jax.default_backend() == "cpu", jax.default_backend()
+    if not _real:
+        jax.config.update("jax_platforms", "cpu")
+        # The environment may have eagerly registered an accelerator backend
+        # (sitecustomize); drop initialized backends so the cpu override
+        # takes.
+        try:
+            import jax.extend as jex
+            jex.backend.clear_backends()
+        except Exception:
+            pass
+        assert jax.default_backend() == "cpu", jax.default_backend()
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def ndev():
+    import jax
     return len(jax.devices())
 
 
 @pytest.fixture()
 def ctx8():
     """Fresh 8-way TP context."""
+    import jax
     from triton_dist_tpu import initialize_distributed, finalize_distributed
     ctx = initialize_distributed({"tp": len(jax.devices())})
     yield ctx
