@@ -1,0 +1,54 @@
+"""Fused SwiGLU activation.
+
+TPU-native re-design of the reference fused activation
+(`python/triton_dist/kernels/nvidia/swiglu.py`, 374 LoC). On TPU the
+XLA fusion engine already folds silu(g)*u into neighboring ops, so the
+default path is plain jnp (idiomatic); the Pallas kernel exists for the
+fused MLP paths where the activation must run inside a hand-scheduled
+kernel between DMAs (and as the single-device unit test target).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+
+
+def swiglu_ref(x2):
+    """silu(gate) * up where x2 = [..., 2*I] packed [gate | up]
+    (jnp reference; XLA fuses this into surrounding matmuls)."""
+    g, u = jnp.split(x2, 2, axis=-1)
+    return jax.nn.silu(g) * u
+
+
+def _swiglu_kernel(x_ref, o_ref):
+    half = o_ref.shape[-1]
+    g = x_ref[:, :half]
+    u = x_ref[:, half:]
+    o_ref[...] = (g * jax.lax.logistic(g.astype(jnp.float32)).astype(g.dtype)
+                  * u)
+
+
+def swiglu(x2, *, block_m: int = 512):
+    """Pallas fused SwiGLU over rows of a 2-D [M, 2I] input."""
+    M, two_i = x2.shape
+    half = two_i // 2
+    bm = min(block_m, M)
+    while M % bm:
+        bm -= 1
+    return pl.pallas_call(
+        _swiglu_kernel,
+        out_shape=jax.ShapeDtypeStruct((M, half), x2.dtype),
+        grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, two_i), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bm, half), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret_mode(),
+    )(x2)

@@ -1,0 +1,13 @@
+"""Layers (reference analog: python/triton_dist/layers/nvidia/,
+SURVEY.md §2.4): TP building blocks over the overlapped kernel library,
+with the reference's forward-mode switch (xla oracle / overlapped dist /
+AR / fused GEMM-AR)."""
+
+from triton_dist_tpu.layers.common import (  # noqa: F401
+    rms_norm,
+    precompute_rope,
+    apply_rope,
+    shard_cols_packed,
+)
+from triton_dist_tpu.layers.tp_mlp import TP_MLP  # noqa: F401
+from triton_dist_tpu.layers.tp_attn import TP_Attn  # noqa: F401

@@ -1,0 +1,61 @@
+"""Shared layer math: RMSNorm, RoPE, TP weight packing.
+
+Reference analogs: RoPE at layers/nvidia/tp_attn.py:165, weight sharding
+`shard_local` at layers/nvidia/tp_mlp.py:38 (torch chunk per rank). Here
+sharding is declarative (NamedSharding) and packing is a host-side array
+transform.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm in f32 accumulation (Qwen3-style)."""
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(dt) * weight
+
+
+def precompute_rope(head_dim: int, max_seq: int, theta: float = 1e6):
+    """cos/sin tables [max_seq, head_dim//2] (Qwen3 uses theta=1e6)."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_seq)
+    freqs = np.outer(t, inv)
+    return (jnp.asarray(np.cos(freqs), dtype=jnp.float32),
+            jnp.asarray(np.sin(freqs), dtype=jnp.float32))
+
+
+def apply_rope(x, cos, sin, positions):
+    """Rotate half-pairs: x [..., S, H, D]; cos/sin [max_seq, D/2];
+    positions [S] (ref: tp_attn.py:165 applies the same rotation on the
+    gathered QKV)."""
+    c = cos[positions][:, None, :]  # [S, 1, D/2]
+    s = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    dt = x.dtype
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [x1f * c - x2f * s, x2f * c + x1f * s], axis=-1).astype(dt)
+
+
+def shard_cols_packed(mats, n: int):
+    """Pack several column-parallel weights into one matrix whose global
+    column layout is n per-rank blocks, each the concat of every input's
+    rank-slice: [m0_r | m1_r | ...] for rank r.
+
+    This is how gate/up (MLP) and q/k/v (attention) projections fuse into
+    ONE ag_gemm while keeping each rank's output slice self-contained
+    (reference analog: per-rank torch chunking in shard_local,
+    tp_mlp.py:38).
+    """
+    blocks = []
+    for r in range(n):
+        for m in mats:
+            cols = m.shape[1] // n
+            blocks.append(m[:, r * cols:(r + 1) * cols])
+    return jnp.concatenate(blocks, axis=1)

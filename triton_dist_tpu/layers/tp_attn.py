@@ -1,0 +1,181 @@
+"""Tensor-parallel attention (GQA) with the reference's mode switch.
+
+TPU-native re-design of `python/triton_dist/layers/nvidia/tp_attn.py`
+(`TP_Attn:80` — QKV AG-GEMM, flash attention, O-proj GEMM-RS :213; AR and
+GEMM-AR variants :251-318; RoPE :165).
+
+Head-parallel TP: each rank owns Hq/n query heads and Hkv/n KV heads.
+The QKV projection is ONE ag_gemm over a packed [q_r | k_r | v_r] weight
+(every rank's output slice is self-contained), attention runs locally on
+the rank's heads over the full (gathered) sequence, and the O projection
+reduces+scatters back to sequence sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels import (ag_gemm, all_reduce,
+                                     create_ag_gemm_context,
+                                     create_gemm_ar_context,
+                                     create_gemm_rs_context, gemm_allreduce,
+                                     gemm_rs)
+from triton_dist_tpu.layers.common import (apply_rope, rms_norm,
+                                           shard_cols_packed)
+
+
+def causal_attention(q, k, v, scale: float):
+    """Causal GQA attention, one device's heads, full sequence.
+    q: [S, Hq, d]; k, v: [T, Hkv, d] with T >= S (suffix alignment:
+    query i attends to keys <= T - S + i). f32 softmax."""
+    S, Hq, d = q.shape
+    T, Hkv, _ = k.shape
+    rep = Hq // Hkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    qi = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    mask = ki <= (qi + (T - S))
+    logits = jnp.where(mask[None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hst,thd->shd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TP_Attn:
+    """Weights (pytree leaves) + static head/TP config.
+
+    w_qkv: [D, (Hq + 2*Hkv) * hd] — n per-rank blocks [q_r | k_r | v_r].
+    w_o:   [Hq * hd, D] — row-parallel.
+    q_norm/k_norm: per-head-dim RMSNorm weights (Qwen3 QK-norm).
+    """
+
+    w_qkv: jax.Array
+    w_o: jax.Array
+    q_norm: Optional[jax.Array]
+    k_norm: Optional[jax.Array]
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    n_heads: int = dataclasses.field(metadata=dict(static=True))
+    n_kv_heads: int = dataclasses.field(metadata=dict(static=True))
+    head_dim: int = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def init(w_q, w_k, w_v, w_o, *, mesh: Mesh, axis: str = "tp",
+             n_heads: int, n_kv_heads: int, head_dim: int,
+             q_norm=None, k_norm=None):
+        n = mesh.shape[axis]
+        packed = shard_cols_packed([w_q, w_k, w_v], n)
+        packed = jax.device_put(packed, NamedSharding(mesh, P(None, axis)))
+        w_o = jax.device_put(jnp.asarray(w_o),
+                             NamedSharding(mesh, P(axis, None)))
+        return TP_Attn(w_qkv=packed, w_o=w_o,
+                       q_norm=None if q_norm is None else jnp.asarray(q_norm),
+                       k_norm=None if k_norm is None else jnp.asarray(k_norm),
+                       mesh=mesh, axis=axis, n_heads=n_heads,
+                       n_kv_heads=n_kv_heads, head_dim=head_dim)
+
+    # per-rank sizes
+    @property
+    def _hq_loc(self):
+        return self.n_heads // self.mesh.shape[self.axis]
+
+    @property
+    def _hkv_loc(self):
+        return self.n_kv_heads // self.mesh.shape[self.axis]
+
+    def _local_attn(self, qkv, cos, sin, positions):
+        """Split a rank's packed [q|k|v] slice, QK-norm + RoPE, causal
+        attention over the rank's heads (ref: tp_attn.py:165-213)."""
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        scale = hd ** -0.5
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=P(None, self.axis),
+                           out_specs=P(None, self.axis), check_vma=False)
+        def f(qkv_loc):
+            S = qkv_loc.shape[0]
+            q = qkv_loc[:, :hq * hd].reshape(S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            o = causal_attention(q, k, v, scale)
+            return o.reshape(S, hq * hd)
+
+        return f(qkv)
+
+    def fwd_xla(self, x, cos, sin, positions):
+        """Pure-XLA oracle (reference: torch_fwd): XLA inserts the psum
+        for the row-sharded O projection."""
+        qkv = x @ self.w_qkv
+        o = self._local_attn(qkv, cos, sin, positions)
+        return jnp.matmul(o, self.w_o, out_sharding=P(None, None))
+
+    def fwd_dist(self, x, cos, sin, positions):
+        """AG-GEMM -> attention -> GEMM-RS (reference: dist_triton_fwd,
+        tp_attn.py:213). x: [S, D] sharded on rows."""
+        ag_ctx = create_ag_gemm_context(self.mesh, self.axis)
+        rs_ctx = create_gemm_rs_context(self.mesh, self.axis)
+        qkv = ag_gemm(x, self.w_qkv, ag_ctx)
+        o = self._local_attn(qkv, cos, sin, positions)
+        return gemm_rs(o, self.w_o, rs_ctx)
+
+    def fwd_ar(self, x, cos, sin, positions):
+        """Local QKV + attention + partial O-proj + AR kernel (reference:
+        AR fwd, tp_attn.py:251). x replicated; returns replicated."""
+        axis = self.axis
+        hq, hd = self._hq_loc, self.head_dim
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None), P(None, axis)),
+                           out_specs=P(None, axis), check_vma=False)
+        def qkv_local(x_r, w_loc):
+            return x_r @ w_loc
+
+        qkv = qkv_local(x, self.w_qkv)
+        o = self._local_attn(qkv, cos, sin, positions)
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, axis), P(axis, None)),
+                           out_specs=P(axis, None, None), check_vma=False)
+        def o_partial(o_loc, wo_loc):
+            return (o_loc @ wo_loc)[None]
+
+        parts = o_partial(o, self.w_o)
+        del hq, hd
+        return all_reduce(parts, mesh=self.mesh, axis=axis)
+
+    def fwd_gemm_ar(self, x, cos, sin, positions):
+        """Fused GEMM+AR for the O projection (reference: tp_attn.py:318)."""
+        axis = self.axis
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None), P(None, axis)),
+                           out_specs=P(None, axis), check_vma=False)
+        def qkv_local(x_r, w_loc):
+            return x_r @ w_loc
+
+        qkv = qkv_local(x, self.w_qkv)
+        o = self._local_attn(qkv, cos, sin, positions)
+        ctx = create_gemm_ar_context(self.mesh, axis)
+        return gemm_allreduce(o, self.w_o, ctx)
+
+    def __call__(self, x, cos, sin, positions, mode: str = "dist"):
+        return dict(xla=self.fwd_xla, dist=self.fwd_dist, ar=self.fwd_ar,
+                    gemm_ar=self.fwd_gemm_ar)[mode](x, cos, sin, positions)

@@ -1,0 +1,136 @@
+"""Tensor-parallel MLP (SwiGLU) with the reference's forward-mode switch.
+
+TPU-native re-design of `python/triton_dist/layers/nvidia/tp_mlp.py`
+(`TP_MLP:52` — torch_fwd / dist_triton_fwd (AG-GEMM -> GEMM-RS :143) /
+AR fwd :177 / fused GEMM-AR fwd :205; weight sharding shard_local :38).
+
+Forward modes:
+  "xla"      — pure-XLA oracle (sharding-annotated jnp; XLA inserts the
+               collectives). The role torch+NCCL plays in the reference.
+  "dist"     — ag_gemm -> swiglu -> gemm_rs, comm hidden inside Pallas
+               kernels (sequence-sharded activations).
+  "ar"       — local partial GEMMs + explicit all_reduce kernel
+               (replicated activations, decode-style).
+  "gemm_ar"  — fused gemm_allreduce kernel for the down projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels import (ag_gemm, all_reduce, create_ag_gemm_context,
+                                     create_gemm_ar_context,
+                                     create_gemm_rs_context, gemm_allreduce,
+                                     gemm_rs)
+from triton_dist_tpu.kernels.swiglu import swiglu_ref
+from triton_dist_tpu.layers.common import shard_cols_packed
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TP_MLP:
+    """Weights (pytree leaves) + static TP config.
+
+    w_gate_up: [D, 2*I] — n per-rank blocks, each [gate_r | up_r]
+               (column-parallel; built by `init` via shard_cols_packed).
+    w_down:    [I, D]   — row-parallel.
+    """
+
+    w_gate_up: jax.Array
+    w_down: jax.Array
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def init(w_gate, w_up, w_down, *, mesh: Mesh, axis: str = "tp"):
+        """Shard+pack plain [D,I]/[D,I]/[I,D] weights onto the mesh
+        (reference: shard_local, tp_mlp.py:38)."""
+        n = mesh.shape[axis]
+        packed = shard_cols_packed([w_gate, w_up], n)
+        packed = jax.device_put(packed, NamedSharding(mesh, P(None, axis)))
+        w_down = jax.device_put(jnp.asarray(w_down),
+                                NamedSharding(mesh, P(axis, None)))
+        return TP_MLP(w_gate_up=packed, w_down=w_down, mesh=mesh, axis=axis)
+
+    # -- contexts are created lazily per call-site jit; they carry only
+    # static config so this is free (unlike the reference's symmetric
+    # buffer allocation, tp_mlp.py:116)
+    def _ctxs(self):
+        return (create_ag_gemm_context(self.mesh, self.axis),
+                create_gemm_rs_context(self.mesh, self.axis))
+
+    def _local_swiglu(self, c):
+        """Apply SwiGLU on each rank's [gate_r | up_r] block."""
+        n = self.mesh.shape[self.axis]
+        i_loc = self.w_gate_up.shape[1] // (2 * n)
+
+        import functools
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=P(None, self.axis),
+                           out_specs=P(None, self.axis), check_vma=False)
+        def f(c_loc):
+            return swiglu_ref(c_loc)
+
+        del i_loc
+        return f(c)
+
+    def fwd_xla(self, x):
+        """Pure-XLA oracle (reference: torch_fwd, tp_mlp.py:~100): plain
+        jnp with sharded weights; XLA inserts the psum for the contraction
+        over the row-sharded down projection."""
+        c = x @ self.w_gate_up
+        h = self._local_swiglu(c)
+        return jnp.matmul(h, self.w_down, out_sharding=P(None, None))
+
+    def fwd_dist(self, x):
+        """AG-GEMM -> SwiGLU -> GEMM-RS (reference: dist_triton_fwd,
+        tp_mlp.py:143). x: [M, D] sharded on rows over the TP axis."""
+        ag_ctx, rs_ctx = self._ctxs()
+        c = ag_gemm(x, self.w_gate_up, ag_ctx)     # [M, 2I] P(None, tp)
+        h = self._local_swiglu(c)                  # [M, I]  P(None, tp)
+        return gemm_rs(h, self.w_down, rs_ctx)     # [M, D]  P(tp, None)
+
+    def fwd_ar(self, x):
+        """Local GEMMs + explicit AR kernel (reference: AR fwd,
+        tp_mlp.py:177). x replicated; returns replicated."""
+        n = self.mesh.shape[self.axis]
+        axis = self.axis
+
+        import functools
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None), P(None, axis),
+                                     P(axis, None)),
+                           out_specs=P(axis, None, None), check_vma=False)
+        def partial_mlp(x_r, wgu_loc, wd_loc):
+            c = x_r @ wgu_loc
+            h = swiglu_ref(c)
+            return (h @ wd_loc)[None]
+
+        parts = partial_mlp(x, self.w_gate_up, self.w_down)  # [n, M, D]
+        return all_reduce(parts, mesh=self.mesh, axis=axis)
+
+    def fwd_gemm_ar(self, x):
+        """Fused GEMM+AR for the down projection (reference: fused
+        GEMM-AR fwd, tp_mlp.py:205)."""
+        axis = self.axis
+
+        import functools
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None), P(None, axis)),
+                           out_specs=P(None, axis), check_vma=False)
+        def up(x_r, wgu_loc):
+            return swiglu_ref(x_r @ wgu_loc)
+
+        h = up(x, self.w_gate_up)                   # [M, I] P(None, tp)
+        ctx = create_gemm_ar_context(self.mesh, axis)
+        return gemm_allreduce(h, self.w_down, ctx)  # [M, D] replicated
+
+    def __call__(self, x, mode: str = "dist"):
+        """Mode switch (reference: DenseLLM set_fwd, models/dense.py:84)."""
+        return dict(xla=self.fwd_xla, dist=self.fwd_dist, ar=self.fwd_ar,
+                    gemm_ar=self.fwd_gemm_ar)[mode](x)
