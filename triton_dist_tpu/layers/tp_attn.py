@@ -121,11 +121,18 @@ class TP_Attn:
         return f(qkv)
 
     def fwd_xla(self, x, cos, sin, positions):
-        """Pure-XLA oracle (reference: torch_fwd): XLA inserts the psum
-        for the row-sharded O projection."""
+        """Pure-XLA oracle (reference: torch_fwd): jnp + XLA psum
+        collective — the torch/NCCL role from the reference."""
         qkv = x @ self.w_qkv
         o = self._local_attn(qkv, cos, sin, positions)
-        return jnp.matmul(o, self.w_o, out_sharding=P(None, None))
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, self.axis), P(self.axis, None)),
+                           out_specs=P(None, None), check_vma=False)
+        def down(o_loc, wo_loc):
+            return jax.lax.psum(o_loc @ wo_loc, self.axis)
+
+        return down(o, self.w_o)
 
     def fwd_dist(self, x, cos, sin, positions):
         """AG-GEMM -> attention -> GEMM-RS (reference: dist_triton_fwd,

@@ -80,12 +80,19 @@ class TP_MLP:
         return f(c)
 
     def fwd_xla(self, x):
-        """Pure-XLA oracle (reference: torch_fwd, tp_mlp.py:~100): plain
-        jnp with sharded weights; XLA inserts the psum for the contraction
-        over the row-sharded down projection."""
+        """Pure-XLA oracle (reference: torch_fwd, tp_mlp.py:~100): jnp +
+        XLA psum collective — the torch/NCCL role from the reference."""
+        import functools
         c = x @ self.w_gate_up
         h = self._local_swiglu(c)
-        return jnp.matmul(h, self.w_down, out_sharding=P(None, None))
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, self.axis), P(self.axis, None)),
+                           out_specs=P(None, None), check_vma=False)
+        def down(h_loc, wd_loc):
+            return jax.lax.psum(h_loc @ wd_loc, self.axis)
+
+        return down(h, self.w_down)
 
     def fwd_dist(self, x):
         """AG-GEMM -> SwiGLU -> GEMM-RS (reference: dist_triton_fwd,
