@@ -51,6 +51,30 @@ def causal_attention(q, k, v, scale: float):
     return out.astype(q.dtype)
 
 
+def causal_attention_batched(q, k, v, scale: float, kv_len):
+    """Batched causal GQA attention against a (possibly longer) KV buffer.
+    q: [B, S, Hq, d]; k, v: [B, T, Hkv, d] where T is the static cache
+    capacity. `kv_len` (traced scalar) is the number of valid KV
+    positions; query i sits at absolute position kv_len - S + i. Masked
+    f32 softmax over the full static T (the standard static-shape decode
+    pattern: compute over capacity, mask the tail)."""
+    B, S, Hq, d = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    rep = Hq // Hkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    qi = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    mask = ki <= (qi + (kv_len - S))
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TP_Attn:
@@ -186,3 +210,99 @@ class TP_Attn:
     def __call__(self, x, cos, sin, positions, mode: str = "dist"):
         return dict(xla=self.fwd_xla, dist=self.fwd_dist, ar=self.fwd_ar,
                     gemm_ar=self.fwd_gemm_ar)[mode](x, cos, sin, positions)
+
+    # ------------------------------------------------------------------
+    # KV-cache paths (prefill fill + decode), used by models/engine
+    # (reference: tp_attn.py decode with KV cache driven by
+    # models/dense.py:101 + kv_cache.py:29)
+    # ------------------------------------------------------------------
+
+    def _attend_cached(self, qkv, cos, sin, batch: int, ck, cv, kv_start):
+        """Split a rank's packed [q|k|v] slice, write K/V into this rank's
+        cache shard at kv_start, attend against the cache.
+
+        qkv: [B*S, qkv_cols] sharded P(None, tp);
+        ck/cv: [B, T, Hkv, hd] sharded on the head axis;
+        kv_start: traced scalar (0 for prefill, pos for decode).
+        Returns (o [B*S, hq_loc*hd] P(None, tp), updated ck, cv).
+        """
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        scale = hd ** -0.5
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(None, None, self.axis, None),
+                      P(None, None, self.axis, None), P()),
+            out_specs=(P(None, self.axis), P(None, None, self.axis, None),
+                       P(None, None, self.axis, None)),
+            check_vma=False)
+        def f(qkv_loc, ck_loc, cv_loc, kv_start):
+            M = qkv_loc.shape[0]
+            S = M // batch
+            q = qkv_loc[:, :hq * hd].reshape(batch, S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(batch, S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(batch, S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            positions = kv_start + jnp.arange(S)
+            # apply_rope expects [..., S, H, d]
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            ck_loc = jax.lax.dynamic_update_slice(
+                ck_loc, k.astype(ck_loc.dtype), (0, kv_start, 0, 0))
+            cv_loc = jax.lax.dynamic_update_slice(
+                cv_loc, v.astype(cv_loc.dtype), (0, kv_start, 0, 0))
+            o = causal_attention_batched(q, ck_loc.astype(q.dtype),
+                                         cv_loc.astype(q.dtype), scale,
+                                         kv_start + S)
+            return o.reshape(M, hq * hd), ck_loc, cv_loc
+
+        return f(qkv, ck, cv, jnp.asarray(kv_start, jnp.int32))
+
+    def fwd_cached(self, x, cos, sin, batch: int, ck, cv, kv_start,
+                   mode: str = "dist"):
+        """Full attention block with KV cache: QKV proj -> cached attend
+        -> O proj, per forward mode. x: [B*S, D] (row-sharded for "dist",
+        replicated otherwise). Returns (y, ck, cv)."""
+        axis = self.axis
+        if mode == "dist":
+            ag_ctx = create_ag_gemm_context(self.mesh, axis)
+            qkv = ag_gemm(x, self.w_qkv, ag_ctx)
+        else:
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=(P(None, None), P(None, axis)),
+                               out_specs=P(None, axis), check_vma=False)
+            def qkv_local(x_r, w_loc):
+                return x_r @ w_loc
+
+            qkv = qkv_local(x, self.w_qkv)
+
+        o, ck, cv = self._attend_cached(qkv, cos, sin, batch, ck, cv,
+                                        kv_start)
+
+        if mode == "dist":
+            rs_ctx = create_gemm_rs_context(self.mesh, axis)
+            y = gemm_rs(o, self.w_o, rs_ctx)
+        elif mode == "gemm_ar":
+            ctx = create_gemm_ar_context(self.mesh, axis)
+            y = gemm_allreduce(o, self.w_o, ctx)
+        elif mode == "ar":
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=(P(None, axis), P(axis, None)),
+                               out_specs=P(axis, None, None),
+                               check_vma=False)
+            def o_partial(o_loc, wo_loc):
+                return (o_loc @ wo_loc)[None]
+
+            y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
+        else:  # "xla" oracle
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=(P(None, axis), P(axis, None)),
+                               out_specs=P(None, None), check_vma=False)
+            def down(o_loc, wo_loc):
+                return jax.lax.psum(o_loc @ wo_loc, axis)
+
+            y = down(o, self.w_o)
+        return y, ck, cv

@@ -1,0 +1,30 @@
+"""Models + inference engine (reference: python/triton_dist/models/,
+SURVEY.md §2.4). `AutoLLM` dispatches by model name/config the way the
+reference does (models/__init__.py:33-59: Qwen3 -> DenseLLM,
+Qwen3-MoE -> Qwen3MoE)."""
+
+from triton_dist_tpu.models.config import (ModelConfig, qwen3_32b,  # noqa: F401
+                                           tiny_qwen3)
+from triton_dist_tpu.models.dense import DenseLLM  # noqa: F401
+from triton_dist_tpu.models.engine import Engine  # noqa: F401
+from triton_dist_tpu.models.kv_cache import KVCache  # noqa: F401
+
+
+class AutoLLM:
+    """Name-based dispatch (reference: AutoLLM.from_pretrained,
+    models/__init__.py:33-59)."""
+
+    @staticmethod
+    def from_pretrained(path: str, mesh, axis: str = "tp"):
+        cfg = ModelConfig.from_hf_config(path)
+        if cfg.is_moe:
+            from triton_dist_tpu.models.qwen_moe import Qwen3MoE
+            return Qwen3MoE.from_hf(path, mesh, axis)
+        return DenseLLM.from_hf(path, mesh, axis)
+
+    @staticmethod
+    def from_config(cfg: ModelConfig, mesh, axis: str = "tp", seed: int = 0):
+        if cfg.is_moe:
+            from triton_dist_tpu.models.qwen_moe import Qwen3MoE
+            return Qwen3MoE.random_init(cfg, mesh, axis, seed)
+        return DenseLLM.random_init(cfg, mesh, axis, seed)

@@ -1,0 +1,190 @@
+"""Dense Qwen3-family LLM (reference: `python/triton_dist/models/dense.py`
+`DenseLLM:117`, per-layer `set_fwd` mode switch :84-100, TP context init
+:169-209; HF weight loading + TP sharding at load :150-168).
+
+Functional pytree model: weights are leaves, mode is an argument (the
+reference mutates per-layer fwd pointers; here the mode string selects
+the path inside one jitted function — same switch, jit-compatible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.layers import TP_Attn, TP_MLP, precompute_rope, rms_norm
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCache
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DenseLayer:
+    attn: TP_Attn
+    mlp: TP_MLP
+    ln_attn: jax.Array
+    ln_mlp: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DenseLLM:
+    embed: jax.Array            # [V, D]
+    layers: Tuple[DenseLayer, ...]
+    final_norm: jax.Array       # [D]
+    lm_head: jax.Array          # [D, V]
+    cos: jax.Array
+    sin: jax.Array
+    config: ModelConfig = dataclasses.field(metadata=dict(static=True))
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def random_init(cfg: ModelConfig, mesh: Mesh, axis: str = "tp",
+                    seed: int = 0) -> "DenseLLM":
+        """Random weights with Qwen3 shapes — the harness/test model."""
+        rng = np.random.RandomState(seed)
+        D, I = cfg.hidden_size, cfg.intermediate_size
+        Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        dt = cfg.jax_dtype
+
+        def w(*shape, scale=None):
+            s = scale if scale is not None else (shape[0] ** -0.5)
+            return jnp.asarray(rng.randn(*shape) * s, dtype=dt)
+
+        layers = []
+        for _ in range(cfg.num_layers):
+            attn = TP_Attn.init(
+                w(D, Hq * hd), w(D, Hkv * hd), w(D, Hkv * hd),
+                w(Hq * hd, D), mesh=mesh, axis=axis, n_heads=Hq,
+                n_kv_heads=Hkv, head_dim=hd,
+                q_norm=np.ones(hd, np.float32),
+                k_norm=np.ones(hd, np.float32))
+            mlp = TP_MLP.init(w(D, I), w(D, I), w(I, D), mesh=mesh,
+                              axis=axis)
+            layers.append(DenseLayer(
+                attn=attn, mlp=mlp,
+                ln_attn=jnp.ones((D,), dt), ln_mlp=jnp.ones((D,), dt)))
+        cos, sin = precompute_rope(hd, cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        embed = w(cfg.vocab_size, D, scale=0.02)
+        return DenseLLM(
+            embed=embed, layers=tuple(layers),
+            final_norm=jnp.ones((D,), dt),
+            lm_head=(embed.T if cfg.tie_word_embeddings
+                     else w(D, cfg.vocab_size, scale=0.02)),
+            cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis)
+
+    @staticmethod
+    def from_hf(path: str, mesh: Mesh, axis: str = "tp") -> "DenseLLM":
+        """Load HF Qwen3 safetensors and shard at load (reference:
+        models/dense.py:150-168). Requires a local checkpoint dir."""
+        from safetensors import safe_open
+
+        cfg = ModelConfig.from_hf_config(path)
+        D, Hq, Hkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                          cfg.head_dim)
+        dt = cfg.jax_dtype
+        tensors = {}
+        for fn in sorted(os.listdir(path)):
+            if fn.endswith(".safetensors"):
+                with safe_open(os.path.join(path, fn), framework="np") as f:
+                    for key in f.keys():
+                        tensors[key] = f.get_tensor(key)
+
+        def t(name):
+            return jnp.asarray(tensors[name], dtype=dt)
+
+        layers = []
+        for li in range(cfg.num_layers):
+            p = f"model.layers.{li}."
+            # HF stores projections transposed ([out, in])
+            attn = TP_Attn.init(
+                t(p + "self_attn.q_proj.weight").T,
+                t(p + "self_attn.k_proj.weight").T,
+                t(p + "self_attn.v_proj.weight").T,
+                t(p + "self_attn.o_proj.weight").T,
+                mesh=mesh, axis=axis, n_heads=Hq, n_kv_heads=Hkv,
+                head_dim=hd,
+                q_norm=tensors.get(p + "self_attn.q_norm.weight"),
+                k_norm=tensors.get(p + "self_attn.k_norm.weight"))
+            mlp = TP_MLP.init(
+                t(p + "mlp.gate_proj.weight").T,
+                t(p + "mlp.up_proj.weight").T,
+                t(p + "mlp.down_proj.weight").T, mesh=mesh, axis=axis)
+            layers.append(DenseLayer(
+                attn=attn, mlp=mlp,
+                ln_attn=t(p + "input_layernorm.weight"),
+                ln_mlp=t(p + "post_attention_layernorm.weight")))
+        cos, sin = precompute_rope(hd, cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        embed = t("model.embed_tokens.weight")
+        lm_head = (embed.T if cfg.tie_word_embeddings
+                   else t("lm_head.weight").T)
+        return DenseLLM(embed=embed, layers=tuple(layers),
+                        final_norm=t("model.norm.weight"),
+                        lm_head=lm_head, cos=cos, sin=sin, config=cfg,
+                        mesh=mesh, axis=axis)
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+
+    def forward_tokens(self, ids, cache: KVCache, mode: str = "dist",
+                       mlp_mode: Optional[str] = None):
+        """One forward pass over `ids` [B, S] starting at cache.offset;
+        fills the cache and returns (last-position logits [B, V], cache).
+
+        mode: attention forward mode; mlp_mode defaults to mode. For
+        "dist", B*S must be divisible by the TP size (reference contract:
+        max_M-padded symmetric workspaces, allgather_gemm.py:447).
+        """
+        B, S = ids.shape
+        mlp_mode = mlp_mode or mode
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        kv_start = cache.offset
+        for li, layer in enumerate(self.layers):
+            ck, cv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, ck, cv = layer.attn.fwd_cached(
+                h, self.cos, self.sin, B, ck, cv, kv_start, mode)
+            cache = cache.set_layer(li, ck, cv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp(h, mlp_mode)
+        cache = cache.advance(S)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "dist":
+            # activations are row-sharded; gather for the LM head tail
+            import functools
+
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=P(self.axis, None), out_specs=P(None, None),
+                check_vma=False)
+            def gather_rows(x_loc):
+                return jax.lax.all_gather(x_loc, self.axis, axis=0,
+                                          tiled=True)
+
+            x = gather_rows(x)
+        last = x.reshape(B, S, -1)[:, -1]
+        logits = last.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        return logits, cache
+
+    def make_cache(self, batch: int, max_seq: int,
+                   dtype=None) -> KVCache:
+        cfg = self.config
+        return KVCache.create(cfg.num_layers, batch, max_seq,
+                              cfg.num_kv_heads, cfg.head_dim,
+                              mesh=self.mesh, axis=self.axis,
+                              dtype=dtype or cfg.jax_dtype)

@@ -1,0 +1,39 @@
+"""Model-side utilities (reference: `python/triton_dist/models/utils.py`
+— sampling helpers + emoji logger)."""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("triton_dist_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[tdtpu] %(levelname)s %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+
+def sample_greedy(logits):
+    return jnp.argmax(logits, axis=-1)
+
+
+def sample_top_k(key, logits, k: int = 50, temperature: float = 1.0):
+    """Top-k sampling (reference: models/utils.py sampling helpers)."""
+    topv, topi = jax.lax.top_k(logits / temperature, k)
+    idx = jax.random.categorical(key, topv)
+    return jnp.take_along_axis(topi, idx[..., None], axis=-1)[..., 0]
+
+
+def sample_top_p(key, logits, p: float = 0.9, temperature: float = 1.0):
+    """Nucleus sampling: mask the tail whose cumulative prob > p."""
+    logits = logits / temperature
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    masked = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, masked)
