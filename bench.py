@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Round benchmark: Qwen3-1.7B greedy decode throughput on the available
+chip(s), normalized against the reference's published per-chip decode
+throughput (BASELINE.md: Qwen3-32B TP8 decode bsz=128 ctx=128 GEMM-AR
+mode, 12.41 ms/step on 8x H800 => 1289 tok/s/chip at 4B params/chip,
+docs/getting-started/e2e/e2e_dense.md:38).
+
+vs_baseline is FLOPs-normalized across model sizes:
+    (our tok/s/chip * our params/chip) / (1289 * 4e9)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import qwen3_1p7b, tiny_qwen3
+
+    ndev = len(jax.devices())
+    mesh = jax.make_mesh((ndev,), ("tp",))
+
+    if on_tpu:
+        cfg = qwen3_1p7b()
+        B, S, gen = 128, 128, 32
+        params = 1.7e9
+    else:
+        # CPU smoke configuration so the bench always produces a line
+        cfg = tiny_qwen3(ndev)
+        B, S, gen = 2, 8, 4
+        params = 1e6
+
+    model = AutoLLM.from_config(cfg, mesh)
+    backend = "xla" if ndev == 1 else "gemm_ar"
+    eng = Engine(model, max_seq=S + gen + 8, backend=backend)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+
+    # warmup (compile)
+    toks = eng.serve(ids, gen)
+    jax.block_until_ready(toks)
+
+    t0 = time.perf_counter()
+    iters = 3 if on_tpu else 1
+    for _ in range(iters):
+        toks = eng.serve(ids, gen)
+        jax.block_until_ready(toks)
+    dt = (time.perf_counter() - t0) / iters
+
+    tok_s = B * gen / dt
+    tok_s_chip = tok_s / ndev
+    # reference: 1289 tok/s/chip at 4e9 params/chip (BASELINE.md)
+    params_per_chip = params / ndev
+    vs_baseline = (tok_s_chip * params_per_chip) / (1289.0 * 4e9)
+
+    print(json.dumps({
+        "metric": "qwen3_decode_tok_per_s_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

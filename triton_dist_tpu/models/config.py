@@ -83,6 +83,14 @@ def tiny_qwen3(n: int = 8, **overrides) -> ModelConfig:
     return ModelConfig(**base)
 
 
+def qwen3_1p7b() -> ModelConfig:
+    """Qwen3-1.7B shapes — the single-chip bench model (fits a v5e)."""
+    return ModelConfig(hidden_size=2048, intermediate_size=6144,
+                       num_layers=28, num_heads=16, num_kv_heads=8,
+                       head_dim=128, vocab_size=151936,
+                       tie_word_embeddings=True)
+
+
 def qwen3_32b() -> ModelConfig:
     """Qwen3-32B shapes (the reference megakernel/e2e target,
     docs/getting-started/megakernel/megakernel.md:29)."""

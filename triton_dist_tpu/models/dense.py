@@ -52,15 +52,19 @@ class DenseLLM:
     @staticmethod
     def random_init(cfg: ModelConfig, mesh: Mesh, axis: str = "tp",
                     seed: int = 0) -> "DenseLLM":
-        """Random weights with Qwen3 shapes — the harness/test model."""
-        rng = np.random.RandomState(seed)
+        """Random weights with Qwen3 shapes — the harness/test model.
+        Generated device-side (jax.random): host-numpy generation of
+        billion-parameter models takes minutes on one core."""
+        key = jax.random.key(seed)
         D, I = cfg.hidden_size, cfg.intermediate_size
         Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         dt = cfg.jax_dtype
+        kit = iter(jax.random.split(key, 16384))
 
         def w(*shape, scale=None):
             s = scale if scale is not None else (shape[0] ** -0.5)
-            return jnp.asarray(rng.randn(*shape) * s, dtype=dt)
+            return jax.random.normal(next(kit), shape, dtype=dt) * jnp.asarray(
+                s, dtype=dt)
 
         layers = []
         for _ in range(cfg.num_layers):

@@ -35,25 +35,13 @@ class Engine:
         # analog here is the XLA-collective mode unless overridden
         self.prefill_backend = prefill_backend or (
             "dist" if backend == "dist" else "xla")
+        # The model is a jit ARGUMENT (weights must not be captured as
+        # program constants — that would bake GBs into the executable)
         self._prefill = jax.jit(functools.partial(
-            model.forward_tokens, mode=self.prefill_backend))
+            _prefill_fn, mode=self.prefill_backend))
         self._decode_scan = jax.jit(
-            functools.partial(self._scan_decode, backend),
-            static_argnames=("gen_len",), donate_argnums=(1,))
-
-    def _scan_decode(self, backend, logits0, cache, *, gen_len: int):
-        model = self.model
-
-        def step(carry, _):
-            logits, cache = carry
-            tok = jnp.argmax(logits, axis=-1)           # greedy [B]
-            logits, cache = model.forward_tokens(tok[:, None], cache,
-                                                 mode=backend)
-            return (logits, cache), tok
-
-        (logits, cache), toks = jax.lax.scan(
-            step, (logits0, cache), None, length=gen_len)
-        return toks.T, logits, cache                     # [B, gen_len]
+            functools.partial(_scan_decode_fn, backend),
+            static_argnames=("gen_len",), donate_argnums=(2,))
 
     def serve(self, input_ids, gen_len: int):
         """Generate greedily (reference: Engine.serve, engine.py:113).
@@ -62,6 +50,24 @@ class Engine:
         input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
         B = input_ids.shape[0]
         cache = self.model.make_cache(B, self.max_seq)
-        logits, cache = self._prefill(input_ids, cache)
-        toks, _, _ = self._decode_scan(logits, cache, gen_len=gen_len)
+        logits, cache = self._prefill(self.model, input_ids, cache)
+        toks, _, _ = self._decode_scan(self.model, logits, cache,
+                                       gen_len=gen_len)
         return toks
+
+
+def _prefill_fn(model, ids, cache, *, mode):
+    return model.forward_tokens(ids, cache, mode=mode)
+
+
+def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):
+    def step(carry, _):
+        logits, cache = carry
+        tok = jnp.argmax(logits, axis=-1)           # greedy [B]
+        logits, cache = model.forward_tokens(tok[:, None], cache,
+                                             mode=backend)
+        return (logits, cache), tok
+
+    (logits, cache), toks = jax.lax.scan(
+        step, (logits0, cache), None, length=gen_len)
+    return toks.T, logits, cache                     # [B, gen_len]
