@@ -1,0 +1,68 @@
+"""Grouped GEMM: per-expert matmuls for MoE.
+
+TPU-native re-design of the reference grouped-GEMM library
+(`python/triton_dist/kernels/nvidia/group_gemm.py` (1102): nk-const
+grouped GEMM, persistent/dynamic variants :251-727).
+
+The reference handles *dynamic* per-expert token counts with
+device-side tile scheduling. XLA requires static shapes, so the TPU
+design is capacity-based: tokens are pre-grouped into [E, C, D] (the
+jnp sort/scatter in ep_a2a.py plays the role of the reference's
+`moe_ag_scatter_align_block_size` CUDA kernel, csrc/lib/moe_utils.cu:61)
+and the grouped GEMM is a Pallas kernel on a (E, C-tiles, F-tiles) grid
+— every dot lands on the MXU with aligned tiles, invalid (padding) rows
+are computed-then-masked, the standard TPU MoE trade.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+from triton_dist_tpu.utils import cdiv
+
+
+def grouped_gemm_ref(x, w):
+    """jnp reference: x [E, C, D] @ w [E, D, F] -> [E, C, F]."""
+    return jnp.einsum("ecd,edf->ecf", x, w,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _gg_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = jnp.dot(
+        x_ref[0], w_ref[0],
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)[None]
+
+
+def grouped_gemm(x, w, *, block_c: int = 256, block_f: int = 512):
+    """Pallas grouped GEMM. x: [E, C, D]; w: [E, D, F] -> [E, C, F].
+    Grid (E, C/bc, F/bf); weights stream through VMEM once per (expert,
+    F-tile) and are reused across C-tiles by the pallas pipeline."""
+    E, C, D = x.shape
+    F = w.shape[2]
+    bc = min(block_c, C)
+    while C % bc:
+        bc -= 1
+    bf = min(block_f, F)
+    while F % bf:
+        bf -= 1
+    grid = (E, cdiv(C, bc), cdiv(F, bf))
+    return pl.pallas_call(
+        _gg_kernel,
+        out_shape=jax.ShapeDtypeStruct((E, C, F), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bc, D), lambda e, i, j: (e, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, D, bf), lambda e, i, j: (e, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bc, bf), lambda e, i, j: (e, i, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret_mode(),
+    )(x, w)
