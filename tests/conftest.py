@@ -39,6 +39,10 @@ def pytest_configure(config):
         subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", _SHIM,
                         _SHIM_SRC], check=False)
     if not os.path.exists(_SHIM):
+        # Shim build failed: still enforce the cpu backend (the guard the
+        # module-level block applies on the no-shim path) instead of
+        # relying solely on the env vars set below.
+        _force_cpu_backend()
         return
     capman = config.pluginmanager.get_plugin("capturemanager")
     if capman is not None:
@@ -61,7 +65,7 @@ if not _real:
         os.environ.get("XLA_FLAGS", "") +
         " --xla_force_host_platform_device_count=8")
 
-if not _NEEDS_SHIM:
+def _force_cpu_backend():
     import jax
 
     if not _real:
@@ -75,6 +79,10 @@ if not _NEEDS_SHIM:
         except Exception:
             pass
         assert jax.default_backend() == "cpu", jax.default_backend()
+
+
+if not _NEEDS_SHIM:
+    _force_cpu_backend()
 
 import pytest  # noqa: E402
 

@@ -164,6 +164,10 @@ def _gemm_rs_call(a_shard, b_shard,
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
     n = ctx.n
+    if M % n:
+        raise ValueError(
+            f"gemm_rs: M={M} must be divisible by the TP size n={n}; "
+            "trailing rows would be silently dropped from the scatter")
     m_loc = M // n
     block_n = _divisor_block(N, ctx.block_n)
     kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n)

@@ -215,6 +215,11 @@ def reduce_scatter(x_partials, *, mesh: Mesh, axis: str = "tp",
         return x_partials[0]
     if collective_id is None:
         collective_id = next_collective_id()
+    if M % n:
+        raise ValueError(
+            f"reduce_scatter: M={M} must be divisible by the axis size "
+            f"n={n}; trailing rows would be silently dropped (reference "
+            "host ops assert their shape contracts the same way)")
     m_loc = M // n
     if method == ReduceScatterMethod.AUTO:
         nbytes = m_loc * cols * x_partials.dtype.itemsize

@@ -24,7 +24,9 @@ class ModelConfig:
     max_position_embeddings: int = 40960
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-6
-    tie_word_embeddings: bool = True
+    # default False to agree with the from_hf_config fallback; only the
+    # <=4B Qwen3 models tie embeddings and they pass True explicitly
+    tie_word_embeddings: bool = False
     model_type: str = "qwen3"
     # MoE (Qwen3-MoE family; 0 experts => dense)
     num_experts: int = 0
