@@ -34,3 +34,11 @@ from triton_dist_tpu.kernels.gemm_allreduce import (  # noqa: F401
     create_gemm_ar_context,
     gemm_allreduce,
 )
+from triton_dist_tpu.kernels.flash_attn import (  # noqa: F401
+    attention_cached_ref,
+    flash_decode,
+)
+from triton_dist_tpu.kernels.swiglu import (  # noqa: F401
+    swiglu,
+    swiglu_ref,
+)

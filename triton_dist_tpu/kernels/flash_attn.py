@@ -1,0 +1,191 @@
+"""Flash attention for cached decode/prefill on TPU.
+
+TPU-native re-design of the reference split-KV GQA decode kernel
+(`python/triton_dist/kernels/nvidia/flash_decode.py`: split-KV
+`kernel_gqa_fwd_batch_decode_split_kv:130`, combine `:308`). The
+reference splits KV across CTAs and combines partials with LSE; on TPU
+one core owns the whole KV, so the split-KV structure becomes a grid
+walk over KV tiles with an online-softmax accumulator in VMEM — the
+combine step degenerates into the running (m, l, acc) update. The
+inter-rank LSE combine lives in kernels/sp_flash_decode.py.
+
+Layout: queries fold (batch, kv-head) into ONE leading batch dimension
+(Mosaic supports a single batched matmul dim), giving
+    q  [B*Hkv, S*rep, d]   (rep = Hq // Hkv; GQA needs no jnp.repeat —
+    k  [B*Hkv, T, d]        the group's queries share their KV head's
+    v  [B*Hkv, T, d]        tile, reference flash_decode.py:130 does the
+                            same with tl.dot over grouped heads)
+so every QK^T is a true MXU matmul [S*rep, d] @ [d, bt] and KV is read
+exactly once per step, straight from the cache, in bf16.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+
+
+def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
+                         len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr):
+    """Grid (X/bx, T/bt); X = B*Hkv. Online softmax over KV tiles."""
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    bt = k_ref.shape[1]
+    rows = q_ref.shape[1]          # S * rep
+    kv_len = len_ref[0]
+    start = t * bt
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(start < kv_len)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [bx, rows, bt]
+        # causal mask with suffix alignment: query row r belongs to
+        # position kv_len - S + r//rep; it sees cols <= that position.
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
+        col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
+        mask = col <= (row + (kv_len - S))
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(jnp.where(mask[None], s, -1e30), -1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1)
+        vt = v_ref[...]
+        if T % bt:
+            # the trailing partial block is PADDED beyond T; the pad may
+            # be NaN (the interpreter pads with NaN deliberately) and
+            # 0 * NaN = NaN would leak through the p @ v contraction
+            tcol = jax.lax.broadcasted_iota(jnp.int32, (bt, 1), 0) + start
+            vt = jnp.where(tcol < T, vt, 0)
+        pv = jax.lax.dot_general(
+            p.astype(vt.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)          # [bx, rows, d]
+        acc_scr[...] = acc_scr[...] * alpha[..., None] + pv
+        m_scr[...] = m_new
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        o_ref[...] = (acc_scr[...]
+                      / l_scr[...][..., None]).astype(o_ref.dtype)
+
+
+def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
+             target: int, budget: int = 10 << 20) -> int:
+    """Largest divisor of X under `target` whose pipelined VMEM footprint
+    (double-buffered q/k/v/out blocks + f32 accumulators) fits."""
+    for bx in range(min(target, X), 0, -1):
+        if X % bx:
+            continue
+        blocks = 2 * bx * d * (rows * itemsize * 2 + 2 * bt * itemsize)
+        scratch = bx * rows * (8 + 4 * d)
+        if blocks + scratch <= budget:
+            return bx
+    raise ValueError(
+        f"flash_decode: no batch block fits VMEM (rows={rows}, d={d}, "
+        f"block_t={bt}); the query block alone exceeds the budget. Chunk "
+        "long prefills into shorter S segments (the engine prefill path "
+        "does), or lower block_t.")
+
+
+def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
+                 block_x: int = 64, block_t: int = 256):
+    """Cached GQA attention (decode and prefill-into-cache).
+
+    q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] (T = static cache capacity);
+    kv_len: traced scalar — number of valid KV positions INCLUDING the S
+    query positions (query s sits at kv_len - S + s). Returns
+    [B, S, Hq, d].
+
+    Reference: flash_decode.py:130 (split-KV GQA kernel) + :308
+    (combine); here split-KV partial results live in VMEM scratch and
+    combine is the online-softmax update, so nothing round-trips HBM.
+    """
+    B, S, Hq, d = q.shape
+    _, Hkv, T, _ = k.shape
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    X = B * Hkv
+    rows = S * rep
+    # queries grouped by kv head: [B, S, Hkv, rep, d] -> [X, rows, d]
+    qx = (q.reshape(B, S, Hkv, rep, d)
+           .transpose(0, 2, 1, 3, 4)
+           .reshape(X, rows, d))
+    kx = k.reshape(X, T, d)
+    vx = v.reshape(X, T, d)
+    bt = min(block_t, T)
+    bx = _pick_bx(X, rows, d, bt, jnp.dtype(q.dtype).itemsize, block_x)
+    kernel = functools.partial(_flash_decode_kernel, float(scale), rep, S, T)
+
+    # KV-tile index map clamps t to the last block containing valid keys:
+    # grid steps past kv_len re-request the same block, and the Pallas
+    # pipeline ELIDES a DMA whose block index equals the previous step's
+    # — so the tail of the static cache costs no HBM bandwidth (the
+    # static-shape analog of the reference's dynamic split-KV grid,
+    # flash_decode.py:130).
+    def kv_map(x, t, len_ref):
+        last = jnp.maximum((len_ref[0] + bt - 1) // bt - 1, 0)
+        return (x, jnp.minimum(t, last), 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(X // bx, pl.cdiv(T, bt)),
+            in_specs=[
+                pl.BlockSpec((bx, rows, d), lambda x, t, len_ref: (x, 0, 0)),
+                pl.BlockSpec((bx, bt, d), kv_map),
+                pl.BlockSpec((bx, bt, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((bx, rows, d),
+                                   lambda x, t, len_ref: (x, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bx, rows), jnp.float32),
+                pltpu.VMEM((bx, rows), jnp.float32),
+                pltpu.VMEM((bx, rows, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((X, rows, d), q.dtype),
+        interpret=interpret_mode(),
+    )(jnp.asarray(kv_len, jnp.int32).reshape(1), qx, kx, vx)
+    return (out.reshape(B, Hkv, S, rep, d)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, S, Hq, d))
+
+
+def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
+    """jnp oracle for flash_decode (same layout/contract): masked f32
+    softmax over the full static T — the role the torch attention plays
+    for the reference's differential tests."""
+    B, S, Hq, d = q.shape
+    _, Hkv, T, _ = k.shape
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(B, S, Hkv, rep, d)
+    logits = jnp.einsum("bsgrd,bgtd->bgsrt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    si = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+    ti = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    mask = ti <= (si + (kv_len - S))
+    logits = jnp.where(mask[None, None, :, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgsrt,bgtd->bsgrd", p, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, d).astype(q.dtype)

@@ -27,28 +27,37 @@ def swiglu_ref(x2):
     return jax.nn.silu(g) * u
 
 
-def _swiglu_kernel(x_ref, o_ref):
-    half = o_ref.shape[-1]
-    g = x_ref[:, :half]
-    u = x_ref[:, half:]
+def _swiglu_kernel(g_ref, u_ref, o_ref):
+    g = g_ref[...]
+    u = u_ref[...]
     o_ref[...] = (g * jax.lax.logistic(g.astype(jnp.float32)).astype(g.dtype)
                   * u)
 
 
-def swiglu(x2, *, block_m: int = 512):
-    """Pallas fused SwiGLU over rows of a 2-D [M, 2I] input."""
+def swiglu(x2, *, block_m: int = 256, block_n: int = 1024):
+    """Pallas fused SwiGLU over a 2-D [M, 2I] input packed [gate | up].
+
+    The packed operand is passed TWICE with different index maps — one
+    spec walks the gate half, the other the up half — so arbitrary M/I
+    tile without ever staging a [bm, 2I] block in VMEM."""
     M, two_i = x2.shape
     half = two_i // 2
     bm = min(block_m, M)
     while M % bm:
         bm -= 1
+    bn = min(block_n, half)
+    while half % bn:
+        bn -= 1
+    nj = half // bn
     return pl.pallas_call(
         _swiglu_kernel,
         out_shape=jax.ShapeDtypeStruct((M, half), x2.dtype),
-        grid=(M // bm,),
-        in_specs=[pl.BlockSpec((bm, two_i), lambda i: (i, 0),
+        grid=(M // bm, nj),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((bm, bn), lambda i, j, _nj=nj: (i, j + _nj),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((bm, half), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret_mode(),
-    )(x2)
+    )(x2, x2)

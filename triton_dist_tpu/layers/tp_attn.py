@@ -51,30 +51,6 @@ def causal_attention(q, k, v, scale: float):
     return out.astype(q.dtype)
 
 
-def causal_attention_batched(q, k, v, scale: float, kv_len):
-    """Batched causal GQA attention against a (possibly longer) KV buffer.
-    q: [B, S, Hq, d]; k, v: [B, T, Hkv, d] where T is the static cache
-    capacity. `kv_len` (traced scalar) is the number of valid KV
-    positions; query i sits at absolute position kv_len - S + i. Masked
-    f32 softmax over the full static T (the standard static-shape decode
-    pattern: compute over capacity, mask the tail)."""
-    B, S, Hq, d = q.shape
-    T, Hkv = k.shape[1], k.shape[2]
-    rep = Hq // Hkv
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    qi = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
-    ki = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
-    mask = ki <= (qi + (kv_len - S))
-    logits = jnp.where(mask[None, None], logits, -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
-
-
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TP_Attn:
@@ -217,24 +193,28 @@ class TP_Attn:
     # models/dense.py:101 + kv_cache.py:29)
     # ------------------------------------------------------------------
 
-    def _attend_cached(self, qkv, cos, sin, batch: int, ck, cv, kv_start):
+    def _attend_cached(self, qkv, cos, sin, batch: int, ck, cv, kv_start,
+                       impl: str = "flash"):
         """Split a rank's packed [q|k|v] slice, write K/V into this rank's
         cache shard at kv_start, attend against the cache.
 
         qkv: [B*S, qkv_cols] sharded P(None, tp);
-        ck/cv: [B, T, Hkv, hd] sharded on the head axis;
-        kv_start: traced scalar (0 for prefill, pos for decode).
+        ck/cv: [B, Hkv, T, hd] sharded on the head axis;
+        kv_start: traced scalar (0 for prefill, pos for decode);
+        impl: "flash" (Pallas flash-decode kernel) or "ref" (jnp oracle).
         Returns (o [B*S, hq_loc*hd] P(None, tp), updated ck, cv).
         """
+        from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
+                                                        flash_decode)
         hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
         scale = hd ** -0.5
 
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
-            in_specs=(P(None, self.axis), P(None, None, self.axis, None),
-                      P(None, None, self.axis, None), P()),
-            out_specs=(P(None, self.axis), P(None, None, self.axis, None),
-                       P(None, None, self.axis, None)),
+            in_specs=(P(None, self.axis), P(None, self.axis, None, None),
+                      P(None, self.axis, None, None), P()),
+            out_specs=(P(None, self.axis), P(None, self.axis, None, None),
+                       P(None, self.axis, None, None)),
             check_vma=False)
         def f(qkv_loc, ck_loc, cv_loc, kv_start):
             M = qkv_loc.shape[0]
@@ -250,13 +230,16 @@ class TP_Attn:
             # apply_rope expects [..., S, H, d]
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
+            # cache layout is head-major [B, Hkv, T, hd]
             ck_loc = jax.lax.dynamic_update_slice(
-                ck_loc, k.astype(ck_loc.dtype), (0, kv_start, 0, 0))
+                ck_loc, k.transpose(0, 2, 1, 3).astype(ck_loc.dtype),
+                (0, 0, kv_start, 0))
             cv_loc = jax.lax.dynamic_update_slice(
-                cv_loc, v.astype(cv_loc.dtype), (0, kv_start, 0, 0))
-            o = causal_attention_batched(q, ck_loc.astype(q.dtype),
-                                         cv_loc.astype(q.dtype), scale,
-                                         kv_start + S)
+                cv_loc, v.transpose(0, 2, 1, 3).astype(cv_loc.dtype),
+                (0, 0, kv_start, 0))
+            attend = flash_decode if impl == "flash" else attention_cached_ref
+            o = attend(q, ck_loc.astype(q.dtype), cv_loc.astype(q.dtype),
+                       kv_start + S, scale=scale)
             return o.reshape(M, hq * hd), ck_loc, cv_loc
 
         return f(qkv, ck, cv, jnp.asarray(kv_start, jnp.int32))
@@ -265,8 +248,14 @@ class TP_Attn:
                    mode: str = "dist"):
         """Full attention block with KV cache: QKV proj -> cached attend
         -> O proj, per forward mode. x: [B*S, D] (row-sharded for "dist",
-        replicated otherwise). Returns (y, ck, cv)."""
+        replicated otherwise). Returns (y, ck, cv).
+
+        Modes: "xla" (jnp oracle attention + psum), "flash" (Pallas
+        flash-decode attention + psum — the single-chip framework path),
+        "dist"/"ar"/"gemm_ar" (overlapped comm kernels + flash-decode).
+        """
         axis = self.axis
+        impl = "ref" if mode == "xla" else "flash"
         if mode == "dist":
             ag_ctx = create_ag_gemm_context(self.mesh, axis)
             qkv = ag_gemm(x, self.w_qkv, ag_ctx)
@@ -280,7 +269,7 @@ class TP_Attn:
             qkv = qkv_local(x, self.w_qkv)
 
         o, ck, cv = self._attend_cached(qkv, cos, sin, batch, ck, cv,
-                                        kv_start)
+                                        kv_start, impl)
 
         if mode == "dist":
             rs_ctx = create_gemm_rs_context(self.mesh, axis)
@@ -297,7 +286,7 @@ class TP_Attn:
                 return (o_loc @ wo_loc)[None]
 
             y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
-        else:  # "xla" oracle
+        else:  # "xla" oracle and "flash": psum epilogue
             @functools.partial(jax.shard_map, mesh=self.mesh,
                                in_specs=(P(None, axis), P(axis, None)),
                                out_specs=P(None, None), check_vma=False)

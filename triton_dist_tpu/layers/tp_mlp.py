@@ -137,7 +137,25 @@ class TP_MLP:
         ctx = create_gemm_ar_context(self.mesh, axis)
         return gemm_allreduce(h, self.w_down, ctx)  # [M, D] replicated
 
+    def fwd_flash(self, x):
+        """Single-chip framework path: local GEMMs with the fused Pallas
+        SwiGLU kernel between them + psum epilogue (the mode the 1-chip
+        bench runs; comm degenerates, the kernels don't)."""
+        from triton_dist_tpu.kernels.swiglu import swiglu as swiglu_pallas
+        axis = self.axis
+
+        import functools
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None), P(None, axis),
+                                     P(axis, None)),
+                           out_specs=P(None, None), check_vma=False)
+        def f(x_r, wgu_loc, wd_loc):
+            h = swiglu_pallas(x_r @ wgu_loc)
+            return jax.lax.psum(h @ wd_loc, axis)
+
+        return f(x, self.w_gate_up, self.w_down)
+
     def __call__(self, x, mode: str = "dist"):
         """Mode switch (reference: DenseLLM set_fwd, models/dense.py:84)."""
         return dict(xla=self.fwd_xla, dist=self.fwd_dist, ar=self.fwd_ar,
-                    gemm_ar=self.fwd_gemm_ar)[mode](x)
+                    gemm_ar=self.fwd_gemm_ar, flash=self.fwd_flash)[mode](x)

@@ -182,7 +182,10 @@ class DenseLLM:
 
             x = gather_rows(x)
         last = x.reshape(B, S, -1)[:, -1]
-        logits = last.astype(jnp.float32) @ self.lm_head.astype(jnp.float32)
+        # bf16 x bf16 -> f32 on the MXU; casting the [D, V] weight to f32
+        # would materialize (and re-read) gigabytes per decode step
+        logits = jnp.dot(last, self.lm_head,
+                         preferred_element_type=jnp.float32)
         return logits, cache
 
     def make_cache(self, batch: int, max_seq: int,

@@ -9,6 +9,8 @@ program for the whole generation, zero per-step host round-trips
 
 Backends (reference backend strings engine.py:126-135):
   "xla"     <- torch            (oracle)
+  "flash"   <- single-chip framework path: Pallas flash-decode +
+               fused SwiGLU kernels, no comm kernels
   "dist"    <- triton_dist      (AG-GEMM / GEMM-RS)
   "ar"      <- triton_dist_AR   (partial GEMMs + AR kernel)
   "gemm_ar" <- triton_dist_gemm_ar (fused GEMM+AR)
@@ -34,7 +36,7 @@ class Engine:
         # the reference prefills with the torch fwd (engine.py:121); the
         # analog here is the XLA-collective mode unless overridden
         self.prefill_backend = prefill_backend or (
-            "dist" if backend == "dist" else "xla")
+            backend if backend in ("dist", "flash") else "xla")
         # The model is a jit ARGUMENT (weights must not be captured as
         # program constants — that would bake GBs into the executable)
         self._prefill = jax.jit(functools.partial(
@@ -43,17 +45,27 @@ class Engine:
             functools.partial(_scan_decode_fn, backend),
             static_argnames=("gen_len",), donate_argnums=(2,))
 
+    def prefill(self, input_ids):
+        """Run the prefill pass on a fresh cache; returns (logits, cache)."""
+        input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
+        cache = self.model.make_cache(input_ids.shape[0], self.max_seq)
+        return self._prefill(self.model, input_ids, cache)
+
+    def decode(self, logits, cache, gen_len: int):
+        """Greedy decode from prefill state: one jitted lax.scan over
+        gen_len steps with a donated cache. Returns tokens [B, gen_len].
+        The benchmark times this call alone — it is the reference's
+        measured decode loop (engine.py:166)."""
+        toks, _, _ = self._decode_scan(self.model, logits, cache,
+                                       gen_len=gen_len)
+        return toks
+
     def serve(self, input_ids, gen_len: int):
         """Generate greedily (reference: Engine.serve, engine.py:113).
         input_ids: [B, S] int32. Returns generated tokens [B, gen_len].
         """
-        input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
-        B = input_ids.shape[0]
-        cache = self.model.make_cache(B, self.max_seq)
-        logits, cache = self._prefill(self.model, input_ids, cache)
-        toks, _, _ = self._decode_scan(self.model, logits, cache,
-                                       gen_len=gen_len)
-        return toks
+        logits, cache = self.prefill(input_ids)
+        return self.decode(logits, cache, gen_len)
 
 
 def _prefill_fn(model, ids, cache, *, mode):

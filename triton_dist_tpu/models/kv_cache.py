@@ -1,16 +1,26 @@
 """KV cache (reference: `python/triton_dist/models/kv_cache.py`
 `KV_Cache:29` — contiguous per-layer K/V buffers + a shared offset).
 
-TPU re-design: one stacked pair of arrays [L, B, T, Hkv, hd] sharded on
-the KV-head axis over TP (each rank caches only its heads — same memory
+TPU re-design: per-layer pairs of arrays [B, Hkv, T, hd] sharded on the
+KV-head axis over TP (each rank caches only its heads — same memory
 split as the reference's per-rank cache), updated functionally
 (`jax.lax.dynamic_update_slice`) so the decode step can donate the cache
 and XLA updates it in place.
+
+Two deliberate layout choices:
+- per-layer tuple (NOT one stacked [L, ...] array): a stacked array
+  would make every layer update an update-slice on the whole multi-GB
+  buffer and every kernel read a materialized slice copy; separate
+  buffers update in place under donation and feed Pallas directly.
+- head-major [Hkv, T, hd]: each head's KV is contiguous, which is the
+  read order of the flash-decode kernel (kernels/flash_attn.py) — no
+  transpose on the hot path.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,28 +30,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    k: jax.Array   # [L, B, T, Hkv, hd]
-    v: jax.Array
+    k: Tuple[jax.Array, ...]   # L x [B, Hkv, T, hd]
+    v: Tuple[jax.Array, ...]
     offset: jax.Array  # scalar int32: number of valid positions
 
     @staticmethod
     def create(num_layers: int, batch: int, max_seq: int, n_kv_heads: int,
                head_dim: int, *, mesh: Mesh, axis: str = "tp",
                dtype=jnp.bfloat16) -> "KVCache":
-        shape = (num_layers, batch, max_seq, n_kv_heads, head_dim)
-        sharding = NamedSharding(mesh, P(None, None, None, axis, None))
-        z = jax.device_put(jnp.zeros(shape, dtype), sharding)
-        return KVCache(k=z, v=jax.device_put(jnp.zeros(shape, dtype),
-                                             sharding),
-                       offset=jnp.int32(0))
+        shape = (batch, n_kv_heads, max_seq, head_dim)
+        sharding = NamedSharding(mesh, P(None, axis, None, None))
+        k = tuple(jax.device_put(jnp.zeros(shape, dtype), sharding)
+                  for _ in range(num_layers))
+        v = tuple(jax.device_put(jnp.zeros(shape, dtype), sharding)
+                  for _ in range(num_layers))
+        return KVCache(k=k, v=v, offset=jnp.int32(0))
 
     def layer(self, idx: int):
-        """Per-layer views passed into TP_Attn.fwd_cached."""
+        """Per-layer buffers passed into TP_Attn.fwd_cached."""
         return self.k[idx], self.v[idx]
 
     def set_layer(self, idx: int, ck, cv) -> "KVCache":
         return dataclasses.replace(
-            self, k=self.k.at[idx].set(ck), v=self.v.at[idx].set(cv))
+            self,
+            k=self.k[:idx] + (ck,) + self.k[idx + 1:],
+            v=self.v[:idx] + (cv,) + self.v[idx + 1:])
 
     def advance(self, n) -> "KVCache":
         return dataclasses.replace(self, offset=self.offset + n)
