@@ -1,0 +1,78 @@
+"""Differential tests for EP dispatch/combine (reference analog:
+test/nvidia/test_ep_a2a.py — routed a2a vs a dense torch MoE oracle)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.kernels.ep_a2a import (create_ep_a2a_context,
+                                            ep_dispatch_combine, moe_oracle,
+                                            plan_dispatch, route)
+
+
+def test_route_topk_normalized():
+    logits = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    w, idx = route(logits, 2)
+    assert idx.shape == (16, 2)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-6)
+    # top-1 must be the argmax expert
+    np.testing.assert_array_equal(np.asarray(idx[:, 0]),
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_plan_dispatch_capacity_drop():
+    # 6 tokens, k=1, all to expert 0 on device 0, cap=4 -> 2 dropped
+    idx = jnp.zeros((6, 1), jnp.int32)
+    plan = plan_dispatch(idx, n=2, experts_per_rank=2, cap=4)
+    assert int(plan.valid.sum()) == 4
+    slots = np.asarray(plan.slot[np.asarray(plan.valid)])
+    assert sorted(slots.tolist()) == [0, 1, 2, 3]
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_ep_dispatch_combine_vs_oracle(ctx8, k):
+    """Identity experts scaled per-expert: exercises routing, slotting,
+    the dispatch/combine Pallas a2a, and the weighted reduce."""
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E = 2 * n
+    T, D = 8 * n, 32
+    epr = E // n
+    rng = np.random.RandomState(k)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = jnp.asarray(rng.randn(T, E), jnp.float32)
+    ctx = create_ep_a2a_context(mesh, "tp", num_experts=E,
+                                capacity=T * k)  # generous: no drops
+
+    def expert_fn(x_e):
+        # scale by global expert id + 1 (device-aware inside shard_map)
+        dev = jax.lax.axis_index("tp")
+        scale = (dev * epr + jnp.arange(epr) + 1).astype(x_e.dtype)
+        return x_e * scale[:, None, None]
+
+    def expert_fn_dense(x_full):
+        scale = jnp.arange(1, E + 1, dtype=x_full.dtype)
+        return x_full[None] * scale[:, None, None]   # [E, T, D]
+
+    y = ep_dispatch_combine(x, logits, k, ctx, expert_fn=expert_fn)
+    ref = moe_oracle(x, logits, k, expert_fn_dense)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ep_dispatch_combine_identity(ctx8):
+    """With identity experts and normalized top-k weights, combine must
+    reproduce the input exactly (round-trip property)."""
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, T, D = 2 * n, 4 * n, 16
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = jnp.asarray(rng.randn(T, E), jnp.float32)
+    ctx = create_ep_a2a_context(mesh, "tp", num_experts=E, capacity=2 * T)
+    y = ep_dispatch_combine(x, logits, 2, ctx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                               atol=1e-5, rtol=1e-5)

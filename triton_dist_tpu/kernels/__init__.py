@@ -38,6 +38,13 @@ from triton_dist_tpu.kernels.flash_attn import (  # noqa: F401
     attention_cached_ref,
     flash_decode,
 )
+from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
+    all_to_all,
+)
+from triton_dist_tpu.kernels.group_gemm import (  # noqa: F401
+    grouped_gemm,
+    grouped_gemm_ref,
+)
 from triton_dist_tpu.kernels.swiglu import (  # noqa: F401
     swiglu,
     swiglu_ref,

@@ -142,8 +142,8 @@ def all_gather(x, *, mesh: Mesh, axis: str = "tp",
         collective_id = next_collective_id()
     shard_rows = x.shape[0] // n
     if method == AllGatherMethod.AUTO:
-        nbytes = shard_rows * int(jnp.prod(jnp.array(x.shape[1:]))) \
-            * x.dtype.itemsize if x.ndim > 1 else shard_rows * x.dtype.itemsize
+        import math
+        nbytes = shard_rows * math.prod(x.shape[1:]) * x.dtype.itemsize
         method = get_auto_all_gather_method(int(nbytes), n)
 
     other = tuple(a for a in mesh.axis_names if a != axis)

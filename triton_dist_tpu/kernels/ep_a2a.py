@@ -1,0 +1,346 @@
+"""EP AllToAll: routed MoE token dispatch/combine over ICI.
+
+TPU-native re-design of the reference EP kernels
+(`python/triton_dist/kernels/nvidia/ep_a2a.py`: `kernel_dispatch_token:79`
+per-expert putmem_nbi + signal, `kernel_combine_token:214` reverse put +
+topk-weighted reduce, splits/offset exchange
+`kernel_get_ag_splits_and_recv_offset:382`; intra-node variant
+`ep_a2a_intra_node.py:39`; low-latency variants
+`low_latency_all_to_all.py:198`, `low_latency_all_to_all_v2.py:156`).
+
+Design differences forced (and enabled) by TPU/XLA:
+
+- **No splits exchange.** The reference exchanges per-expert token counts
+  first so receivers can compute exact recv offsets for dynamically-sized
+  putmem. XLA needs static shapes, so dispatch is CAPACITY-based: every
+  (src, dst) pair owns a fixed [cap, D] slot range in the recv buffer and
+  a put always transfers the full slot (invalid rows are masked by the
+  `valid` metadata instead of not being sent). The offsets kernel
+  (ep_a2a.py:382) therefore has no analog — its job is done by the
+  static layout.
+- **Routing/planning is XLA, not a CUDA kernel.** Token->slot planning
+  (sort by destination, capacity clamp) is the role of
+  `moe_ag_scatter_align_block_size` (csrc/lib/moe_utils.cu:61); on TPU
+  argsort/cumsum/scatter are efficient XLA ops and fuse with the
+  surrounding math, so `plan_dispatch` is jnp. The Pallas kernel does
+  what only a kernel can do: one-sided puts with semaphore signaling.
+- **One slot set, no call_count double-buffering.** The reference's
+  double-buffered signal slots (call_count%2, README.md:101-186) exist
+  because NVSHMEM symmetric buffers persist across calls; XLA allocates
+  fresh kernel buffers per call, so one set suffices.
+
+Everything here is DEVICE-LOCAL (called inside shard_map over the ep
+axis); `ep_all_to_all` is the host-level wrapper used by tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+@dataclasses.dataclass
+class EPAll2AllContext:
+    """Per-op context (reference: the symmetric token buffers + signal
+    arrays created per EP group, ep_a2a.py:881). Static config only —
+    the buffers are the kernels' own allocations."""
+
+    mesh: Mesh
+    axis: str
+    n: int
+    num_experts: int
+    experts_per_rank: int
+    capacity: int          # max tokens per (src, dst) device pair
+    collective_id: int
+
+
+def create_ep_a2a_context(mesh: Mesh, axis: str = "ep", *,
+                          num_experts: int, capacity: int,
+                          collective_id: Optional[int] = None,
+                          ) -> EPAll2AllContext:
+    n = mesh.shape[axis]
+    assert num_experts % n == 0, (num_experts, n)
+    return EPAll2AllContext(
+        mesh=mesh, axis=axis, n=n, num_experts=num_experts,
+        experts_per_rank=num_experts // n, capacity=capacity,
+        collective_id=(collective_id if collective_id is not None
+                       else next_collective_id()))
+
+
+# ----------------------------------------------------------------------
+# routing + planning (XLA; csrc/moe_utils.cu analog)
+# ----------------------------------------------------------------------
+
+def route(router_logits, k: int, *, norm_topk: bool = True):
+    """Softmax -> top-k -> (optionally) renormalize (Qwen3-MoE routing,
+    reference models/qwen_moe.py). Returns (weights [T, k] f32,
+    expert_idx [T, k] int32)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    w, idx = jax.lax.top_k(probs, k)
+    if norm_topk:
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return w, idx.astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class DispatchPlan:
+    """Source-side record of where each (token, k) entry was placed, so
+    combine can gather the returned results (the role of the reference's
+    send-req index builders, ep_a2a.py:604-765)."""
+    slot: jax.Array     # [T*k] slot in the [n*cap] send layout (or n*cap)
+    valid: jax.Array    # [T*k] bool — False = dropped by capacity
+    token: jax.Array    # [T*k] source token row
+
+
+def plan_dispatch(topk_idx, n: int, experts_per_rank: int, cap: int
+                  ) -> DispatchPlan:
+    """Assign each routed (token, k) entry a slot in the per-destination
+    capacity layout. Entries beyond a destination's capacity are dropped
+    (their combine weight contribution becomes 0)."""
+    T, k = topk_idx.shape
+    flat_e = topk_idx.reshape(-1)
+    dest = flat_e // experts_per_rank                       # [T*k]
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    # position of each sorted entry within its destination group
+    start = jnp.searchsorted(sorted_dest, jnp.arange(n), side="left")
+    pos = jnp.arange(T * k) - start[sorted_dest]
+    valid_sorted = pos < cap
+    slot_sorted = jnp.where(valid_sorted,
+                            sorted_dest * cap + jnp.minimum(pos, cap - 1),
+                            n * cap)
+    # back to entry order
+    inv = jnp.argsort(order, stable=True)
+    slot = slot_sorted[inv]
+    valid = valid_sorted[inv]
+    token = jnp.arange(T * k) // k
+    return DispatchPlan(slot=slot, valid=valid, token=token)
+
+
+def fill_send_buffers(x, topk_idx, plan: DispatchPlan, n: int,
+                      experts_per_rank: int, cap: int):
+    """Scatter tokens (+ metadata) into the [n*cap] send layout.
+    Returns (send_x [n*cap, D], send_meta [n*cap, 2] int32) where
+    meta[:, 0] = local expert id on the destination, meta[:, 1] = valid."""
+    T, k = topk_idx.shape
+    D = x.shape[1]
+    dtype = x.dtype
+    local_e = (topk_idx.reshape(-1) % experts_per_rank).astype(jnp.int32)
+    send_x = jnp.zeros((n * cap + 1, D), dtype).at[plan.slot].set(
+        x[plan.token], mode="drop")[:-1]
+    meta = jnp.stack([local_e, plan.valid.astype(jnp.int32)], axis=-1)
+    send_meta = jnp.zeros((n * cap + 1, 2), jnp.int32).at[plan.slot].set(
+        meta, mode="drop")[:-1]
+    return send_x, send_meta
+
+
+def group_by_expert(recv_x, recv_meta, experts_per_rank: int,
+                    expert_cap: int):
+    """Arrange received tokens into capacity-padded per-expert batches
+    for the grouped GEMM. Returns (x_e [E_loc, expert_cap, D],
+    inv_slot [n*cap] — where each recv slot's result lives in the
+    flattened [E_loc*expert_cap] expert layout, n*cap.. = dropped)."""
+    R, D = recv_x.shape
+    e = jnp.where(recv_meta[:, 1] > 0, recv_meta[:, 0], experts_per_rank)
+    order = jnp.argsort(e, stable=True)
+    sorted_e = e[order]
+    start = jnp.searchsorted(sorted_e, jnp.arange(experts_per_rank),
+                             side="left")
+    pos = jnp.arange(R) - start[jnp.minimum(sorted_e, experts_per_rank - 1)]
+    ok = (sorted_e < experts_per_rank) & (pos < expert_cap)
+    eslot_sorted = jnp.where(
+        ok, sorted_e * expert_cap + jnp.minimum(pos, expert_cap - 1),
+        experts_per_rank * expert_cap)
+    x_e = jnp.zeros((experts_per_rank * expert_cap + 1, D),
+                    recv_x.dtype).at[eslot_sorted].set(
+        recv_x[order], mode="drop")[:-1].reshape(
+            experts_per_rank, expert_cap, D)
+    inv = jnp.argsort(order, stable=True)
+    inv_slot = eslot_sorted[inv]
+    return x_e, inv_slot
+
+
+def group_tokens_by_expert(x, topk_idx, num_experts: int, cap: int):
+    """LOCAL grouping (no a2a): arrange each routed (token, k) entry into
+    capacity-padded per-expert batches — the TP-MoE front half (reference:
+    sort_topk_ids_align_block_size, allgather_group_gemm.py:201, backed by
+    csrc/lib/moe_utils.cu:61). Returns (x_e [E, cap, D], inv_slot [T*k],
+    token [T*k]) where inv_slot locates each entry's row in the flattened
+    [E*cap] expert layout (E*cap = dropped by capacity)."""
+    T, k = topk_idx.shape
+    flat_e = topk_idx.reshape(-1)
+    token = jnp.arange(T * k) // k
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    start = jnp.searchsorted(sorted_e, jnp.arange(num_experts), side="left")
+    pos = jnp.arange(T * k) - start[sorted_e]
+    ok = pos < cap
+    eslot_sorted = jnp.where(ok, sorted_e * cap + jnp.minimum(pos, cap - 1),
+                             num_experts * cap)
+    x_e = jnp.zeros((num_experts * cap + 1, x.shape[1]), x.dtype
+                    ).at[eslot_sorted].set(
+        x[token[order]], mode="drop")[:-1].reshape(num_experts, cap, -1)
+    inv = jnp.argsort(order, stable=True)
+    return x_e, eslot_sorted[inv], token
+
+
+def scatter_weighted(y_e, inv_slot, token, topk_w, T: int):
+    """Topk-weighted combine of LOCAL expert outputs back to token order
+    (the weighted reduce of moe_reduce_rs's consumer, reference
+    moe_reduce_rs.py:168). y_e: [E, cap, D] -> [T, D] f32."""
+    E, cap, D = y_e.shape
+    y_flat = y_e.reshape(E * cap, D)
+    w = jnp.where(inv_slot < E * cap, topk_w.reshape(-1), 0.0)
+    contrib = jnp.take(y_flat, jnp.minimum(inv_slot, E * cap - 1), axis=0)
+    contrib = contrib.astype(jnp.float32) * w[:, None]
+    return jax.ops.segment_sum(contrib, token, num_segments=T)
+
+
+def combine_from_slots(y_back, plan: DispatchPlan, topk_w, T: int):
+    """Weighted sum of each token's returned expert outputs (reference:
+    the topk-weighted reduce inside kernel_combine_token, ep_a2a.py:214).
+    y_back: [n*cap, D]; returns [T, D] f32."""
+    D = y_back.shape[1]
+    w = jnp.where(plan.valid, topk_w.reshape(-1), 0.0)
+    contrib = y_back[jnp.minimum(plan.slot, y_back.shape[0] - 1)]
+    contrib = contrib.astype(jnp.float32) * w[:, None]
+    return jax.ops.segment_sum(contrib, plan.token, num_segments=T)
+
+
+# ----------------------------------------------------------------------
+# Pallas a2a kernels (the one-sided data plane)
+# ----------------------------------------------------------------------
+
+def _a2a_payload_kernel(n: int, axis: str, x_ref, m_ref, ox_ref, om_ref,
+                        send_sem, recv_x_sem, recv_m_sem):
+    """Dispatch a2a carrying payload + metadata in one kernel (ref:
+    kernel_dispatch_token, ep_a2a.py:79 — putmem_nbi of data then
+    putmem_signal of scale/meta). Chunk p of the send layout goes to
+    device p's chunk `me`."""
+    me = dl.my_pe(axis)
+    C = x_ref.shape[0] // n
+    Cm = m_ref.shape[0] // n
+    dl.barrier_all(axis)
+    for p in range(n):
+        dl.putmem_nbi(ox_ref.at[pl.ds(me * C, C)],
+                      x_ref.at[pl.ds(p * C, C)],
+                      send_sem, recv_x_sem, jnp.int32(p), axis)
+        dl.putmem_nbi(om_ref.at[pl.ds(me * Cm, Cm)],
+                      m_ref.at[pl.ds(p * Cm, Cm)],
+                      send_sem, recv_m_sem, jnp.int32(p), axis)
+    for _ in range(n):
+        pltpu.make_async_copy(x_ref.at[pl.ds(0, C)],
+                              x_ref.at[pl.ds(0, C)], recv_x_sem).wait()
+        pltpu.make_async_copy(m_ref.at[pl.ds(0, Cm)],
+                              m_ref.at[pl.ds(0, Cm)], recv_m_sem).wait()
+    dl.quiet(send_sem, x_ref.at[pl.ds(0, C)], n)
+    dl.quiet(send_sem, m_ref.at[pl.ds(0, Cm)], n)
+
+
+def dispatch_a2a(send_x, send_meta, *, n: int, axis: str,
+                 collective_id: int):
+    """Device-local (inside shard_map): exchange send buffers so device d
+    ends with every peer's chunk destined for it. [n*cap, D] -> same."""
+    if n == 1:
+        return send_x, send_meta
+    R, D = send_x.shape
+    Rm, M = send_meta.shape
+    kernel = functools.partial(_a2a_payload_kernel, n, axis)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((R, D), send_x.dtype),
+                   jax.ShapeDtypeStruct((Rm, M), send_meta.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=shmem_compiler_params(collective_id),
+        interpret=interpret_mode(),
+    )(send_x, send_meta)
+
+
+def combine_a2a(y_slots, *, n: int, axis: str, collective_id: int):
+    """Device-local reverse a2a: return expert outputs to the token
+    owners (ref: kernel_combine_token's put phase, ep_a2a.py:214).
+    Delegates to the one-shot a2a kernel (kernels/all_to_all.py) — the
+    combine traffic pattern IS an all-to-all of the slot layout."""
+    if n == 1:
+        return y_slots
+    from triton_dist_tpu.kernels.all_to_all import _a2a_pallas
+    return _a2a_pallas(y_slots, n=n, axis=axis, collective_id=collective_id)
+
+
+# ----------------------------------------------------------------------
+# host-level wrapper (test surface; the EP layer calls the device-local
+# pieces inside its own shard_map)
+# ----------------------------------------------------------------------
+
+def ep_dispatch_combine(x, router_logits, k: int,
+                        ctx: EPAll2AllContext,
+                        expert_fn=None, expert_cap: Optional[int] = None):
+    """Full routed dispatch -> (expert_fn on grouped tokens) -> combine.
+
+    x: [T, D] sharded P(axis, None); router_logits: [T, E] sharded the
+    same. expert_fn(x_e [E_loc, C_e, D]) -> same leading shape, applied
+    to the capacity-grouped tokens on their owner device
+    (identity if None). Returns y [T, D] (same sharding as x): the
+    topk-weighted combination of expert outputs — differentially
+    testable against a dense jnp MoE oracle.
+    """
+    n, axis, epr, cap = ctx.n, ctx.axis, ctx.experts_per_rank, ctx.capacity
+    e_cap = expert_cap or n * cap
+    cid = ctx.collective_id
+
+    @functools.partial(
+        jax.shard_map, mesh=ctx.mesh,
+        in_specs=(P(axis, None), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False)
+    def _f(x_loc, logits_loc):
+        T = x_loc.shape[0]
+        topk_w, topk_idx = route(logits_loc, k)
+        plan = plan_dispatch(topk_idx, n, epr, cap)
+        send_x, send_meta = fill_send_buffers(x_loc, topk_idx, plan, n,
+                                              epr, cap)
+        recv_x, recv_meta = dispatch_a2a(send_x, send_meta, n=n, axis=axis,
+                                         collective_id=cid)
+        x_e, inv_slot = group_by_expert(recv_x, recv_meta, epr, e_cap)
+        if expert_fn is not None:
+            x_e = expert_fn(x_e)
+        y_flat = x_e.reshape(epr * e_cap, -1)
+        gathered = jnp.take(y_flat, jnp.minimum(inv_slot, epr * e_cap - 1),
+                            axis=0)
+        y_slots = gathered * (inv_slot < epr * e_cap)[:, None].astype(
+            gathered.dtype)
+        y_back = combine_a2a(y_slots, n=n, axis=axis, collective_id=cid)
+        y = combine_from_slots(y_back, plan, topk_w, T)
+        return y.astype(x_loc.dtype)
+
+    return _f(x, router_logits)
+
+
+def moe_oracle(x, router_logits, k: int, expert_fn_dense):
+    """Dense jnp MoE reference: every token through every expert,
+    topk-weighted sum (the torch oracle role from test_ep_a2a.py)."""
+    T, D = x.shape
+    topk_w, topk_idx = route(router_logits, k)
+    y_all = expert_fn_dense(x)          # [E, T, D]
+    E = y_all.shape[0]
+    onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, k, E]
+    w_e = jnp.einsum("tk,tke->te", topk_w, onehot)           # [T, E]
+    y = jnp.einsum("te,etd->td", w_e, y_all.astype(jnp.float32))
+    return y.astype(x.dtype)

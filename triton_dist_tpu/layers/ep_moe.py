@@ -1,0 +1,159 @@
+"""Expert-parallel MoE layer: experts sharded across devices, tokens
+routed to their experts' owners over ICI.
+
+TPU-native re-design of the reference EP layers
+(`python/triton_dist/layers/nvidia/ep_a2a_layer.py` `EpAll2AllOp`,
+fused variant `ep_a2a_fused_layer.py`, low-latency inference variant
+`ep_ll_a2a_layer.py`; training wrapper
+`function/nvidia/ep_moe_fused.py:42`).
+
+Forward = dispatch (one-sided a2a puts) -> grouped GEMM on each expert
+owner -> combine (reverse puts + topk-weighted reduce), all inside ONE
+shard_map over the ep axis — the shard_map body is the per-rank program
+the reference writes per-GPU, with the Pallas a2a kernels as the data
+plane (kernels/ep_a2a.py documents the capacity-based redesign of the
+splits exchange)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.ep_a2a import (combine_a2a, combine_from_slots,
+                                            dispatch_a2a, fill_send_buffers,
+                                            group_by_expert, plan_dispatch,
+                                            route)
+from triton_dist_tpu.kernels.group_gemm import grouped_gemm
+from triton_dist_tpu.kernels.swiglu import swiglu_ref
+from triton_dist_tpu.runtime import next_collective_id
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class EP_MoE:
+    """Router + expert-sharded SwiGLU MLPs.
+
+    w_router:  [D, E] replicated.
+    w_gate_up: [E, D, 2I] sharded P(ep, None, None) — E/n experts per
+               device, full intermediate (packed [gate | up]).
+    w_down:    [E, I, D] sharded P(ep, None, None).
+    """
+
+    w_router: jax.Array
+    w_gate_up: jax.Array
+    w_down: jax.Array
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    top_k: int = dataclasses.field(metadata=dict(static=True))
+    capacity_factor: float = dataclasses.field(
+        default=2.0, metadata=dict(static=True))
+
+    @staticmethod
+    def init(w_router, w_gate, w_up, w_down, *, mesh: Mesh,
+             axis: str = "tp", top_k: int,
+             capacity_factor: float = 2.0) -> "EP_MoE":
+        packed = jnp.concatenate([jnp.asarray(w_gate), jnp.asarray(w_up)],
+                                 axis=-1)               # [E, D, 2I]
+        packed = jax.device_put(packed,
+                                NamedSharding(mesh, P(axis, None, None)))
+        w_down = jax.device_put(jnp.asarray(w_down),
+                                NamedSharding(mesh, P(axis, None, None)))
+        return EP_MoE(w_router=jnp.asarray(w_router), w_gate_up=packed,
+                      w_down=w_down, mesh=mesh, axis=axis, top_k=top_k,
+                      capacity_factor=capacity_factor)
+
+    @property
+    def num_experts(self) -> int:
+        return self.w_router.shape[1]
+
+    def _caps(self, t_loc: int):
+        """(pair capacity, per-expert capacity): static shapes standing in
+        for the reference's splits exchange."""
+        n = self.mesh.shape[self.axis]
+        epr = self.num_experts // n
+        pair = int(self.capacity_factor * self.top_k * t_loc / n) + 1
+        pair = min(max(8, -(-pair // 8) * 8), t_loc * self.top_k)
+        e_cap = int(self.capacity_factor * n * pair / epr) + 1
+        e_cap = min(max(8, -(-e_cap // 8) * 8), n * pair)
+        return pair, e_cap
+
+    def fwd_ep(self, x):
+        """x: [T, D] row-sharded over the ep axis -> same sharding."""
+        n = self.mesh.shape[self.axis]
+        axis = self.axis
+        epr = self.num_experts // n
+        k = self.top_k
+        T = x.shape[0]
+        cap, e_cap = self._caps(T // n)
+        cid = next_collective_id()
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(axis, None), P(None, None),
+                      P(axis, None, None), P(axis, None, None)),
+            out_specs=P(axis, None), check_vma=False)
+        def _f(x_loc, router, wgu_loc, wd_loc):
+            t_loc = x_loc.shape[0]
+            topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
+            plan = plan_dispatch(topk_idx, n, epr, cap)
+            send_x, send_meta = fill_send_buffers(x_loc, topk_idx, plan,
+                                                  n, epr, cap)
+            recv_x, recv_meta = dispatch_a2a(send_x, send_meta, n=n,
+                                             axis=axis, collective_id=cid)
+            x_e, inv_slot = group_by_expert(recv_x, recv_meta, epr, e_cap)
+            h = grouped_gemm(x_e, wgu_loc.astype(x_e.dtype))
+            h = swiglu_ref(h)
+            y_e = grouped_gemm(h, wd_loc.astype(x_e.dtype))
+            y_flat = y_e.reshape(epr * e_cap, -1)
+            gathered = jnp.take(y_flat,
+                                jnp.minimum(inv_slot, epr * e_cap - 1),
+                                axis=0)
+            y_slots = gathered * (inv_slot < epr * e_cap)[:, None].astype(
+                gathered.dtype)
+            y_back = combine_a2a(y_slots, n=n, axis=axis,
+                                 collective_id=cid)
+            y = combine_from_slots(y_back, plan, topk_w, t_loc)
+            return y.astype(x_loc.dtype)
+
+        return _f(x, self.w_router, self.w_gate_up, self.w_down)
+
+    def fwd_xla(self, x):
+        """Oracle (x row-sharded): dense all-experts math with XLA
+        collectives — all_gather tokens, each device computes its experts
+        densely, psum the weighted sum, slice back."""
+        axis = self.axis
+        n = self.mesh.shape[axis]
+        epr = self.num_experts // n
+        k = self.top_k
+        E = self.num_experts
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(axis, None), P(None, None),
+                      P(axis, None, None), P(axis, None, None)),
+            out_specs=P(axis, None), check_vma=False)
+        def _f(x_loc, router, wgu_loc, wd_loc):
+            me = jax.lax.axis_index(axis)
+            xg = jax.lax.all_gather(x_loc, axis, axis=0, tiled=True)
+            topk_w, topk_idx = route(xg @ router.astype(xg.dtype), k)
+            h = jnp.einsum("md,edf->emf", xg, wgu_loc.astype(xg.dtype))
+            h = swiglu_ref(h)
+            y_all = jnp.einsum("emf,efd->emd", h, wd_loc.astype(xg.dtype))
+            # weights restricted to this device's experts
+            onehot = jax.nn.one_hot(topk_idx - me * epr, epr,
+                                    dtype=jnp.float32)
+            w_e = jnp.einsum("tk,tke->te", topk_w, onehot)
+            y = jnp.einsum("te,etd->td", w_e, y_all.astype(jnp.float32))
+            y = jax.lax.psum(y, axis)
+            t_loc = x_loc.shape[0]
+            return jax.lax.dynamic_slice_in_dim(
+                y, me * t_loc, t_loc).astype(x_loc.dtype)
+
+        return _f(x, self.w_router, self.w_gate_up, self.w_down)
+
+    def __call__(self, x, mode: str = "ep"):
+        return self.fwd_ep(x) if mode == "ep" else self.fwd_xla(x)

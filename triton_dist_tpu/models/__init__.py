@@ -3,8 +3,9 @@ SURVEY.md §2.4). `AutoLLM` dispatches by model name/config the way the
 reference does (models/__init__.py:33-59: Qwen3 -> DenseLLM,
 Qwen3-MoE -> Qwen3MoE)."""
 
-from triton_dist_tpu.models.config import (ModelConfig, qwen3_32b,  # noqa: F401
-                                           tiny_qwen3)
+from triton_dist_tpu.models.config import (ModelConfig, qwen3_30b_a3b,  # noqa: F401
+                                           qwen3_32b, tiny_qwen3,
+                                           tiny_qwen3_moe)
 from triton_dist_tpu.models.dense import DenseLLM  # noqa: F401
 from triton_dist_tpu.models.engine import Engine  # noqa: F401
 from triton_dist_tpu.models.kv_cache import KVCache  # noqa: F401
@@ -15,16 +16,19 @@ class AutoLLM:
     models/__init__.py:33-59)."""
 
     @staticmethod
-    def from_pretrained(path: str, mesh, axis: str = "tp"):
+    def from_pretrained(path: str, mesh, axis: str = "tp", **kw):
         cfg = ModelConfig.from_hf_config(path)
         if cfg.is_moe:
             from triton_dist_tpu.models.qwen_moe import Qwen3MoE
-            return Qwen3MoE.from_hf(path, mesh, axis)
+            return Qwen3MoE.from_hf(path, mesh, axis, **kw)
+        assert not kw, f"MoE-only kwargs {kw} on a dense config"
         return DenseLLM.from_hf(path, mesh, axis)
 
     @staticmethod
-    def from_config(cfg: ModelConfig, mesh, axis: str = "tp", seed: int = 0):
+    def from_config(cfg: ModelConfig, mesh, axis: str = "tp", seed: int = 0,
+                    **kw):
         if cfg.is_moe:
             from triton_dist_tpu.models.qwen_moe import Qwen3MoE
-            return Qwen3MoE.random_init(cfg, mesh, axis, seed)
+            return Qwen3MoE.random_init(cfg, mesh, axis, seed, **kw)
+        assert not kw, f"MoE-only kwargs {kw} on a dense config"
         return DenseLLM.random_init(cfg, mesh, axis, seed)

@@ -1,0 +1,212 @@
+"""Qwen3-MoE model (reference: `python/triton_dist/models/qwen_moe.py`
+`Qwen3MoE:108` — Qwen3 attention blocks + routed-expert SwiGLU FFNs).
+
+Functional pytree model mirroring DenseLLM; the FFN is either a TP_MoE
+(experts replicated, intermediate sharded — the reference's TP-MoE
+AG-GroupGEMM/MoE-reduce-RS path) or an EP_MoE (experts sharded, tokens
+routed over ICI — the reference's EP a2a path), chosen at construction
+(`moe_impl`), since the two shard the same weights differently.
+
+Forward modes:
+  "xla"   — oracle (dense all-experts MoE + psum attention).
+  "flash" — single-chip framework kernels (flash-decode + grouped GEMM).
+  "dist"  — TP overlap kernels: AG-GEMM/GEMM-RS attention + AG-GroupGEMM
+            + MoE-reduce-RS FFN (moe_impl="tp").
+  "ep"    — AG-GEMM/GEMM-RS attention + EP dispatch/combine FFN
+            (moe_impl="ep"); activations stay row-sharded end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers import TP_Attn, precompute_rope, rms_norm
+from triton_dist_tpu.layers.ep_moe import EP_MoE
+from triton_dist_tpu.layers.tp_moe import TP_MoE
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCache
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MoELayer:
+    attn: TP_Attn
+    moe: TP_MoE | EP_MoE
+    ln_attn: jax.Array
+    ln_mlp: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Qwen3MoE:
+    embed: jax.Array
+    layers: Tuple[MoELayer, ...]
+    final_norm: jax.Array
+    lm_head: jax.Array
+    cos: jax.Array
+    sin: jax.Array
+    config: ModelConfig = dataclasses.field(metadata=dict(static=True))
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    moe_impl: str = dataclasses.field(default="tp",
+                                      metadata=dict(static=True))
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def random_init(cfg: ModelConfig, mesh: Mesh, axis: str = "tp",
+                    seed: int = 0, moe_impl: str = "tp") -> "Qwen3MoE":
+        key = jax.random.key(seed)
+        D, I = cfg.hidden_size, cfg.moe_intermediate_size
+        E, k = cfg.num_experts, cfg.num_experts_per_tok
+        Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        dt = cfg.jax_dtype
+        kit = iter(jax.random.split(key, 65536))
+
+        def w(*shape, scale=None):
+            s = scale if scale is not None else (shape[-2] ** -0.5)
+            return jax.random.normal(next(kit), shape,
+                                     dtype=dt) * jnp.asarray(s, dtype=dt)
+
+        moe_cls = TP_MoE if moe_impl == "tp" else EP_MoE
+        layers = []
+        for _ in range(cfg.num_layers):
+            attn = TP_Attn.init(
+                w(D, Hq * hd), w(D, Hkv * hd), w(D, Hkv * hd),
+                w(Hq * hd, D), mesh=mesh, axis=axis, n_heads=Hq,
+                n_kv_heads=Hkv, head_dim=hd,
+                q_norm=np.ones(hd, np.float32),
+                k_norm=np.ones(hd, np.float32))
+            moe = moe_cls.init(
+                w(D, E, scale=0.02), w(E, D, I), w(E, D, I), w(E, I, D),
+                mesh=mesh, axis=axis, top_k=k)
+            layers.append(MoELayer(
+                attn=attn, moe=moe,
+                ln_attn=jnp.ones((D,), dt), ln_mlp=jnp.ones((D,), dt)))
+        cos, sin = precompute_rope(hd, cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        embed = w(cfg.vocab_size, D, scale=0.02)
+        return Qwen3MoE(
+            embed=embed, layers=tuple(layers),
+            final_norm=jnp.ones((D,), dt),
+            lm_head=(embed.T if cfg.tie_word_embeddings
+                     else w(D, cfg.vocab_size, scale=0.02)),
+            cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis,
+            moe_impl=moe_impl)
+
+    @staticmethod
+    def from_hf(path: str, mesh: Mesh, axis: str = "tp",
+                moe_impl: str = "tp") -> "Qwen3MoE":
+        """Load HF Qwen3-MoE safetensors, stacking per-expert projections
+        (reference: models/qwen_moe.py HF loading + TP shard at load)."""
+        from safetensors import safe_open
+
+        cfg = ModelConfig.from_hf_config(path)
+        Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        dt = cfg.jax_dtype
+        tensors = {}
+        for fn in sorted(os.listdir(path)):
+            if fn.endswith(".safetensors"):
+                with safe_open(os.path.join(path, fn), framework="np") as f:
+                    for key in f.keys():
+                        tensors[key] = f.get_tensor(key)
+
+        def t(name):
+            return jnp.asarray(tensors[name], dtype=dt)
+
+        moe_cls = TP_MoE if moe_impl == "tp" else EP_MoE
+        layers = []
+        for li in range(cfg.num_layers):
+            p = f"model.layers.{li}."
+            attn = TP_Attn.init(
+                t(p + "self_attn.q_proj.weight").T,
+                t(p + "self_attn.k_proj.weight").T,
+                t(p + "self_attn.v_proj.weight").T,
+                t(p + "self_attn.o_proj.weight").T,
+                mesh=mesh, axis=axis, n_heads=Hq, n_kv_heads=Hkv,
+                head_dim=hd,
+                q_norm=tensors.get(p + "self_attn.q_norm.weight"),
+                k_norm=tensors.get(p + "self_attn.k_norm.weight"))
+            gate = jnp.stack([
+                t(p + f"mlp.experts.{e}.gate_proj.weight").T
+                for e in range(cfg.num_experts)])
+            up = jnp.stack([
+                t(p + f"mlp.experts.{e}.up_proj.weight").T
+                for e in range(cfg.num_experts)])
+            down = jnp.stack([
+                t(p + f"mlp.experts.{e}.down_proj.weight").T
+                for e in range(cfg.num_experts)])
+            moe = moe_cls.init(
+                t(p + "mlp.gate.weight").T, gate, up, down,
+                mesh=mesh, axis=axis, top_k=cfg.num_experts_per_tok)
+            layers.append(MoELayer(
+                attn=attn, moe=moe,
+                ln_attn=t(p + "input_layernorm.weight"),
+                ln_mlp=t(p + "post_attention_layernorm.weight")))
+        cos, sin = precompute_rope(hd, cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        embed = t("model.embed_tokens.weight")
+        return Qwen3MoE(
+            embed=embed, layers=tuple(layers),
+            final_norm=t("model.norm.weight"),
+            lm_head=(embed.T if cfg.tie_word_embeddings
+                     else t("lm_head.weight").T),
+            cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis,
+            moe_impl=moe_impl)
+
+    # ------------------------------------------------------------------
+    # forward (mirrors DenseLLM.forward_tokens)
+    # ------------------------------------------------------------------
+
+    def forward_tokens(self, ids, cache: KVCache, mode: str = "dist"):
+        B, S = ids.shape
+        attn_mode = "dist" if mode == "ep" else mode
+        if self.moe_impl == "ep":
+            moe_mode = "ep" if mode == "ep" else "xla"
+        else:
+            moe_mode = "dist" if mode == "ep" else mode
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        kv_start = cache.offset
+        for li, layer in enumerate(self.layers):
+            ck, cv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, ck, cv = layer.attn.fwd_cached(
+                h, self.cos, self.sin, B, ck, cv, kv_start, attn_mode)
+            cache = cache.set_layer(li, ck, cv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.moe(h, moe_mode).astype(x.dtype)
+        cache = cache.advance(S)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode in ("dist", "ep"):
+            import functools
+
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=P(self.axis, None), out_specs=P(None, None),
+                check_vma=False)
+            def gather_rows(x_loc):
+                return jax.lax.all_gather(x_loc, self.axis, axis=0,
+                                          tiled=True)
+
+            x = gather_rows(x)
+        last = x.reshape(B, S, -1)[:, -1]
+        logits = jnp.dot(last, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits, cache
+
+    def make_cache(self, batch: int, max_seq: int, dtype=None) -> KVCache:
+        cfg = self.config
+        return KVCache.create(cfg.num_layers, batch, max_seq,
+                              cfg.num_kv_heads, cfg.head_dim,
+                              mesh=self.mesh, axis=self.axis,
+                              dtype=dtype or cfg.jax_dtype)
