@@ -33,14 +33,27 @@ from triton_dist_tpu.runtime import interpret_mode
 
 
 def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
-                         len_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr):
-    """Grid (X/bx, T/bt); X = B*Hkv. Online softmax over KV tiles."""
+                         partial: bool, len_ref, q_ref, k_ref, v_ref,
+                         *rest):
+    """Grid (X/bx, T/bt); X = B*Hkv. Online softmax over KV tiles.
+
+    partial=False: rest = (o_ref, m_scr, l_scr, acc_scr); writes the
+    normalized output. partial=True: rest = (o_ref, m_ref, l_ref,
+    m_scr, l_scr, acc_scr); writes UNNORMALIZED f32 acc + (m, l) for an
+    inter-chip LSE combine (reference: flash_decode.py:482)."""
+    if partial:
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        m_ref = l_ref = None
     t = pl.program_id(1)
     nt = pl.num_programs(1)
     bt = k_ref.shape[1]
     rows = q_ref.shape[1]          # S * rep
     kv_len = len_ref[0]
+    # global position of query row 0 relative to this KV buffer's col 0;
+    # a query row r sits at q_off + r//rep and sees cols <= that.
+    q_off = len_ref[1]
     start = t * bt
 
     @pl.when(t == 0)
@@ -56,11 +69,9 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale  # [bx, rows, bt]
-        # causal mask with suffix alignment: query row r belongs to
-        # position kv_len - S + r//rep; it sees cols <= that position.
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
-        mask = col <= (row + (kv_len - S))
+        mask = (col <= (row + q_off)) & (col < kv_len)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev,
                             jnp.max(jnp.where(mask[None], s, -1e30), -1))
@@ -82,8 +93,13 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
 
     @pl.when(t == nt - 1)
     def _finish():
-        o_ref[...] = (acc_scr[...]
-                      / l_scr[...][..., None]).astype(o_ref.dtype)
+        if partial:
+            o_ref[...] = acc_scr[...]
+            m_ref[...] = m_scr[...]
+            l_ref[...] = l_scr[...]
+        else:
+            o_ref[...] = (acc_scr[...]
+                          / l_scr[...][..., None]).astype(o_ref.dtype)
 
 
 def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
@@ -130,9 +146,71 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
            .reshape(X, rows, d))
     kx = k.reshape(X, T, d)
     vx = v.reshape(X, T, d)
+    out = _flash_call(qx, kx, vx, kv_len, kv_len - S, scale=float(scale),
+                      rep=rep, S=S, T=T, partial=False, block_x=block_x,
+                      block_t=block_t)
+    return (out.reshape(B, Hkv, S, rep, d)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, S, Hq, d))
+
+
+def flash_decode_partial(q, k, v, kv_len, q_offset, *,
+                         scale: Optional[float] = None,
+                         block_x: int = 64, block_t: int = 256):
+    """Per-chip split-KV partial: unnormalized accumulator + LSE stats
+    for the inter-chip combine (reference: the split-KV kernel's partial
+    outputs, flash_decode.py:130, combined at :308/:482).
+
+    q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] — THIS CHIP'S KV shard.
+    kv_len: valid cols in this buffer (may be 0 for an empty shard).
+    q_offset: global position of query s=0 relative to this buffer's
+    col 0 (query s attends cols <= q_offset + s; may be negative or
+    > T). Returns (acc [B, S, Hq, d] f32 unnormalized, m [B, S, Hq],
+    l [B, S, Hq]) — combine with lse_combine().
+    """
+    B, S, Hq, d = q.shape
+    _, Hkv, T, _ = k.shape
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    X = B * Hkv
+    rows = S * rep
+    qx = (q.reshape(B, S, Hkv, rep, d)
+           .transpose(0, 2, 1, 3, 4)
+           .reshape(X, rows, d))
+    acc, m, l = _flash_call(qx, k.reshape(X, T, d), v.reshape(X, T, d),
+                            kv_len, q_offset, scale=float(scale), rep=rep,
+                            S=S, T=T, partial=True, block_x=block_x,
+                            block_t=block_t)
+
+    def unfold(a):
+        tail = a.shape[2:]
+        return (a.reshape(B, Hkv, S, rep, *tail)
+                 .transpose(0, 2, 1, 3, *range(4, 4 + len(tail)))
+                 .reshape(B, S, Hq, *tail))
+
+    return unfold(acc), unfold(m), unfold(l)
+
+
+def lse_combine(accs, ms, ls, dtype=None):
+    """Merge split-KV partials across chips/chunks (reference: the
+    inter-rank LSE combine, flash_decode.py:482). accs: [n, ..., d] f32
+    unnormalized; ms/ls: [n, ...]. Returns normalized [..., d]."""
+    m_star = jnp.max(ms, axis=0)
+    scale = jnp.exp(ms - m_star[None])
+    acc = jnp.sum(accs * scale[..., None], axis=0)
+    l = jnp.sum(ls * scale, axis=0)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(dtype) if dtype is not None else out
+
+
+def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
+                S: int, T: int, partial: bool, block_x: int, block_t: int):
+    X, rows, d = qx.shape
     bt = min(block_t, T)
-    bx = _pick_bx(X, rows, d, bt, jnp.dtype(q.dtype).itemsize, block_x)
-    kernel = functools.partial(_flash_decode_kernel, float(scale), rep, S, T)
+    bx = _pick_bx(X, rows, d, bt, jnp.dtype(qx.dtype).itemsize, block_x)
+    kernel = functools.partial(_flash_decode_kernel, scale, rep, S, T,
+                               partial)
 
     # KV-tile index map clamps t to the last block containing valid keys:
     # grid steps past kv_len re-request the same block, and the Pallas
@@ -144,30 +222,42 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
         last = jnp.maximum((len_ref[0] + bt - 1) // bt - 1, 0)
         return (x, jnp.minimum(t, last), 0)
 
-    out = pl.pallas_call(
+    def q_map(x, t, len_ref):
+        return (x, 0, 0)
+
+    if partial:
+        out_shape = (jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
+                     jax.ShapeDtypeStruct((X, rows), jnp.float32),
+                     jax.ShapeDtypeStruct((X, rows), jnp.float32))
+        out_specs = (pl.BlockSpec((bx, rows, d), q_map),
+                     pl.BlockSpec((bx, rows), lambda x, t, len_ref: (x, 0)),
+                     pl.BlockSpec((bx, rows), lambda x, t, len_ref: (x, 0)))
+    else:
+        out_shape = jax.ShapeDtypeStruct((X, rows, d), qx.dtype)
+        out_specs = pl.BlockSpec((bx, rows, d), q_map)
+
+    scalars = jnp.stack([jnp.asarray(kv_len, jnp.int32),
+                         jnp.asarray(q_off, jnp.int32)])
+    return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(X // bx, pl.cdiv(T, bt)),
             in_specs=[
-                pl.BlockSpec((bx, rows, d), lambda x, t, len_ref: (x, 0, 0)),
+                pl.BlockSpec((bx, rows, d), q_map),
                 pl.BlockSpec((bx, bt, d), kv_map),
                 pl.BlockSpec((bx, bt, d), kv_map),
             ],
-            out_specs=pl.BlockSpec((bx, rows, d),
-                                   lambda x, t, len_ref: (x, 0, 0)),
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((bx, rows), jnp.float32),
                 pltpu.VMEM((bx, rows), jnp.float32),
                 pltpu.VMEM((bx, rows, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((X, rows, d), q.dtype),
+        out_shape=out_shape,
         interpret=interpret_mode(),
-    )(jnp.asarray(kv_len, jnp.int32).reshape(1), qx, kx, vx)
-    return (out.reshape(B, Hkv, S, rep, d)
-               .transpose(0, 2, 1, 3, 4)
-               .reshape(B, S, Hq, d))
+    )(scalars, qx, kx, vx)
 
 
 def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
