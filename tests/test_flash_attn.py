@@ -50,6 +50,26 @@ def test_flash_decode_block_t(block_t):
                                    err_msg=f"kv_len={kv_len}")
 
 
+def test_flash_decode_kv_len_past_buffer():
+    """kv_len > T (the non-causal frontier shift sp_ring_attention's
+    'ag' mode uses) must not admit the last block's padding columns:
+    regression for the `col < T` clamp."""
+    rng = np.random.RandomState(2)
+    B, S, Hq, Hkv, T, d = 1, 4, 4, 2, 320, 64   # T % block_t != 0
+    q = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        # every query row sees all T keys
+        out = flash_decode(q, k, v, T + S - 1)
+    assert np.isfinite(np.asarray(out)).all()
+    # oracle: plain full softmax over all T
+    ref = attention_cached_ref(
+        q[:, -1:], k, v, T)  # last row sees exactly all T keys
+    np.testing.assert_allclose(np.asarray(out)[:, -1:], np.asarray(ref),
+                               atol=5e-5, rtol=1e-5)
+
+
 def test_flash_backend_matches_xla_engine(ctx8):
     """Greedy decode through the 'flash' backend (Pallas flash-decode +
     fused SwiGLU) must produce the same tokens as the XLA oracle backend."""

@@ -49,3 +49,16 @@ from triton_dist_tpu.kernels.swiglu import (  # noqa: F401
     swiglu,
     swiglu_ref,
 )
+from triton_dist_tpu.kernels.sp_flash_decode import (  # noqa: F401
+    kv_cache_scatter,
+    sp_flash_decode,
+    sp_flash_decode_ref,
+)
+from triton_dist_tpu.kernels.sp_attention import (  # noqa: F401
+    gemm_all_to_all,
+    qkv_gemm_a2a,
+    sp_ring_attention,
+    sp_ring_attention_ref,
+    ulysses_combine,
+    ulysses_dispatch,
+)

@@ -52,17 +52,24 @@ def _a2a_kernel(n: int, axis: str, x_ref, o_ref, send_sem, recv_sem):
 
 def _a2a_pallas(x_local, *, n: int, axis: str, collective_id: int):
     rows, cols = x_local.shape
+    # Mosaic requires sliced DMAs 128-aligned in the minor dim; pad the
+    # lane dim so chunk slices stay legal on hardware.
+    colsp = -(-cols // 128) * 128
+    if colsp != cols:
+        x_local = jnp.pad(x_local, ((0, 0), (0, colsp - cols)))
     kernel = functools.partial(_a2a_kernel, n, axis)
-    return pl.pallas_call(
+    y = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, cols), x_local.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, colsp), x_local.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
-        compiler_params=shmem_compiler_params(collective_id),
+        compiler_params=shmem_compiler_params(
+            collective_id if n > 1 else None),
         interpret=interpret_mode(),
     )(x_local)
+    return y[:, :cols] if colsp != cols else y
 
 
 def all_to_all(x, *, mesh: Mesh, axis: str = "ep",

@@ -71,7 +71,10 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
             preferred_element_type=jnp.float32) * scale  # [bx, rows, bt]
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
-        mask = (col <= (row + q_off)) & (col < kv_len)
+        # col < T guards the last block's padding when a caller shifts
+        # the causal frontier past the buffer (kv_len > T, e.g. the
+        # non-causal mode of sp_ring_attention)
+        mask = (col <= (row + q_off)) & (col < jnp.minimum(kv_len, T))
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev,
                             jnp.max(jnp.where(mask[None], s, -1e30), -1))

@@ -1,0 +1,386 @@
+"""Sequence-parallel attention for long-context prefill over ICI.
+
+TPU-native re-design of the reference SP prefill kernels
+(`python/triton_dist/kernels/nvidia/sp_ag_attention_intra_node.py`:
+KV-producer :106, attention consumer :257;
+`ulysses_sp_dispatch.py:39` and `sp_ulysess_qkv_gemm_all2all.py:64`).
+
+Three mechanisms, as in the reference:
+
+  - ``sp_ring_attention`` (mode="ring"): Q, K, V all sequence-sharded;
+    KV blocks rotate around the ICI ring via `lax.ppermute` while each
+    chip folds the arriving block into its online-softmax state. This is
+    the overlapped producer/consumer of the reference's AG-attention
+    expressed the TPU way: the NVSHMEM producer stream becomes the async
+    collective-permute (XLA overlaps it with the flash kernel of the
+    current block), and the per-chunk signal waits become the data
+    dependence of the scan carry. Causal skip: future blocks are
+    `lax.cond`-skipped, halving the FLOPs like the reference's
+    rank-ordered consumption.
+  - ``sp_ring_attention`` (mode="ag"): gather the full KV first with the
+    one-shot/ring AllGather kernel, then one flash call — the latency
+    shape of the reference's non-overlapped fallback.
+  - ``ulysses_dispatch`` / ``ulysses_combine``: the Ulysses a2a reshard
+    (seq-sharded <-> head-sharded) over the one-shot A2A kernel; and
+    ``gemm_all_to_all`` — the projection GEMM fused with the dispatch:
+    each head-group tile is pushed to its owner as soon as the MXU
+    finishes it (reference sp_ulysess_qkv_gemm_all2all.py:64).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.kernels.all_to_all import _a2a_pallas
+from triton_dist_tpu.kernels.flash_attn import (flash_decode,
+                                                flash_decode_partial)
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+def _lse_accumulate(carry, part):
+    """Fold one split-KV partial into the running (acc, m, l) state —
+    the pairwise form of the inter-rank combine (flash_decode.py:482)."""
+    acc, m, l = carry
+    acc_i, m_i, l_i = part
+    m_new = jnp.maximum(m, m_i)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m_i - m_new)
+    return (acc * a[..., None] + acc_i * b[..., None],
+            m_new, l * a + l_i * b)
+
+
+def sp_ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
+                      scale: Optional[float] = None, causal: bool = True,
+                      mode: str = "ring", block_x: int = 64,
+                      block_t: int = 256, out_dtype=None):
+    """Self-attention prefill with Q/K/V sequence-sharded over `axis`.
+
+    q: [B, S, Hq, d] sharded on dim 1; k, v: [B, Hkv, S, d] sharded on
+    dim 2 (same S). Every position is valid (prefill); causal masking is
+    by global position. Returns [B, S, Hq, d] sharded on dim 1.
+
+    Reference: sp_ag_attention_intra_node.py:106 (producer) + :257
+    (consumer). There, rank r's Q block consumes KV chunks as the AG
+    lands them; here the chunks come to us around the ring.
+    """
+    n = mesh.shape[axis]
+    B, S, Hq, d = q.shape
+    Hkv = k.shape[1]
+    s_loc = S // n
+    assert S % n == 0, f"S={S} must divide sp={n}"
+    if scale is None:
+        scale = d ** -0.5
+    if out_dtype is None:
+        out_dtype = q.dtype
+
+    q_spec = P(None, axis, None, None)
+    kv_spec = P(None, None, axis, None)
+
+    if mode == "ag":
+        from triton_dist_tpu.kernels.allgather import (AllGatherMethod,
+                                                       _ag_pallas)
+        cid_k = next_collective_id()
+        cid_v = next_collective_id()
+
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(q_spec, kv_spec, kv_spec),
+                           out_specs=q_spec, check_vma=False)
+        def _f_ag(q_loc, k_loc, v_loc):
+            me = jax.lax.axis_index(axis)
+
+            def gather(x_loc, cid):
+                # seq to dim 0 so the AG kernel's contiguous-shard
+                # contract holds: [B, Hkv, s_loc, d] -> [s_loc, B*Hkv*d]
+                flat = x_loc.transpose(2, 0, 1, 3).reshape(s_loc, -1)
+                full = _ag_pallas(flat, n=n, axis=axis,
+                                  method=AllGatherMethod.ONE_SHOT,
+                                  collective_id=cid)
+                return (full.reshape(S, B, Hkv, d)
+                            .transpose(1, 2, 0, 3))
+
+            k_full = gather(k_loc, cid_k)
+            v_full = gather(v_loc, cid_v)
+            # queries at global rows me*s_loc + s; kv_len for the flash
+            # contract = last query's global position + 1. Non-causal:
+            # shift the causal frontier past the last column so every
+            # query row sees all S keys.
+            kv_len = ((me + 1) * s_loc if causal
+                      else jnp.int32(S + s_loc - 1))
+            return flash_decode(q_loc, k_full, v_full,
+                                kv_len, scale=scale, block_x=block_x,
+                                block_t=block_t).astype(out_dtype)
+        return _f_ag(q, k, v)
+
+    assert mode == "ring", mode
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(q_spec, kv_spec, kv_spec),
+                       out_specs=q_spec, check_vma=False)
+    def _f(q_loc, k_loc, v_loc):
+        me = jax.lax.axis_index(axis)
+        rows = (B, s_loc, Hq)
+        acc = jnp.zeros(rows + (d,), jnp.float32)
+        m = jnp.full(rows, -1e30, jnp.float32)
+        l = jnp.zeros(rows, jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb, vb = k_loc, v_loc
+        for r in range(n):
+            src = jax.lax.rem(me - r + n, jnp.int32(n))
+            if causal:
+                # future blocks: kv_len=0 — the kernel still launches
+                # (uniform across devices, required by the interpreter's
+                # lockstep and cheap on hardware) but its pl.when gate
+                # skips every tile, so the causal half costs no FLOPs
+                # (the reference skips by rank order the same way,
+                # sp_ag_attention_intra_node.py:257).
+                local_len = jnp.where(src <= me, s_loc, 0).astype(jnp.int32)
+                q_off = (me - src) * s_loc
+            else:
+                local_len = jnp.int32(s_loc)
+                q_off = jnp.int32(s_loc - 1)
+            part = flash_decode_partial(
+                q_loc, kb, vb, local_len, q_off, scale=scale,
+                block_x=block_x, block_t=block_t)
+            acc, m, l = _lse_accumulate((acc, m, l), part)
+            if r != n - 1:
+                kb = jax.lax.ppermute(kb, axis, perm)
+                vb = jax.lax.ppermute(vb, axis, perm)
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(out_dtype)
+
+    return _f(q, k, v)
+
+
+def sp_ring_attention_ref(q, k, v, *, scale: Optional[float] = None,
+                          causal: bool = True):
+    """Full-tensor jnp oracle (the torch attention role in the
+    reference's SP tests)."""
+    B, S, Hq, d = q.shape
+    Hkv = k.shape[1]
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(B, S, Hkv, rep, d)
+    logits = jnp.einsum("bsgrd,bgtd->bgsrt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        si = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        ti = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        logits = jnp.where((ti <= si)[None, None, :, None], logits,
+                           -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bgsrt,bgtd->bsgrd", p, v.astype(jnp.float32))
+    return o.reshape(B, S, Hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses SP: a2a reshard (seq-sharded <-> head-sharded)
+# ---------------------------------------------------------------------------
+
+def ulysses_dispatch(x, *, mesh: Mesh, axis: str = "sp",
+                     collective_id: Optional[int] = None):
+    """[B, S, H, d] sharded on S -> sharded on H with the full sequence:
+    the Ulysses pre-attention a2a (reference ulysses_sp_dispatch.py:39).
+    H must divide the axis size."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    B, S, H, d = x.shape
+    s_loc, h_loc = S // n, H // n
+    assert H % n == 0 and S % n == 0
+    if collective_id is None:
+        collective_id = next_collective_id()
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(None, axis, None, None),
+                       out_specs=P(None, None, axis, None),
+                       check_vma=False)
+    def _f(x_loc):
+        # chunk p = head group p of my seq block, layout [B, s_loc, h_loc, d]
+        chunks = (x_loc.reshape(B, s_loc, n, h_loc, d)
+                       .transpose(2, 0, 1, 3, 4))
+        flat = chunks.reshape(n * B * s_loc * h_loc, d)
+        y = _a2a_pallas(flat, n=n, axis=axis, collective_id=collective_id)
+        # slot p = peer p's seq block for my head group
+        recv = y.reshape(n, B, s_loc, h_loc, d)
+        return recv.transpose(1, 0, 2, 3, 4).reshape(B, S, h_loc, d)
+
+    return _f(x)
+
+
+def ulysses_combine(x, *, mesh: Mesh, axis: str = "sp",
+                    collective_id: Optional[int] = None):
+    """[B, S, H, d] head-sharded (dim 2) with the full sequence ->
+    [B, S, H, d] sequence-sharded (dim 1): the Ulysses post-attention
+    a2a (the inverse reshard, reference ulysses_sp_dispatch.py:39's
+    combine direction). Shapes are global."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    B, S, H, d = x.shape
+    h_loc, s_loc = H // n, S // n
+    assert H % n == 0 and S % n == 0
+    if collective_id is None:
+        collective_id = next_collective_id()
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(None, None, axis, None),
+                       out_specs=P(None, axis, None, None),
+                       check_vma=False)
+    def _f(x_loc):
+        # chunk p = seq block p of my head group
+        chunks = (x_loc.reshape(B, n, s_loc, h_loc, d)
+                       .transpose(1, 0, 2, 3, 4))
+        flat = chunks.reshape(n * B * s_loc * h_loc, d)
+        y = _a2a_pallas(flat, n=n, axis=axis, collective_id=collective_id)
+        # slot p = head group p for my seq block
+        recv = y.reshape(n, B, s_loc, h_loc, d)
+        return (recv.transpose(1, 2, 0, 3, 4)
+                    .reshape(B, s_loc, H, d))
+
+    return _f(x)
+
+
+# ---------------------------------------------------------------------------
+# Fused projection-GEMM + dispatch a2a
+# ---------------------------------------------------------------------------
+
+def _gemm_a2a_kernel(n: int, axis: str, a_ref, w_ref, o_ref, send_buf,
+                     a_vmem, w_vmem, p_vmem, t_vmem,
+                     copy_sem, send_sem, recv_sem):
+    # send_buf is an HBM *output* used as staging (Mosaic only allows
+    # vmem/smem/semaphore scratch on hardware)
+    """Per head-group chunk j: GEMM tile -> push to owner j, slot `me`.
+    The push of chunk j overlaps the dot of chunk j+1 (reference:
+    sp_ulysess_qkv_gemm_all2all.py:64 — there the epilogue of each
+    tile issues the putmem)."""
+    me = dl.my_pe(axis)
+    M, K = a_ref.shape
+    Nc = o_ref.shape[2]
+    dl.barrier_all(axis)
+    cp = pltpu.make_async_copy(a_ref, a_vmem, copy_sem)
+    cp.start()
+    cp.wait()
+    for j in range(n):
+        cp = pltpu.make_async_copy(
+            w_ref.at[:, pl.ds(j * Nc, Nc)], w_vmem, copy_sem)
+        cp.start()
+        cp.wait()
+        p_vmem[...] = jnp.dot(a_vmem[...], w_vmem[...],
+                              preferred_element_type=jnp.float32)
+        t_vmem[...] = p_vmem[...].astype(t_vmem.dtype)
+        cp = pltpu.make_async_copy(t_vmem, send_buf.at[j], copy_sem)
+        cp.start()
+        cp.wait()
+        dl.putmem_nbi(o_ref.at[me], send_buf.at[j], send_sem, recv_sem,
+                      jnp.int32(j), axis)
+    for _ in range(n):
+        pltpu.make_async_copy(send_buf.at[0], send_buf.at[0],
+                              recv_sem).wait()
+    dl.quiet(send_sem, send_buf.at[0], n)
+
+
+def gemm_all_to_all(a, w, *, mesh: Mesh, axis: str = "sp",
+                    collective_id: Optional[int] = None):
+    """y = a @ w with the output scattered by column-chunk to its owner
+    and token-blocks gathered from every peer: a [M_total, K] sharded on
+    rows (tokens) over `axis`; w [K, N] replicated, its columns arranged
+    head-group-major (chunk j = owner j's N/n columns). Returns
+    [n, M_total/n, N/n] per device under spec P(axis, None, None) —
+    slot p = peer p's token block for this device's head group.
+
+    Fused form of ulysses_dispatch for the QKV projection (reference:
+    sp_ulysess_qkv_gemm_all2all.py:64)."""
+    n = mesh.shape[axis]
+    if collective_id is None:
+        collective_id = next_collective_id()
+    M, K = a.shape
+    N = w.shape[1]
+    m_loc, Nc = M // n, N // n
+    assert M % n == 0 and N % n == 0
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis, None), P(None, None)),
+                       out_specs=P(axis, None, None), check_vma=False)
+    def _f(a_loc, w_r):
+        return _gemm_a2a_call(a_loc, w_r, n=n, axis=axis, m_loc=m_loc,
+                              Nc=Nc, collective_id=collective_id)
+
+    return _f(a, w)
+
+
+def qkv_gemm_a2a(x, w, *, mesh: Mesh, axis: str = "sp",
+                 collective_id: Optional[int] = None):
+    """Fused projection + Ulysses dispatch for token tensors: x [B, S, D]
+    sequence-sharded (dim 1) -> y [B, S, N/n] with the FULL sequence and
+    the projection output head-sharded (dim 2). w [D, N] replicated,
+    columns head-group-major. The GEMM tile for head-group j is pushed
+    to owner j as soon as the MXU finishes it (reference:
+    sp_ulysess_qkv_gemm_all2all.py:64)."""
+    n = mesh.shape[axis]
+    if collective_id is None:
+        collective_id = next_collective_id()
+    B, S, D = x.shape
+    N = w.shape[1]
+    s_loc, Nc = S // n, N // n
+    assert S % n == 0 and N % n == 0
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(None, axis, None), P(None, None)),
+                       out_specs=P(None, None, axis), check_vma=False)
+    def _f(x_loc, w_r):
+        a_loc = x_loc.reshape(B * s_loc, D)
+        out = _gemm_a2a_call(a_loc, w_r, n=n, axis=axis,
+                             m_loc=B * s_loc, Nc=Nc,
+                             collective_id=collective_id)
+        # slot p = peer p's [B, s_loc] token block for my head group
+        return (out.reshape(n, B, s_loc, Nc)
+                   .transpose(1, 0, 2, 3)
+                   .reshape(B, S, Nc))
+
+    return _f(x, w)
+
+
+def _gemm_a2a_call(a_loc, w_r, *, n, axis, m_loc, Nc, collective_id):
+    K = a_loc.shape[1]
+    # pad each column chunk to a 128-lane multiple so the per-chunk
+    # weight-slice DMAs stay Mosaic-legal (sliced DMAs must be
+    # 128-aligned in the minor dim)
+    Ncp = -(-Nc // 128) * 128
+    if Ncp != Nc:
+        w_r = jnp.pad(w_r.reshape(K, n, Nc), ((0, 0), (0, 0),
+                                              (0, Ncp - Nc)))
+        w_r = w_r.reshape(K, n * Ncp)
+    Nc_out, Nc = Nc, Ncp
+    kernel = functools.partial(_gemm_a2a_kernel, n, axis)
+    out, _ = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n, m_loc, Nc), a_loc.dtype),
+                   jax.ShapeDtypeStruct((n, m_loc, Nc), a_loc.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((m_loc, K), a_loc.dtype),
+            pltpu.VMEM((K, Nc), w_r.dtype),
+            pltpu.VMEM((m_loc, Nc), jnp.float32),
+            pltpu.VMEM((m_loc, Nc), a_loc.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=shmem_compiler_params(
+            collective_id if n > 1 else None),
+        interpret=interpret_mode(),
+    )(a_loc, w_r)
+    return out[..., :Nc_out] if Nc_out != Nc else out

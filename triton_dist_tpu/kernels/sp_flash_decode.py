@@ -1,0 +1,299 @@
+"""Distributed (sequence-parallel) flash-decode over ICI.
+
+TPU-native re-design of the reference distributed flash-decode
+(`python/triton_dist/kernels/nvidia/flash_decode.py`: per-rank split-KV
+partials :130, intra-rank combine :308, **inter-rank LSE combine** :482,
+host op `gqa_fwd_batch_decode_persistent_aot`/`flash_decode_v2`). The KV
+cache is sharded on the sequence dimension across the `sp` axis; each
+chip runs the local split-KV flash kernel over its shard producing an
+unnormalized accumulator plus (m, l) softmax stats, and the partials are
+merged with a numerically-stable log-sum-exp combine.
+
+Two combine paths:
+  - ``combine="xla"``  : `lax.all_gather` of the partials + jnp combine —
+    the oracle (the role torch/NCCL plays in the reference tests).
+  - ``combine="dist"`` : a one-shot Pallas kernel — every chip pushes its
+    (acc, stats) into its slot on every peer over ICI and reduces the n
+    landed partials on the VPU (the reference's inter-rank combine
+    kernel, flash_decode.py:482, as one-sided puts instead of a
+    gather-then-combine pair). Output is replicated, which is exactly
+    what decode wants (the next layer's QKV projection reads it whole).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
+                                                flash_decode_partial,
+                                                lse_combine)
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+def _pick_block_r(R: int, d: int, budget: int = 8 << 20) -> int:
+    """Largest divisor of R whose reduce-tile VMEM footprint fits:
+    one landed tile + one f32 accumulator per block."""
+    for br in range(R, 0, -1):
+        if R % br:
+            continue
+        if br * d * 4 * 2 <= budget:
+            return br
+    return R
+
+
+def _lse_combine_kernel(n: int, axis: str, block_r: int,
+                        acc_ref, st_ref, o_ref, land_acc, land_st,
+                        vst, vtile, vacc,
+                        copy_sem, send_sem, recv_sem):
+    """One-shot push of (acc, stats) + fused LSE reduce.
+
+    acc_ref: [R, d] f32 unnormalized accumulator; st_ref: [2, R] f32
+    (row 0 = m, row 1 = l; R last so remote-DMA slices keep the lane
+    dimension whole — Mosaic requires sliced DMAs 128-aligned in the
+    minor dim). Ref: the inter-rank combine kernel (flash_decode.py:482)
+    — there a gather lands partials and a second kernel combines; here
+    the push and the combine share one kernel so arrival waits overlap
+    the stats math.
+    """
+    me = dl.my_pe(axis)
+    R, d = acc_ref.shape
+    dl.barrier_all(axis)
+    for p in range(n):
+        dl.putmem_nbi(land_acc.at[me], acc_ref, send_sem, recv_sem,
+                      jnp.int32(p), axis)
+        dl.putmem_nbi(land_st.at[me], st_ref, send_sem, recv_sem,
+                      jnp.int32(p), axis)
+    # n acc-sized + n stats-sized arrivals (own slots; order irrelevant)
+    for _ in range(n):
+        pltpu.make_async_copy(acc_ref, acc_ref, recv_sem).wait()
+    for _ in range(n):
+        pltpu.make_async_copy(st_ref, st_ref, recv_sem).wait()
+    # stats are tiny: load all n slots and compute the global m*, and the
+    # per-slot rescale exp(m_p - m*) and combined l* on the VPU once.
+    cp = pltpu.make_async_copy(land_st, vst, copy_sem)
+    cp.start()
+    cp.wait()
+    m = vst[:, 0, :]                                  # [n, R]
+    m_star = jnp.max(m, axis=0)                       # [R]
+    scale = jnp.exp(m - m_star[None])                 # [n, R]
+    l_star = jnp.sum(vst[:, 1, :] * scale, axis=0)    # [R]
+    inv_l = 1.0 / jnp.maximum(l_star, 1e-30)
+    nr = R // block_r
+    for t in range(nr):
+        lo, hi = t * block_r, (t + 1) * block_r
+        rows = pl.ds(lo, block_r)
+        cp = pltpu.make_async_copy(land_acc.at[0, rows], vtile, copy_sem)
+        cp.start()
+        cp.wait()
+        vacc[...] = vtile[...] * scale[0, lo:hi][..., None]
+        for p in range(1, n):
+            cp = pltpu.make_async_copy(land_acc.at[p, rows], vtile,
+                                       copy_sem)
+            cp.start()
+            cp.wait()
+            vacc[...] = vacc[...] + vtile[...] * scale[p, lo:hi][..., None]
+        vtile[...] = vacc[...] * inv_l[lo:hi][..., None]
+        cp = pltpu.make_async_copy(vtile, o_ref.at[rows], copy_sem)
+        cp.start()
+        cp.wait()
+    # drain our own sends before the buffers are reclaimed
+    dl.quiet(send_sem, acc_ref, n)
+    dl.quiet(send_sem, st_ref, n)
+
+
+def _lse_combine_pallas(acc, st, *, n: int, axis: str, collective_id: int):
+    R, d = acc.shape
+    Rp = st.shape[1]
+    block_r = _pick_block_r(R, d)
+    kernel = functools.partial(_lse_combine_kernel, n, axis, block_r)
+    # The landing buffers are extra HBM OUTPUTS, not scratch: Mosaic
+    # only allocates vmem/smem/semaphore scratch on hardware, and making
+    # them outputs is exactly the symmetric-buffer shape the reference
+    # allocates via nvshmem_create_tensors (flash_decode.py host side).
+    out, _, _ = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((R, d), jnp.float32),
+                   jax.ShapeDtypeStruct((n, R, d), jnp.float32),
+                   jax.ShapeDtypeStruct((n, 2, Rp), jnp.float32)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((n, 2, Rp), jnp.float32),
+            pltpu.VMEM((block_r, d), jnp.float32),
+            pltpu.VMEM((block_r, d), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        # n=1: barrier_all degenerates to nothing, so Mosaic forbids a
+        # collective_id (no barrier-semaphore use in the kernel)
+        compiler_params=shmem_compiler_params(
+            collective_id if n > 1 else None),
+        interpret=interpret_mode(),
+    )(acc, st)
+    return out
+
+
+def sp_flash_decode(q, k, v, kv_len, *, mesh: Mesh, axis: str = "sp",
+                    scale: Optional[float] = None, combine: str = "dist",
+                    block_x: int = 64, block_t: int = 256,
+                    collective_id: Optional[int] = None,
+                    out_dtype=None):
+    """Cached GQA attention with the KV cache sequence-sharded over `axis`.
+
+    q: [B, S, Hq, d] replicated over `axis`; k, v: [B, Hkv, T, d] with T
+    sharded over `axis` (each chip owns a contiguous T/n window of the
+    cache; chip r's window covers global positions [r*T/n, (r+1)*T/n)).
+    kv_len: traced global count of valid KV positions INCLUDING the S
+    query positions. Returns [B, S, Hq, d] replicated over `axis`.
+
+    Reference: flash_decode.py:482 (inter-rank combine) — the split-KV
+    split there is over CTAs within a rank AND over ranks; here the
+    intra-chip split is the flash grid walk (flash_attn.py) and the
+    inter-chip split is this op.
+    """
+    n = mesh.shape[axis]
+    B, S, Hq, d = q.shape
+    T = k.shape[2]
+    t_loc = T // n
+    assert T % n == 0, f"cache T={T} must divide sp={n}"
+    if scale is None:
+        scale = d ** -0.5
+    if collective_id is None:
+        collective_id = next_collective_id()
+    if out_dtype is None:
+        out_dtype = q.dtype
+
+    def _partial(q_r, k_loc, v_loc, L):
+        me = jax.lax.axis_index(axis)
+        local_len = jnp.clip(L - me * t_loc, 0, t_loc)
+        q_off = (L - S) - me * t_loc
+        return flash_decode_partial(q_r, k_loc, v_loc, local_len, q_off,
+                                    scale=scale, block_x=block_x,
+                                    block_t=block_t)
+
+    kv_spec = P(None, None, axis, None)
+    rep_spec = P(*(None,) * 4)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+
+    if combine == "xla":
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(rep_spec, kv_spec, kv_spec, P()),
+                           out_specs=rep_spec, check_vma=False)
+        def _f(q_r, k_loc, v_loc, L):
+            acc, m, l = _partial(q_r, k_loc, v_loc, L)
+            accs = jax.lax.all_gather(acc, axis)
+            ms = jax.lax.all_gather(m, axis)
+            ls = jax.lax.all_gather(l, axis)
+            return lse_combine(accs, ms, ls, dtype=out_dtype)
+        return _f(q, k, v, kv_len)
+
+    assert combine == "dist", combine
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(rep_spec, kv_spec, kv_spec, P()),
+                       out_specs=rep_spec, check_vma=False)
+    def _f(q_r, k_loc, v_loc, L):
+        acc, m, l = _partial(q_r, k_loc, v_loc, L)
+        R = B * S * Hq
+        acc2 = acc.reshape(R, d)
+        # stats [2, R] padded to a 128 lane multiple: Mosaic requires
+        # the minor dim of sliced remote DMAs tile-aligned
+        Rp = -(-R // 128) * 128
+        st = jnp.stack([m.reshape(R), l.reshape(R)], axis=0)
+        if Rp != R:
+            st = jnp.pad(st, ((0, 0), (0, Rp - R)))
+        out = _lse_combine_pallas(acc2, st, n=n, axis=axis,
+                                  collective_id=collective_id)
+        return out.reshape(B, S, Hq, d).astype(out_dtype)
+
+    return _f(q, k, v, kv_len)
+
+
+def sp_flash_decode_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
+    """Full-KV oracle: identical math on the unsharded cache."""
+    return attention_cached_ref(q, k, v, kv_len, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Cache fill: scatter seq-sharded KV blocks into owner windows
+# ---------------------------------------------------------------------------
+
+def _kv_scatter_kernel(n: int, axis: str, s_loc: int, t_loc: int, S: int,
+                       src_ref, cache_ref, win_ref, send_sem, recv_sem):
+    """Each chip puts its s_loc block straight into the owner chip's
+    window at the right offset — one ICI hop, S/n bytes per link total
+    (vs the n x cost of gather-then-slice). cache_ref is aliased to
+    win_ref, so untouched window rows keep their contents."""
+    del cache_ref
+    me = dl.my_pe(axis)
+    a = me * s_loc
+    owner = a // jnp.int32(t_loc)
+    off = jax.lax.rem(a, jnp.int32(t_loc))
+    dl.barrier_all(axis)
+    dl.putmem_nbi(win_ref.at[:, :, pl.ds(off, s_loc)], src_ref,
+                  send_sem, recv_sem, owner, axis)
+    # arrivals landing in MY window: blocks covering [me*t_loc, S)
+    lo = me * t_loc
+    cnt = jnp.clip((jnp.int32(S) - lo + s_loc - 1) // s_loc, 0,
+                   t_loc // s_loc)
+
+    def body(i, c):
+        pltpu.make_async_copy(src_ref, src_ref, recv_sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, cnt, body, 0)
+    dl.quiet(send_sem, src_ref, 1)
+
+
+def kv_cache_scatter(cache, kv_new, *, mesh: Mesh, axis: str = "sp",
+                     collective_id: Optional[int] = None):
+    """Fill a sequence-sharded KV cache from seq-sharded new K or V.
+
+    cache: [B, Hkv, T, d], T sharded over `axis` in contiguous t_loc
+    windows; kv_new: [B, Hkv, S, d], S sharded in s_loc blocks (S <= T,
+    t_loc % s_loc == 0 so each block has one owner window). Returns the
+    cache with positions [0, S) overwritten — the prefill fill path of
+    the SP layer (reference analog: the KV store the producer ranks
+    write before flash_decode.py:482's combine reads it)."""
+    n = mesh.shape[axis]
+    B, Hkv, S, d = kv_new.shape
+    T = cache.shape[2]
+    s_loc, t_loc = S // n, T // n
+    assert S % n == 0 and T % n == 0 and t_loc % s_loc == 0, (S, T, n)
+    if collective_id is None:
+        collective_id = next_collective_id()
+    spec = P(None, None, axis, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _f(c_loc, k_loc):
+        kernel = functools.partial(_kv_scatter_kernel, n, axis, s_loc,
+                                   t_loc, S)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(c_loc.shape, c_loc.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+            input_output_aliases={1: 0},
+            compiler_params=shmem_compiler_params(
+                collective_id if n > 1 else None),
+            interpret=interpret_mode(),
+        )(k_loc.astype(c_loc.dtype), c_loc)
+
+    return _f(cache, kv_new)

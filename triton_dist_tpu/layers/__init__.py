@@ -11,3 +11,9 @@ from triton_dist_tpu.layers.common import (  # noqa: F401
 )
 from triton_dist_tpu.layers.tp_mlp import TP_MLP  # noqa: F401
 from triton_dist_tpu.layers.tp_attn import TP_Attn  # noqa: F401
+from triton_dist_tpu.layers.tp_moe import TP_MoE  # noqa: F401
+from triton_dist_tpu.layers.ep_moe import EP_MoE  # noqa: F401
+from triton_dist_tpu.layers.sp_attn import (  # noqa: F401
+    SPAttn,
+    UlyssesAttn,
+)

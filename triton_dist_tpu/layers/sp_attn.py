@@ -1,0 +1,324 @@
+"""Sequence-parallel attention layers: SP flash-decode and Ulysses.
+
+TPU-native re-design of the reference SP layers
+(`python/triton_dist/layers/nvidia/sp_attn.py` — the SP AG-attention
+prefill wrapper and the flash-decode layer driven by
+`kernels/nvidia/flash_decode.py:482`'s inter-rank combine — and the
+Ulysses layer over `ulysses_sp_dispatch.py:39` /
+`sp_ulysess_qkv_gemm_all2all.py:64`).
+
+Two layers:
+  - ``SPAttn``: weights replicated, activations and KV cache sharded on
+    the sequence dimension. Prefill runs ring attention (KV blocks
+    rotate over ICI); decode runs the distributed flash-decode with the
+    one-sided LSE-combine kernel. This is the long-context serving
+    layout: the cache grows with T but each chip only holds T/n of it.
+  - ``UlyssesAttn``: prefill where the QKV projection is fused with the
+    head-reshard a2a (each head-group GEMM tile is pushed to its owner
+    as the MXU finishes it), attention runs over the full sequence on
+    1/n of the heads, and the inverse a2a restores sequence sharding
+    before the local O projection — no collective in the O path at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.sp_attention import (qkv_gemm_a2a,
+                                                  sp_ring_attention,
+                                                  sp_ring_attention_ref,
+                                                  ulysses_combine,
+                                                  ulysses_dispatch)
+from triton_dist_tpu.kernels.sp_flash_decode import (kv_cache_scatter,
+                                                     sp_flash_decode)
+from triton_dist_tpu.kernels.flash_attn import flash_decode
+from triton_dist_tpu.layers.common import (apply_rope, rms_norm,
+                                           shard_cols_packed)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SPAttn:
+    """Sequence-parallel GQA attention with a sequence-sharded KV cache.
+
+    w_qkv: [D, (Hq + 2*Hkv) * hd] replicated (natural head order).
+    w_o:   [Hq * hd, D] replicated.
+    """
+
+    w_qkv: jax.Array
+    w_o: jax.Array
+    q_norm: Optional[jax.Array]
+    k_norm: Optional[jax.Array]
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    n_heads: int = dataclasses.field(metadata=dict(static=True))
+    n_kv_heads: int = dataclasses.field(metadata=dict(static=True))
+    head_dim: int = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def init(w_q, w_k, w_v, w_o, *, mesh: Mesh, axis: str = "sp",
+             n_heads: int, n_kv_heads: int, head_dim: int,
+             q_norm=None, k_norm=None):
+        w_qkv = jnp.concatenate(
+            [jnp.asarray(w_q), jnp.asarray(w_k), jnp.asarray(w_v)], axis=1)
+        rep = NamedSharding(mesh, P(*(None,) * 2))
+        return SPAttn(
+            w_qkv=jax.device_put(w_qkv, rep),
+            w_o=jax.device_put(jnp.asarray(w_o), rep),
+            q_norm=None if q_norm is None else jnp.asarray(q_norm),
+            k_norm=None if k_norm is None else jnp.asarray(k_norm),
+            mesh=mesh, axis=axis, n_heads=n_heads,
+            n_kv_heads=n_kv_heads, head_dim=head_dim)
+
+    def _split_qkv(self, qkv, B, S):
+        hq, hkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        q = qkv[..., :hq * hd].reshape(B, S, hq, hd)
+        k = qkv[..., hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
+        v = qkv[..., (hq + hkv) * hd:].reshape(B, S, hkv, hd)
+        if self.q_norm is not None:
+            q = rms_norm(q, self.q_norm)
+        if self.k_norm is not None:
+            k = rms_norm(k, self.k_norm)
+        return q, k, v
+
+    def alloc_cache(self, B: int, T: int, dtype=jnp.bfloat16):
+        """Sequence-sharded KV cache: [B, Hkv, T, d], T over `axis`
+        (chip r owns global positions [r*T/n, (r+1)*T/n))."""
+        spec = NamedSharding(self.mesh, P(None, None, self.axis, None))
+        shape = (B, self.n_kv_heads, T, self.head_dim)
+        z = jnp.zeros(shape, dtype)
+        return (jax.device_put(z, spec), jax.device_put(z, spec))
+
+    def prefill(self, x, cos, sin, cache_k, cache_v, *, mode="ring"):
+        """x: [B, S, D] sequence-sharded. Runs ring attention and writes
+        K/V into the cache's owner windows. Returns (out seq-sharded,
+        cache_k, cache_v, kv_len)."""
+        B, S, D = x.shape
+        n = self.mesh.shape[self.axis]
+        s_loc = S // n
+        axis = self.axis
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, axis, None), P(None, None)),
+                           out_specs=(P(None, axis, None, None),
+                                      P(None, None, axis, None),
+                                      P(None, None, axis, None)),
+                           check_vma=False)
+        def project(x_loc, w):
+            me = jax.lax.axis_index(axis)
+            qkv = x_loc @ w
+            q, k, v = self._split_qkv(qkv, B, s_loc)
+            pos = me * s_loc + jnp.arange(s_loc)
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            return (q, k.transpose(0, 2, 1, 3),   # [B, Hkv, s_loc, d]
+                    v.transpose(0, 2, 1, 3))
+
+        q, k_s, v_s = project(x, self.w_qkv)
+        # one-sided scatter of the s_loc blocks into the t_loc owner
+        # windows: S/n bytes per link, no full gather
+        cache_k = kv_cache_scatter(cache_k, k_s, mesh=self.mesh,
+                                   axis=axis)
+        cache_v = kv_cache_scatter(cache_v, v_s, mesh=self.mesh,
+                                   axis=axis)
+        out = sp_ring_attention(
+            q, k_s, v_s, mesh=self.mesh, axis=axis, causal=True,
+            mode=mode, out_dtype=x.dtype)
+        out = out.reshape(B, S, self.n_heads * self.head_dim)
+        o = _local_oproj(out, self.w_o, self.mesh, axis)
+        return o, cache_k, cache_v, jnp.int32(S)
+
+    def decode(self, x, cos, sin, cache_k, cache_v, kv_len, *,
+               combine="dist"):
+        """One decode step. x: [B, 1, D] replicated; cache seq-sharded;
+        kv_len: traced count of tokens already in the cache. Returns
+        (out [B, 1, D] replicated, cache_k, cache_v, kv_len+1)."""
+        B = x.shape[0]
+        axis = self.axis
+        qkv = x @ self.w_qkv             # replicated compute: tiny M
+        q, k, v = self._split_qkv(qkv, B, 1)
+        q = apply_rope(q, cos, sin, kv_len[None])
+        k = apply_rope(k, cos, sin, kv_len[None])
+        # [B, 1, Hkv, d] -> the cache's [B, Hkv, 1, d] layout
+        cache_k = _write_token(cache_k, k.transpose(0, 2, 1, 3), kv_len,
+                               self.mesh, axis)
+        cache_v = _write_token(cache_v, v.transpose(0, 2, 1, 3), kv_len,
+                               self.mesh, axis)
+        out = sp_flash_decode(q, cache_k, cache_v, kv_len + 1,
+                              mesh=self.mesh, axis=axis, combine=combine,
+                              out_dtype=x.dtype)
+        out = out.reshape(B, 1, self.n_heads * self.head_dim)
+        return out @ self.w_o, cache_k, cache_v, kv_len + 1
+
+
+def _write_token(cache, kv_new, pos, mesh, axis):
+    """Scatter one token's K/V [B, Hkv, 1, d] into the owner chip's
+    window at global position `pos` (traced)."""
+    n = mesh.shape[axis]
+    T = cache.shape[2]
+    t_loc = T // n
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(None, None, axis, None),
+                                 P(None, None, None, None), P()),
+                       out_specs=P(None, None, axis, None),
+                       check_vma=False)
+    def _f(c_loc, new, p):
+        me = jax.lax.axis_index(axis)
+        local = p - me * t_loc
+        idx = jnp.clip(local, 0, t_loc - 1)
+        updated = jax.lax.dynamic_update_slice_in_dim(
+            c_loc, new.astype(c_loc.dtype), idx, axis=2)
+        mine = (local >= 0) & (local < t_loc)
+        return jnp.where(mine, updated, c_loc)
+
+    return _f(cache, kv_new, jnp.asarray(pos, jnp.int32))
+
+
+def _local_oproj(x, w_o, mesh, axis):
+    """O projection on seq-sharded tokens: replicated weight, zero
+    collectives (the SP payoff: the reduction dim is intact)."""
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(None, axis, None), P(None, None)),
+                       out_specs=P(None, axis, None), check_vma=False)
+    def _f(x_loc, w):
+        return x_loc @ w
+
+    return _f(x, w_o)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class UlyssesAttn:
+    """Ulysses SP prefill: a2a head-reshard fused with the QKV GEMM.
+
+    w_qkv: [D, n * (hq_loc + 2*hkv_loc) * hd] — head-GROUP-major packed
+    (chunk j = [q grp j | k grp j | v grp j]), so the fused GEMM+a2a can
+    push chunk j straight to chip j.
+    w_o: [Hq * hd, D] replicated (the O path has no collective).
+    """
+
+    w_qkv: jax.Array
+    w_o: jax.Array
+    q_norm: Optional[jax.Array]
+    k_norm: Optional[jax.Array]
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    n_heads: int = dataclasses.field(metadata=dict(static=True))
+    n_kv_heads: int = dataclasses.field(metadata=dict(static=True))
+    head_dim: int = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def init(w_q, w_k, w_v, w_o, *, mesh: Mesh, axis: str = "sp",
+             n_heads: int, n_kv_heads: int, head_dim: int,
+             q_norm=None, k_norm=None):
+        n = mesh.shape[axis]
+        packed = shard_cols_packed([w_q, w_k, w_v], n)
+        rep = NamedSharding(mesh, P(*(None,) * 2))
+        return UlyssesAttn(
+            w_qkv=jax.device_put(packed, rep),
+            w_o=jax.device_put(jnp.asarray(w_o), rep),
+            q_norm=None if q_norm is None else jnp.asarray(q_norm),
+            k_norm=None if k_norm is None else jnp.asarray(k_norm),
+            mesh=mesh, axis=axis, n_heads=n_heads,
+            n_kv_heads=n_kv_heads, head_dim=head_dim)
+
+    def prefill(self, x, cos, sin, *, mode: str = "fused"):
+        """x: [B, S, D] sequence-sharded -> [B, S, D] sequence-sharded.
+
+        mode="fused":   qkv_gemm_a2a (GEMM tiles pushed per head group)
+        mode="unfused": local GEMM then ulysses_dispatch a2a
+        mode="xla":     replicated-einsum oracle
+        """
+        B, S, D = x.shape
+        n = self.mesh.shape[self.axis]
+        hq_loc = self.n_heads // n
+        hkv_loc = self.n_kv_heads // n
+        hd = self.head_dim
+        axis = self.axis
+        C = (hq_loc + 2 * hkv_loc) * hd
+
+        if mode == "xla":
+            return self._oracle(x, cos, sin)
+
+        if mode == "fused":
+            qkv = qkv_gemm_a2a(x, self.w_qkv, mesh=self.mesh, axis=axis)
+        else:
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=(P(None, axis, None),
+                                         P(None, None)),
+                               out_specs=P(None, axis, None),
+                               check_vma=False)
+            def proj(x_loc, w):
+                return x_loc @ w
+
+            qkv_seq = proj(x, self.w_qkv)   # [B, S, n*C] seq-sharded
+            # dispatch on a head-like trailing dim: n chunks ("heads")
+            # of width C, keeping a full C-wide lane dim for the DMAs
+            qkv = ulysses_dispatch(
+                qkv_seq.reshape(B, S, n, C), mesh=self.mesh,
+                axis=axis).reshape(B, S, n * C)
+
+        # head-sharded full-seq attention
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=P(None, None, axis),
+                           out_specs=P(None, None, axis, None),
+                           check_vma=False)
+        def attend(qkv_loc):
+            q = qkv_loc[..., :hq_loc * hd].reshape(B, S, hq_loc, hd)
+            k = qkv_loc[..., hq_loc * hd:(hq_loc + hkv_loc) * hd]
+            v = qkv_loc[..., (hq_loc + hkv_loc) * hd:]
+            k = k.reshape(B, S, hkv_loc, hd)
+            v = v.reshape(B, S, hkv_loc, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            pos = jnp.arange(S)
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            o = flash_decode(q, k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3), jnp.int32(S))
+            return o
+
+        o = attend(qkv)                      # [B, S, Hq, d] head-sharded
+        o = ulysses_combine(o, mesh=self.mesh, axis=axis)
+        o = o.reshape(B, S, self.n_heads * hd)
+        return _local_oproj(o, self.w_o, self.mesh, axis)
+
+    def _oracle(self, x, cos, sin):
+        """Replicated jnp oracle with identical weight unpacking."""
+        B, S, D = x.shape
+        n = self.mesh.shape[self.axis]
+        hq_loc = self.n_heads // n
+        hkv_loc = self.n_kv_heads // n
+        hd = self.head_dim
+        C = (hq_loc + 2 * hkv_loc) * hd
+        w = self.w_qkv.reshape(D, n, C)
+        wq = w[:, :, :hq_loc * hd].reshape(D, n * hq_loc * hd)
+        wk = (w[:, :, hq_loc * hd:(hq_loc + hkv_loc) * hd]
+              .reshape(D, n * hkv_loc * hd))
+        wv = (w[:, :, (hq_loc + hkv_loc) * hd:]
+              .reshape(D, n * hkv_loc * hd))
+        xr = jax.reshard(x, NamedSharding(self.mesh, P(None, None, None)))
+        q = (xr @ wq).reshape(B, S, self.n_heads, hd)
+        k = (xr @ wk).reshape(B, S, self.n_kv_heads, hd)
+        v = (xr @ wv).reshape(B, S, self.n_kv_heads, hd)
+        if self.q_norm is not None:
+            q = rms_norm(q, self.q_norm)
+        if self.k_norm is not None:
+            k = rms_norm(k, self.k_norm)
+        pos = jnp.arange(S)
+        q = apply_rope(q, cos, sin, pos)
+        k = apply_rope(k, cos, sin, pos)
+        o = sp_ring_attention_ref(q, k.transpose(0, 2, 1, 3),
+                                  v.transpose(0, 2, 1, 3), causal=True)
+        o = o.reshape(B, S, self.n_heads * hd) @ self.w_o
+        return jax.reshard(
+            o, NamedSharding(self.mesh, P(None, self.axis, None)))
