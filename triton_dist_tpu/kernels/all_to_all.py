@@ -65,8 +65,7 @@ def _a2a_pallas(x_local, *, n: int, axis: str, collective_id: int):
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
-        compiler_params=shmem_compiler_params(
-            collective_id if n > 1 else None),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(x_local)
     return y[:, :cols] if colsp != cols else y

@@ -123,7 +123,7 @@ def _ag_pallas(x_shard, *, n: int, axis: str, method: AllGatherMethod,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=scratch,
-        compiler_params=shmem_compiler_params(collective_id),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(x_shard)
 

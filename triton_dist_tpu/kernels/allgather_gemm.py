@@ -198,7 +198,7 @@ def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext):
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((n,)),
         ],
-        compiler_params=shmem_compiler_params(ctx.collective_id),
+        compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
     )(a_shard, b_shard)
     return ag, out

@@ -170,11 +170,15 @@ def _ar_pallas(x_local, *, n: int, axis: str, method: AllReduceMethod,
                collective_id: int):
     M, cols = x_local.shape
     m_loc = M // n
-    out_shape = jax.ShapeDtypeStruct((M, cols), x_local.dtype)
+    # HBM landing/staging buffers are extra OUTPUTS (discarded): Mosaic
+    # only allocates vmem/smem/semaphore scratch on hardware, and
+    # outputs are the symmetric-heap shape the reference gets from
+    # nvshmem_create_tensors.
     if method == AllReduceMethod.ONE_SHOT:
         kernel = functools.partial(_one_shot_ar_kernel, n, axis)
+        out_shape = (jax.ShapeDtypeStruct((M, cols), x_local.dtype),
+                     jax.ShapeDtypeStruct((n, M, cols), x_local.dtype))
         scratch = [
-            pltpu.HBM((n, M, cols), x_local.dtype),
             pltpu.VMEM((M, cols), jnp.float32),
             pltpu.VMEM((M, cols), x_local.dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -183,9 +187,10 @@ def _ar_pallas(x_local, *, n: int, axis: str, method: AllReduceMethod,
         ]
     else:
         kernel = functools.partial(_two_shot_ar_kernel, n, axis)
+        out_shape = (jax.ShapeDtypeStruct((M, cols), x_local.dtype),
+                     jax.ShapeDtypeStruct((2, m_loc, cols), x_local.dtype),
+                     jax.ShapeDtypeStruct((2, m_loc, cols), x_local.dtype))
         scratch = [
-            pltpu.HBM((2, m_loc, cols), x_local.dtype),
-            pltpu.HBM((2, m_loc, cols), x_local.dtype),
             pltpu.VMEM((m_loc, cols), jnp.float32),
             pltpu.VMEM((m_loc, cols), x_local.dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -194,15 +199,17 @@ def _ar_pallas(x_local, *, n: int, axis: str, method: AllReduceMethod,
             pltpu.SemaphoreType.DMA((n,)),
             pltpu.SemaphoreType.REGULAR,
         ]
-    return pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in out_shape),
         scratch_shapes=scratch,
-        compiler_params=shmem_compiler_params(collective_id),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(x_local)
+    return res[0]
 
 
 def all_reduce(x_partials, *, mesh: Mesh, axis: str = "tp",

@@ -268,7 +268,7 @@ def dispatch_a2a(send_x, send_meta, *, n: int, axis: str,
         scratch_shapes=[pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
-        compiler_params=shmem_compiler_params(collective_id),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(send_x, send_meta)
 

@@ -131,15 +131,18 @@ def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
     n = ctx.n
     block_n = _divisor_block(N, ctx.block_n)
     kernel = functools.partial(_gemm_ar_kernel, n, ctx.axis, block_n)
-    return pl.pallas_call(
+    # landing/staging HBM buffers as extra outputs (hardware forbids
+    # non-vmem scratch); kernel arg order is unchanged
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((M, N), a_shard.dtype),
+        out_shape=(jax.ShapeDtypeStruct((M, N), a_shard.dtype),
+                   jax.ShapeDtypeStruct((n, M, N), a_shard.dtype),
+                   jax.ShapeDtypeStruct((M, N), a_shard.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in range(3)),
         scratch_shapes=[
-            pltpu.HBM((n, M, N), a_shard.dtype),
-            pltpu.HBM((M, N), a_shard.dtype),
             pltpu.VMEM((M, k_loc), a_shard.dtype),
             pltpu.VMEM((k_loc, block_n), b_shard.dtype),
             pltpu.VMEM((M, block_n), jnp.float32),
@@ -148,9 +151,10 @@ def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
-        compiler_params=shmem_compiler_params(ctx.collective_id),
+        compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
     )(a_shard, b_shard)
+    return res[0]
 
 
 def gemm_allreduce(a, b, ctx: Optional[GemmARContext] = None, *,

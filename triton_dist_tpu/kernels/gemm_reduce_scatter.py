@@ -152,11 +152,12 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
                 pltpu.semaphore_wait(credit_sem, 1)
             dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
                           send_sems.at[slot], recv_sems.at[slot], right, axis)
-    # drain the last outstanding send on each slot
-    dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
-    if n > 2:
-        dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
-    pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+    # drain the last outstanding send on each slot (n=1 sends nothing)
+    if n > 1:
+        dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
+        if n > 2:
+            dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
+        pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
 
 
 def _gemm_rs_call(a_shard, b_shard,
@@ -171,15 +172,18 @@ def _gemm_rs_call(a_shard, b_shard,
     m_loc = M // n
     block_n = _divisor_block(N, ctx.block_n)
     kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n)
-    return pl.pallas_call(
+    # landing/staging HBM buffers as extra outputs (hardware forbids
+    # non-vmem scratch); kernel arg order is unchanged
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((m_loc, N), a_shard.dtype),
+        out_shape=(jax.ShapeDtypeStruct((m_loc, N), a_shard.dtype),
+                   jax.ShapeDtypeStruct((2, m_loc, N), a_shard.dtype),
+                   jax.ShapeDtypeStruct((2, m_loc, N), a_shard.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in range(3)),
         scratch_shapes=[
-            pltpu.HBM((2, m_loc, N), a_shard.dtype),
-            pltpu.HBM((2, m_loc, N), a_shard.dtype),
             pltpu.VMEM((m_loc, k_loc), a_shard.dtype),
             pltpu.VMEM((k_loc, block_n), b_shard.dtype),
             pltpu.VMEM((m_loc, block_n), jnp.float32),
@@ -189,9 +193,10 @@ def _gemm_rs_call(a_shard, b_shard,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=shmem_compiler_params(ctx.collective_id),
+        compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
     )(a_shard, b_shard)
+    return res[0]
 
 
 def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,

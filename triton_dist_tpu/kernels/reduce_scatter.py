@@ -165,11 +165,13 @@ def _rs_pallas(x_shard, *, n: int, axis: str, method: ReduceScatterMethod,
                collective_id: int):
     M, cols = x_shard.shape
     m_loc = M // n
-    out_shape = jax.ShapeDtypeStruct((m_loc, cols), x_shard.dtype)
+    # HBM landing/staging buffers as extra outputs (hardware forbids
+    # non-vmem scratch); kernel arg order is unchanged.
     if method == ReduceScatterMethod.ONE_SHOT:
         kernel = functools.partial(_one_shot_rs_kernel, n, axis)
+        out_shape = (jax.ShapeDtypeStruct((m_loc, cols), x_shard.dtype),
+                     jax.ShapeDtypeStruct((n, m_loc, cols), x_shard.dtype))
         scratch = [
-            pltpu.HBM((n, m_loc, cols), x_shard.dtype),
             pltpu.VMEM((m_loc, cols), jnp.float32),
             pltpu.VMEM((m_loc, cols), x_shard.dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -178,9 +180,10 @@ def _rs_pallas(x_shard, *, n: int, axis: str, method: ReduceScatterMethod,
         ]
     else:
         kernel = functools.partial(_ring_rs_kernel, n, axis)
+        out_shape = (jax.ShapeDtypeStruct((m_loc, cols), x_shard.dtype),
+                     jax.ShapeDtypeStruct((2, m_loc, cols), x_shard.dtype),
+                     jax.ShapeDtypeStruct((2, m_loc, cols), x_shard.dtype))
         scratch = [
-            pltpu.HBM((2, m_loc, cols), x_shard.dtype),
-            pltpu.HBM((2, m_loc, cols), x_shard.dtype),
             pltpu.VMEM((m_loc, cols), jnp.float32),
             pltpu.VMEM((m_loc, cols), x_shard.dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -188,15 +191,17 @@ def _rs_pallas(x_shard, *, n: int, axis: str, method: ReduceScatterMethod,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ]
-    return pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in out_shape),
         scratch_shapes=scratch,
-        compiler_params=shmem_compiler_params(collective_id),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(x_shard)
+    return res[0]
 
 
 def reduce_scatter(x_partials, *, mesh: Mesh, axis: str = "tp",

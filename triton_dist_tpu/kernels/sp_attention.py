@@ -40,7 +40,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu import language as dl
 from triton_dist_tpu.kernels.all_to_all import _a2a_pallas
-from triton_dist_tpu.kernels.flash_attn import (flash_decode,
+from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
+                                                flash_decode,
                                                 flash_decode_partial)
 from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
                                      shmem_compiler_params)
@@ -163,23 +164,12 @@ def sp_ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
 def sp_ring_attention_ref(q, k, v, *, scale: Optional[float] = None,
                           causal: bool = True):
     """Full-tensor jnp oracle (the torch attention role in the
-    reference's SP tests)."""
-    B, S, Hq, d = q.shape
-    Hkv = k.shape[1]
-    rep = Hq // Hkv
-    if scale is None:
-        scale = d ** -0.5
-    qg = q.reshape(B, S, Hkv, rep, d)
-    logits = jnp.einsum("bsgrd,bgtd->bgsrt", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    if causal:
-        si = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-        ti = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-        logits = jnp.where((ti <= si)[None, None, :, None], logits,
-                           -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    o = jnp.einsum("bgsrt,bgtd->bsgrd", p, v.astype(jnp.float32))
-    return o.reshape(B, S, Hq, d).astype(q.dtype)
+    reference's SP tests): attention_cached_ref with the prefill
+    frontier — kv_len = S for causal, shifted past the last key for
+    non-causal (the same contract the kernels use)."""
+    S = q.shape[1]
+    kv_len = S if causal else 2 * S - 1
+    return attention_cached_ref(q, k, v, kv_len, scale=scale)
 
 
 # ---------------------------------------------------------------------------
@@ -205,10 +195,12 @@ def ulysses_dispatch(x, *, mesh: Mesh, axis: str = "sp",
                        out_specs=P(None, None, axis, None),
                        check_vma=False)
     def _f(x_loc):
-        # chunk p = head group p of my seq block, layout [B, s_loc, h_loc, d]
+        # chunk p = head group p of my seq block, layout [B, s_loc, h_loc, d];
+        # flatten (h_loc, d) into the lane dim so common head sizes stay
+        # 128-aligned without padding
         chunks = (x_loc.reshape(B, s_loc, n, h_loc, d)
                        .transpose(2, 0, 1, 3, 4))
-        flat = chunks.reshape(n * B * s_loc * h_loc, d)
+        flat = chunks.reshape(n * B * s_loc, h_loc * d)
         y = _a2a_pallas(flat, n=n, axis=axis, collective_id=collective_id)
         # slot p = peer p's seq block for my head group
         recv = y.reshape(n, B, s_loc, h_loc, d)
@@ -237,10 +229,11 @@ def ulysses_combine(x, *, mesh: Mesh, axis: str = "sp",
                        out_specs=P(None, axis, None, None),
                        check_vma=False)
     def _f(x_loc):
-        # chunk p = seq block p of my head group
+        # chunk p = seq block p of my head group; (h_loc, d) flattened
+        # into the lane dim (see ulysses_dispatch)
         chunks = (x_loc.reshape(B, n, s_loc, h_loc, d)
                        .transpose(1, 0, 2, 3, 4))
-        flat = chunks.reshape(n * B * s_loc * h_loc, d)
+        flat = chunks.reshape(n * B * s_loc, h_loc * d)
         y = _a2a_pallas(flat, n=n, axis=axis, collective_id=collective_id)
         # slot p = head group p for my seq block
         recv = y.reshape(n, B, s_loc, h_loc, d)
@@ -379,8 +372,7 @@ def _gemm_a2a_call(a_loc, w_r, *, n, axis, m_loc, Nc, collective_id):
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
-        compiler_params=shmem_compiler_params(
-            collective_id if n > 1 else None),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(a_loc, w_r)
     return out[..., :Nc_out] if Nc_out != Nc else out

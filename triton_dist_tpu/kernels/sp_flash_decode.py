@@ -137,10 +137,7 @@ def _lse_combine_pallas(acc, st, *, n: int, axis: str, collective_id: int):
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
-        # n=1: barrier_all degenerates to nothing, so Mosaic forbids a
-        # collective_id (no barrier-semaphore use in the kernel)
-        compiler_params=shmem_compiler_params(
-            collective_id if n > 1 else None),
+        compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(acc, st)
     return out
@@ -291,8 +288,7 @@ def kv_cache_scatter(cache, kv_new, *, mesh: Mesh, axis: str = "sp",
             scratch_shapes=[pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(())],
             input_output_aliases={1: 0},
-            compiler_params=shmem_compiler_params(
-                collective_id if n > 1 else None),
+            compiler_params=shmem_compiler_params(collective_id, n=n),
             interpret=interpret_mode(),
         )(k_loc.astype(c_loc.dtype), c_loc)
 

@@ -168,12 +168,17 @@ def barrier_all(axis: str, barrier_sem=None) -> None:
     Requires the enclosing pallas_call to set
     compiler_params=pltpu.CompilerParams(collective_id=...).
     """
+    # single-device axis: a true no-op, BEFORE touching the barrier
+    # semaphore (Mosaic pairs get_barrier_semaphore with a collective_id,
+    # which single-device kernels must not pass)
+    n_static = _static_axis_size(axis)
+    if n_static <= 1 and barrier_sem is None:
+        return
     sem = barrier_sem if barrier_sem is not None else pltpu.get_barrier_semaphore()
     me = jax.lax.axis_index(axis)
     n = jax.lax.axis_size(axis)
     # static unroll over log2 rounds: n is static at trace time
     import math
-    n_static = _static_axis_size(axis)
     rounds = max(1, math.ceil(math.log2(n_static))) if n_static > 1 else 0
     for k in range(rounds):
         dist = 1 << k

@@ -163,16 +163,21 @@ def next_collective_id() -> int:
     return cid
 
 
-def shmem_compiler_params(collective_id: Optional[int] = None, **kwargs):
+def shmem_compiler_params(collective_id: Optional[int] = None,
+                          n: Optional[int] = None, **kwargs):
     """CompilerParams for communication kernels.
 
     Mosaic only accepts `collective_id` when the kernel actually uses the
     global barrier semaphore (pltpu.get_barrier_semaphore); pass it ONLY
-    for kernels calling dl.barrier_all. All comm kernels need
-    has_side_effects so XLA cannot DCE puts whose results flow through
-    peers' memory rather than this device's outputs.
+    for kernels calling dl.barrier_all. Pass `n` (the axis size) so the
+    single-device degenerate case — where barrier_all is a no-op and the
+    id must be dropped — is handled here once, not at every call site.
+    All comm kernels need has_side_effects so XLA cannot DCE puts whose
+    results flow through peers' memory rather than this device's outputs.
     """
     from jax.experimental.pallas import tpu as pltpu
+    if n is not None and n <= 1:
+        collective_id = None
     if collective_id is None:
         return pltpu.CompilerParams(has_side_effects=True, **kwargs)
     return pltpu.CompilerParams(has_side_effects=True,
