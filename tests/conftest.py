@@ -64,6 +64,12 @@ if not _real:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") +
         " --xla_force_host_platform_device_count=8")
+    # Serialize CPU programs: with async dispatch, two back-to-back jit
+    # programs containing interpreted Pallas kernels can interleave and
+    # skew the interpreter's global device barrier (observed as rare
+    # hangs/aborts mid-suite). Dispatch sync costs a little wall time
+    # and removes the whole failure class.
+    os.environ.setdefault("JAX_CPU_ENABLE_ASYNC_DISPATCH", "false")
 
 def _force_cpu_backend():
     import jax
@@ -85,6 +91,44 @@ if not _NEEDS_SHIM:
     _force_cpu_backend()
 
 import pytest  # noqa: E402
+
+
+def cpu_mesh_env(extra=None):
+    """Env for subprocess test cases: the same virtual-CPU-mesh
+    substrate the parent runs on (subprocesses don't inherit the
+    in-process backend forcing)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    if os.path.exists(_SHIM) and "fakecpus" not in env.get("LD_PRELOAD", ""):
+        env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
+        env.setdefault("FAKE_NPROC", "32")
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _reset_interpreter_state():
+    """Reset the Pallas TPU interpreter's global shared-memory state
+    between test modules: long single-process runs can otherwise
+    accumulate skewed barrier/semaphore state across hundreds of
+    interpreted kernels (observed as a rare deadlock-abort deep into
+    the suite). Interpreter-only: skipped on real devices, where it
+    would just throw away compilation caches."""
+    yield
+    if _real:
+        return
+    try:
+        import jax
+        from jax.experimental.pallas import tpu as pltpu
+        jax.clear_caches()
+        pltpu.reset_tpu_interpret_mode_state()
+    except Exception:
+        pass
 
 
 @pytest.fixture(scope="session")

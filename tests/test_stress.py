@@ -1,0 +1,233 @@
+"""Stress/straggler/hang tests for the comm-kernel semaphore protocols
+(reference analogs: test/stress/stress_test_ag_gemm.py:74-133,
+--verify_hang in test/nvidia/test_allreduce.py:190-196, straggler env
+hook allgather_gemm.py:660-661).
+
+Runs the ring/credit protocols at n in {2, 3, 4, 8} — including the
+two-shot AR / ring RS drain edge cases at n=2 and n=3 — with randomized
+data, a per-case hang watchdog, and an injected straggler."""
+
+import functools
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels import (AllGatherMethod, AllReduceMethod,
+                                     all_gather, all_reduce, gemm_rs,
+                                     create_gemm_rs_context,
+                                     reduce_scatter)
+from triton_dist_tpu.runtime.stress import (HangError, races_found,
+                                            straggler_tax, watchdog)
+
+from conftest import cpu_mesh_env as _cpu_mesh_env  # noqa: E402
+
+TIMEOUT = 180.0
+
+
+def submesh(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]), ("tp",))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_stress_allreduce_two_shot(n):
+    """Randomized two-shot AR stress incl. the n=2/n=3 drain edges."""
+    mesh = submesh(n)
+    rng = np.random.RandomState(n)
+    for it in range(3):
+        M = n * rng.choice([2, 4, 8])
+        cols = 128 * rng.choice([1, 2])
+        x = rng.randn(n, M, cols).astype(np.float32)
+        xs = jax.device_put(jnp.asarray(x),
+                            NamedSharding(mesh, P("tp", None, None)))
+        out = watchdog(
+            functools.partial(
+                jax.jit(lambda v: all_reduce(
+                    v, mesh=mesh, method=AllReduceMethod.TWO_SHOT)), xs),
+            TIMEOUT, f"two_shot_ar n={n} it={it}")
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), atol=1e-4,
+                                   rtol=1e-5, err_msg=f"n={n} it={it}")
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+def test_stress_ring_reduce_scatter(n):
+    mesh = submesh(n)
+    rng = np.random.RandomState(10 + n)
+    for it in range(3):
+        M = n * rng.choice([4, 8])
+        x = rng.randn(n, M, 128).astype(np.float32)
+        xs = jax.device_put(jnp.asarray(x),
+                            NamedSharding(mesh, P("tp", None, None)))
+        out = watchdog(
+            functools.partial(
+                jax.jit(lambda v: reduce_scatter(v, mesh=mesh)), xs),
+            TIMEOUT, f"ring_rs n={n} it={it}")
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), atol=1e-4,
+                                   rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", [3, 8])
+def test_stress_ring_allgather(n):
+    mesh = submesh(n)
+    rng = np.random.RandomState(20 + n)
+    for it in range(2):
+        x = rng.randn(n * 4, 128).astype(np.float32)
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("tp")))
+        out = watchdog(
+            functools.partial(
+                jax.jit(lambda v: all_gather(
+                    v, mesh=mesh, method=AllGatherMethod.RING)), xs),
+            TIMEOUT, f"ring_ag n={n} it={it}")
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+
+@pytest.mark.parametrize("rank", [0, 1])
+def test_straggler_two_shot_ar(rank):
+    """One late device must not corrupt the credit/slot protocol."""
+    n = len(jax.devices())
+    mesh = submesh(n)
+    rng = np.random.RandomState(rank)
+    x = rng.randn(n, n * 4, 128).astype(np.float32)
+    xs = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P("tp", None, None)))
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P("tp", None, None),
+                       out_specs=P("tp", None, None), check_vma=False)
+    def slow_partials(v):
+        me = jax.lax.axis_index("tp")
+        return straggler_tax(v, me, rank)
+
+    def run(v):
+        return all_reduce(slow_partials(v), mesh=mesh,
+                          method=AllReduceMethod.TWO_SHOT)
+
+    out = watchdog(functools.partial(jax.jit(run), xs), TIMEOUT,
+                   f"straggler_ar rank={rank}")
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), atol=1e-4,
+                               rtol=1e-5)
+
+
+def test_straggler_gemm_rs():
+    n = len(jax.devices())
+    mesh = submesh(n)
+    rng = np.random.RandomState(3)
+    M, K, N = 4 * n, 32 * n, 128
+    a = rng.randn(M, K).astype(np.float32)
+    b = rng.randn(K, N).astype(np.float32) / np.sqrt(K)
+    a_s = jax.device_put(jnp.asarray(a), NamedSharding(mesh, P(None, "tp")))
+    b_s = jax.device_put(jnp.asarray(b), NamedSharding(mesh, P("tp", None)))
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(None, "tp"), out_specs=P(None, "tp"),
+                       check_vma=False)
+    def slow_a(v):
+        me = jax.lax.axis_index("tp")
+        return straggler_tax(v, me, n - 1)
+
+    ctx = create_gemm_rs_context(mesh)
+    out = watchdog(
+        functools.partial(jax.jit(lambda u, w: gemm_rs(slow_a(u), w, ctx)),
+                          a_s, b_s),
+        TIMEOUT, "straggler_gemm_rs")
+    with jax.default_matmul_precision("highest"):
+        ref = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-3, rtol=1e-4)
+
+
+def test_race_detector_clean_on_comm_kernels():
+    """All comm kernels run under the interpreter's race detector with
+    no race reports (reference: the compute-sanitizer CI hook,
+    launch.sh:160-163). Runs in a subprocess because TDTPU_DETECT_RACES
+    must be set before kernels trace."""
+    code = r"""
+import os
+os.environ["TDTPU_DETECT_RACES"] = "1"
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from triton_dist_tpu.kernels import (all_gather, AllGatherMethod,
+    all_reduce, AllReduceMethod, reduce_scatter)
+from triton_dist_tpu.runtime.stress import races_found
+n = len(jax.devices())
+mesh = jax.make_mesh((n,), ("tp",))
+x = np.random.RandomState(0).randn(n, n * 2, 128).astype(np.float32)
+xp = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("tp", None, None)))
+xs = jax.device_put(jnp.asarray(x[0]), NamedSharding(mesh, P("tp")))
+for name, fn in (
+    ("ag_one_shot", lambda: all_gather(xs, mesh=mesh,
+                                       method=AllGatherMethod.ONE_SHOT)),
+    ("ag_ring", lambda: all_gather(xs, mesh=mesh,
+                                   method=AllGatherMethod.RING)),
+    ("ar_one_shot", lambda: all_reduce(xp, mesh=mesh,
+                                       method=AllReduceMethod.ONE_SHOT)),
+    ("ar_two_shot", lambda: all_reduce(xp, mesh=mesh,
+                                       method=AllReduceMethod.TWO_SHOT)),
+    ("reduce_scatter", lambda: reduce_scatter(xp, mesh=mesh)),
+):
+    jax.block_until_ready(jax.jit(fn)())
+    # the interpreter recreates its race state per pallas_call, so the
+    # verdict must be read after EVERY kernel, not once at the end
+    found = races_found()
+    assert found is not None, f"race detector never engaged ({name})"
+    assert found is False, f"RACE DETECTED in {name} (see stdout)"
+print("RACECHECK_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          env=_cpu_mesh_env(), capture_output=True,
+                          text=True, timeout=1200)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "RACECHECK_OK" in proc.stdout
+
+
+def test_watchdog_flags_hang():
+    """The watchdog itself must detect a deadlock. Subprocess-isolated:
+    a hung interpreter poisons the process (like a stuck communicator)."""
+    code = r"""
+import functools, jax, jax.numpy as jnp, numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from triton_dist_tpu.runtime import interpret_mode, shmem_compiler_params
+from triton_dist_tpu.runtime.stress import HangError, watchdog
+
+def _kernel(x_ref, o_ref, sem):
+    # wait on a semaphore nobody signals
+    pltpu.semaphore_wait(sem, 1)
+    pltpu.sync_copy(x_ref, o_ref)
+
+def hang(x):
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+        compiler_params=shmem_compiler_params(None),
+        interpret=interpret_mode(),
+    )(x)
+
+n = len(jax.devices())
+mesh = jax.make_mesh((n,), ("tp",))
+x = jax.device_put(jnp.ones((n * 2, 128)), NamedSharding(mesh, P("tp")))
+f = jax.jit(lambda v: jax.shard_map(hang, mesh=mesh, in_specs=P("tp"),
+                                    out_specs=P("tp"), check_vma=False)(v))
+try:
+    watchdog(functools.partial(f, x), 20.0, "deliberate-hang")
+except HangError:
+    print("WATCHDOG_OK")
+else:
+    print("WATCHDOG_MISSED")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          env=_cpu_mesh_env(), capture_output=True,
+                          text=True, timeout=1200)
+    assert "WATCHDOG_OK" in proc.stdout, (proc.stdout[-2000:],
+                                          proc.stderr[-2000:])

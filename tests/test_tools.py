@@ -1,0 +1,121 @@
+"""Autotuner + perf-model tests (reference analogs:
+python/triton_dist/tools/tune.py's cache/consensus behavior and the
+gemm_perf_model sanity checks)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.tools import (AutoTuner, autotune, chip_specs,
+                                   clear_cache, collective_sol_us,
+                                   gemm_sol_us, sol_report)
+
+
+@pytest.fixture()
+def cache_path(tmp_path):
+    return str(tmp_path / "autotune.json")
+
+
+def test_autotuner_picks_fastest_and_caches(cache_path):
+    calls = {"n": 0}
+
+    def op(x, *, block):
+        calls["n"] += 1
+        # block=2 artificially slow: burn host time the timer sees
+        if block == 2:
+            import time
+            time.sleep(0.01)
+        return x * block
+
+    tuner = AutoTuner(op, [{"block": 2}, {"block": 3}],
+                      cache_path=cache_path, iters=1, warmup=0)
+    x = jnp.ones((4, 4))
+    cfg = tuner.pick(x)
+    assert cfg == {"block": 3}
+    n_after_tune = calls["n"]
+    # cached: replay without re-measuring
+    out = tuner(x)
+    assert calls["n"] == n_after_tune + 1
+    np.testing.assert_array_equal(np.asarray(out), 3 * np.ones((4, 4)))
+    # on-disk cache has the entry
+    with open(cache_path) as f:
+        disk = json.load(f)
+    (entry,) = disk.values()
+    assert entry["cfg"] == {"block": 3}
+
+
+def test_autotuner_cache_survives_new_instance(cache_path):
+    def op(x, *, block):
+        return x + block
+
+    t1 = AutoTuner(op, [{"block": 1}, {"block": 2}],
+                   cache_path=cache_path, iters=1, warmup=0)
+    cfg1 = t1.pick(jnp.ones((2, 2)))
+    measured = {"n": 0}
+
+    def op2(x, *, block):
+        measured["n"] += 1
+        return x + block
+
+    t2 = AutoTuner(op2, [{"block": 1}, {"block": 2}], name=op.__name__,
+                   cache_path=cache_path, iters=1, warmup=0)
+    cfg2 = t2.pick(jnp.ones((2, 2)))
+    assert cfg2 == cfg1 and measured["n"] == 0   # pure cache hit
+
+
+def test_autotuner_distinct_signatures(cache_path):
+    def op(x, *, block):
+        return x * block
+
+    t = AutoTuner(op, [{"block": 1}, {"block": 4}],
+                  cache_path=cache_path, iters=1, warmup=0)
+    t.pick(jnp.ones((2, 2)))
+    t.pick(jnp.ones((8, 8)))
+    with open(cache_path) as f:
+        assert len(json.load(f)) == 2
+
+
+def test_autotune_decorator_skips_failing_config(cache_path):
+    @autotune([{"block": 7}, {"block": 8}], cache_path=cache_path,
+              iters=1, warmup=0)
+    def op(x, *, block):
+        if block == 7:
+            raise ValueError("illegal tile")
+        return x * block
+
+    out = op(jnp.ones((2, 2)))
+    np.testing.assert_array_equal(np.asarray(out), 8 * np.ones((2, 2)))
+
+
+def test_clear_cache(cache_path):
+    def op(x, *, b):
+        return x
+
+    AutoTuner(op, [{"b": 1}], cache_path=cache_path, iters=1,
+              warmup=0).pick(jnp.ones(2))
+    assert os.path.exists(cache_path)
+    clear_cache(cache_path)
+    assert not os.path.exists(cache_path)
+
+
+def test_perf_models_sanity():
+    spec = chip_specs("TPU v5e")
+    assert spec.name == "v5e"
+    # square bf16 GEMM large enough to be FLOPs-bound
+    t = gemm_sol_us(4096, 4096, 4096, spec=spec)
+    flops = 2 * 4096 ** 3
+    assert abs(t - flops / (spec.bf16_tflops * 1e12) * 1e6) / t < 1e-6
+    # tiny GEMM is bandwidth-bound
+    t2 = gemm_sol_us(8, 4096, 4096, spec=spec)
+    assert t2 > 2 * 8 * 4096 * 4096 / (spec.bf16_tflops * 1e12) * 1e6
+    # AR moves 2(n-1)/n, AG (n-1)/n: ratio 2
+    ag = collective_sol_us("ag", 1 << 20, 8, spec=spec)
+    ar = collective_sol_us("ar", 1 << 20, 8, spec=spec)
+    assert abs(ar / ag - 2.0) < 1e-9
+    assert collective_sol_us("ag", 1 << 20, 1, spec=spec) == 0.0
+    line = sol_report("ag_gemm", 100.0, 80.0)
+    assert "80.0" in line and "%" in line

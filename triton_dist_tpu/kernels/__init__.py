@@ -54,6 +54,9 @@ from triton_dist_tpu.kernels.sp_flash_decode import (  # noqa: F401
     sp_flash_decode,
     sp_flash_decode_ref,
 )
+from triton_dist_tpu.kernels.p2p import (  # noqa: F401
+    p2p_shift,
+)
 from triton_dist_tpu.kernels.sp_attention import (  # noqa: F401
     gemm_all_to_all,
     qkv_gemm_a2a,

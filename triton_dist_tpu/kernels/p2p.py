@@ -1,0 +1,81 @@
+"""P2P pipeline-parallel primitives over ICI.
+
+TPU-native re-design of the reference P2P kernels
+(`python/triton_dist/kernels/nvidia/p2p.py`: one-sided `p2p_put` :33,
+signal/wait pairs :72-119 used by the PP comm layer
+`layers/nvidia/pp_block.py:102`). On TPU the stage handoff is a
+neighbor put over the `pp` mesh axis: the sender DMAs its activation
+into the receiver's landing buffer and the receiver's semaphore wait is
+the recv. The shift is cyclic (uniform SPMD — every stage sends and
+receives exactly once); non-cyclic pipelines simply ignore the wrapped
+value at stage 0 (the schedule injects a fresh microbatch there).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+def _p2p_shift_kernel(n: int, axis: str, reverse: bool,
+                      x_ref, o_ref, send_sem, recv_sem):
+    """Cyclic neighbor shift: device i's x lands in device (i+1)%n's o
+    (reverse: (i-1)%n). Ref: p2p.py:33 `p2p_put` + the signal wait at
+    :72 — one put, one arrival, one drain."""
+    left, right = dl.ring_neighbors(axis)
+    dst = left if reverse else right
+    dl.barrier_all(axis)
+    dl.putmem_nbi(o_ref, x_ref, send_sem, recv_sem, dst, axis)
+    pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+    dl.quiet(send_sem, x_ref, 1)
+
+
+def _p2p_pallas(x_loc, *, n: int, axis: str, reverse: bool,
+                collective_id: int):
+    if n == 1:
+        return x_loc
+    kernel = functools.partial(_p2p_shift_kernel, n, axis, reverse)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x_loc.shape, x_loc.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=shmem_compiler_params(collective_id, n=n),
+        interpret=interpret_mode(),
+    )(x_loc)
+
+
+def p2p_shift(x, *, mesh: Mesh, axis: str = "pp", reverse: bool = False,
+              collective_id: Optional[int] = None):
+    """Cyclic stage handoff: x [n, ...] sharded on dim 0 over `axis`;
+    returns y with y[(i+1)%n] = x[i] (reverse: y[(i-1)%n] = x[i]) — the
+    forward (backward) activation/grad handoff of a pipeline (reference:
+    p2p.py:33-119 + pp_block.py:102)."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    if collective_id is None:
+        collective_id = next_collective_id()
+    spec = P(axis, *(None,) * (x.ndim - 1))
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=spec, check_vma=False)
+    def _f(x_loc):
+        flat = x_loc.reshape(-1, x_loc.shape[-1])
+        y = _p2p_pallas(flat, n=n, axis=axis, reverse=reverse,
+                        collective_id=collective_id)
+        return y.reshape(x_loc.shape)
+
+    return _f(x)

@@ -17,3 +17,4 @@ from triton_dist_tpu.layers.sp_attn import (  # noqa: F401
     SPAttn,
     UlyssesAttn,
 )
+from triton_dist_tpu.layers.pp import PPipeline  # noqa: F401

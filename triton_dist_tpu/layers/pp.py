@@ -1,0 +1,103 @@
+"""Pipeline-parallel comm layer and schedule.
+
+TPU-native re-design of the reference PP layer
+(`python/triton_dist/layers/nvidia/pp_block.py`: `PPCommLayer` :102 —
+p2p send/recv of activations between consecutive stages — and the
+microbatch schedule it drives). On TPU the stages are the `pp` axis of
+the device mesh; every stage holds its block's parameters (stacked
+leaves sharded on dim 0) and the handoff is the one-sided p2p shift
+kernel. The schedule is GPipe-style: with M microbatches and n stages
+the loop runs M + n - 1 ticks; at tick t stage s works on microbatch
+t - s (bubble ticks compute on garbage and are masked at the edges —
+the SPMD-uniform formulation, same shape as the reference's per-rank
+send/recv ordering but without any rank-divergent control flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.p2p import _p2p_pallas
+from triton_dist_tpu.runtime import next_collective_id
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PPipeline:
+    """A pipeline of n identical-shaped stages.
+
+    stage_params: a pytree whose leaves are stacked [n_stages, ...] and
+    sharded on dim 0 over `axis`; stage_fn(params_slice, x) -> y is the
+    per-stage compute (params_slice has the stacked dim removed).
+    """
+
+    stage_params: object
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    stage_fn: Callable = dataclasses.field(metadata=dict(static=True))
+
+    @staticmethod
+    def init(stage_params, stage_fn, *, mesh: Mesh, axis: str = "pp"):
+        def put(leaf):
+            leaf = jnp.asarray(leaf)
+            spec = P(axis, *(None,) * (leaf.ndim - 1))
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        return PPipeline(stage_params=jax.tree.map(put, stage_params),
+                         mesh=mesh, axis=axis, stage_fn=stage_fn)
+
+    def __call__(self, x_mb):
+        """x_mb: [M, B, D] microbatches, replicated. Returns [M, B, D]:
+        each microbatch passed through all n stages in order."""
+        n = self.mesh.shape[self.axis]
+        M, B, D = x_mb.shape
+        axis = self.axis
+        fn = self.stage_fn
+        cid = next_collective_id()
+
+        p_specs = jax.tree.map(
+            lambda l: P(axis, *(None,) * (l.ndim - 1)), self.stage_params)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(p_specs, P(*(None,) * 3)),
+            out_specs=P(*(None,) * 3), check_vma=False)
+        def run(params_loc, mb):
+            me = jax.lax.axis_index(axis)
+            params = jax.tree.map(lambda l: l[0], params_loc)
+
+            def tick(t, carry):
+                reg, outs = carry
+                # stage 0 swaps in microbatch t (clamped; bubble ticks
+                # at t >= M re-feed the last mb and are masked below)
+                inject = jax.lax.dynamic_index_in_dim(
+                    mb, jnp.clip(t, 0, M - 1), keepdims=False)
+                cur = jnp.where(me == 0, inject, reg)
+                y = fn(params, cur)
+                # last stage banks microbatch t-(n-1); other stages'
+                # contribution is masked out by the psum of a zero
+                out_slot = jnp.clip(t - (n - 1), 0, M - 1)
+                bank = jnp.where((me == n - 1) & (t >= n - 1),
+                                 y, jnp.zeros_like(y))
+                outs = jax.lax.dynamic_update_index_in_dim(
+                    outs, outs[out_slot] + bank, out_slot, axis=0)
+                # handoff: stage s's y becomes stage s+1's register
+                reg = _p2p_pallas(y.reshape(-1, y.shape[-1]), n=n,
+                                  axis=axis, reverse=False,
+                                  collective_id=cid).reshape(y.shape)
+                return reg, outs
+
+            outs0 = jnp.zeros((M, B, D), x_mb.dtype)
+            reg0 = jnp.zeros((B, D), x_mb.dtype)
+            _, outs = jax.lax.fori_loop(0, M + n - 1, tick, (reg0, outs0))
+            # only the last stage banked non-zeros; psum replicates its
+            # values to every stage (the out spec says replicated)
+            return jax.lax.psum(outs, axis)
+
+        return run(self.stage_params, x_mb)

@@ -1,0 +1,87 @@
+"""Stress/straggler/hang harness for the semaphore protocols.
+
+TPU-native re-design of the reference stress tooling
+(`test/stress/stress_test_ag_gemm.py:74-133` randomized stress loops,
+the straggler injection hook `kernels/nvidia/allgather_gemm.py:660-661`
+(`TRITON_DIST_DEBUG_STRAGGLER`), `--verify_hang` in
+`test/nvidia/test_allreduce.py:190-196`, and the compute-sanitizer hook
+`launch.sh:160-163` whose TPU answer is the interpreter's shared-memory
+race detector).
+
+Pieces:
+  - ``straggler_tax``: device-dependent busy work injected BEFORE a comm
+    kernel so one device arrives late — the skew that breaks buggy
+    credit/slot protocols (late producer, early consumer).
+  - ``watchdog``: runs a computation on a daemon thread with a deadline;
+    a deadlock surfaces as a clean HANG verdict instead of a stuck CI.
+  - ``race_state`` helpers: read/reset the Pallas interpreter's race
+    detector (enabled via TDTPU_DETECT_RACES=1).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def straggler_tax(x, me, rank, *, iters: int = 30, size: int = 256):
+    """Return `x` unchanged, but make device `rank` burn ~iters matmuls
+    of [size, size] first (the skew source; reference:
+    TRITON_DIST_DEBUG_STRAGGLER, allgather_gemm.py:660-661). `me` is the
+    traced axis index inside shard_map; the tax threads into x as a +0
+    so XLA cannot reorder the kernel above it."""
+    a0 = jnp.full((size, size), 1.0 + 1e-6, jnp.float32)
+
+    def heavy(a):
+        def body(i, v):
+            return (v @ a0) * (1.0 / size)
+        return jax.lax.fori_loop(0, iters, body, a)
+
+    out = jax.lax.cond(me == rank, heavy, lambda a: a, a0)
+    return x + (out[0, 0] * 0).astype(x.dtype)
+
+
+class HangError(RuntimeError):
+    pass
+
+
+def watchdog(fn: Callable[[], Any], timeout_s: float,
+             label: str = "computation"):
+    """Run fn() to completion on a daemon thread; raise HangError if it
+    misses the deadline (reference: --verify_hang,
+    test_allreduce.py:190-196). The hung thread is left behind
+    deliberately — the process must be considered poisoned after a hang,
+    exactly like a stuck NCCL communicator."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["value"] = jax.block_until_ready(fn())
+        except BaseException as e:   # pragma: no cover - surfaced below
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise HangError(f"HANG: {label} still running after {timeout_s}s")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def races_found() -> Optional[bool]:
+    """True/False once the interpreter's race detector has run; None if
+    it never engaged (e.g. on real hardware). The interpreter recreates
+    its race state per pallas_call — read the verdict after every
+    kernel of interest (a full reset is
+    pltpu.reset_tpu_interpret_mode_state())."""
+    try:
+        from jax._src.pallas.mosaic.interpret import (
+            interpret_pallas_call as _ipc)
+    except ImportError:   # pragma: no cover
+        return None
+    return None if _ipc.races is None else bool(_ipc.races.races_found)

@@ -1,0 +1,16 @@
+"""Tooling (reference analog: python/triton_dist/tools/ + autotuner/,
+SURVEY.md §2.8): function-level autotuner with an on-disk cache and
+distributed consensus, and speed-of-light perf models for ICI/MXU."""
+
+from triton_dist_tpu.tools.tune import (  # noqa: F401
+    AutoTuner,
+    autotune,
+    clear_cache,
+    default_cache_path,
+)
+from triton_dist_tpu.tools.perf_model import (  # noqa: F401
+    chip_specs,
+    collective_sol_us,
+    gemm_sol_us,
+    sol_report,
+)

@@ -1,0 +1,97 @@
+"""Speed-of-light performance models for TPU GEMMs and ICI collectives.
+
+TPU-native re-design of the reference perf models
+(`python/triton_dist/kernels/nvidia/gemm_perf_model.py`:
+`get_tensorcore_tflops` :220 / `get_dram_gbps` and the comm SOL math in
+`utils.py`'s perf reporting). The per-op tests and the bench report
+achieved/SOL so regressions are attributable to the kernel, not the
+chip: a 0.9 SOL GEMM that got slower means the schedule broke; a 0.2
+SOL collective means the protocol serialized.
+
+Numbers are public per-chip specs (Google Cloud TPU docs); unknown
+chips fall back conservatively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float          # dense MXU peak
+    hbm_gbps: float             # HBM bandwidth per chip
+    ici_gbps_per_link: float    # one direction, one link
+    ici_links: int              # torus links per chip
+
+
+_SPECS = {
+    "v4": ChipSpec("v4", 275.0, 1228.0, 50.0, 6),
+    "v5e": ChipSpec("v5e", 197.0, 819.0, 50.0, 4),
+    "v5p": ChipSpec("v5p", 459.0, 2765.0, 100.0, 6),
+    "v6e": ChipSpec("v6e", 918.0, 1640.0, 100.0, 4),
+}
+_FALLBACK = ChipSpec("unknown", 100.0, 500.0, 25.0, 4)
+
+
+_ALIASES = {
+    "v5 lite": "v5e", "v5litepod": "v5e", "v5lite": "v5e",
+    "v6 lite": "v6e", "v6lite": "v6e",
+}
+
+
+def chip_specs(device_kind: Optional[str] = None) -> ChipSpec:
+    if device_kind is None:
+        d = jax.devices()[0]
+        device_kind = getattr(d, "device_kind", "") or d.platform
+    kind = device_kind.lower()
+    for alias, key in _ALIASES.items():
+        if alias in kind:
+            return _SPECS[key]
+    for key, spec in _SPECS.items():
+        if key in kind:
+            return spec
+    return _FALLBACK
+
+
+def gemm_sol_us(M: int, K: int, N: int, *, itemsize: int = 2,
+                spec: Optional[ChipSpec] = None) -> float:
+    """max(MXU time, HBM time) for one M*K@K*N GEMM (reference:
+    get_gemm_time in gemm_perf_model.py — tensor-core vs DRAM bound)."""
+    spec = spec or chip_specs()
+    flops = 2.0 * M * K * N
+    t_mxu = flops / (spec.bf16_tflops * 1e12)
+    nbytes = itemsize * (M * K + K * N + M * N)
+    t_hbm = nbytes / (spec.hbm_gbps * 1e9)
+    return max(t_mxu, t_hbm) * 1e6
+
+
+def collective_sol_us(op: str, nbytes: int, n: int, *,
+                      spec: Optional[ChipSpec] = None) -> float:
+    """Ring-lower-bound time for `nbytes` of payload per device over an
+    n-chip ICI ring (reference analog: the NVLink busbw SOL the perf
+    tests print). ops: ag | rs | ar | a2a | p2p."""
+    if n <= 1:
+        return 0.0
+    spec = spec or chip_specs()
+    bw = spec.ici_gbps_per_link * 1e9 * 2   # bidirectional ring: 2 links
+    factor = {
+        "ag": (n - 1) / n,
+        "rs": (n - 1) / n,
+        "ar": 2 * (n - 1) / n,
+        "a2a": (n - 1) / n,
+        "p2p": 1.0,
+    }[op]
+    return factor * nbytes / bw * 1e6
+
+
+def sol_report(name: str, achieved_us: float, sol_us: float) -> str:
+    """One report line, reference-style: achieved vs SOL and the ratio
+    (reference prints e.g. 'xx TFLOPS, yy% of peak')."""
+    ratio = sol_us / achieved_us if achieved_us > 0 else 0.0
+    return (f"{name}: {achieved_us:8.1f} us achieved, "
+            f"{sol_us:8.1f} us SOL, {100.0 * ratio:5.1f}% of SOL")

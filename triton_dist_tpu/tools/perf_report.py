@@ -1,0 +1,185 @@
+"""Per-op perf report: achieved vs speed-of-light for every comm/compute
+kernel family (reference analog: the perf printout of each
+test/nvidia/test_*.py `--case perf` run, backed by
+gemm_perf_model.py:220).
+
+Run:  python -m triton_dist_tpu.tools.perf_report [--json PATH]
+
+On a TPU backend the numbers are real; on the CPU interpreter substrate
+they measure the simulator (still useful for relative regressions, and
+flagged as such in the output).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.tools.perf_model import (chip_specs,
+                                              collective_sol_us,
+                                              gemm_sol_us, sol_report)
+
+
+def _repeat(step, x0, k):
+    """One jit program: `k` data-chained executions of `step` inside a
+    fori_loop (one kernel compile regardless of k; the chain defeats
+    CSE/reordering), reduced to a scalar so readback is tiny."""
+    shd = getattr(x0, "sharding", None)
+    if not isinstance(shd, NamedSharding):
+        shd = None
+
+    def body(i, v):
+        out = step(v)
+        # restore the carry's sharding (free when unchanged; a local
+        # slice when the op replicated its output)
+        return jax.reshard(out, shd) if shd is not None else out
+
+    @jax.jit
+    def prog(x):
+        out = jax.lax.fori_loop(0, k, body, x)
+        return jnp.sum(jax.tree.leaves(out)[0]).astype(jnp.float32)
+
+    return functools.partial(prog, x0)
+
+
+def _time(step, x0, *, k1=64, k2=1024, reps=3):
+    """Two-point amortized timing: per-op time is the slope between a
+    k1-iteration and a k2-iteration loop program, cancelling the
+    (large, on tunneled backends) constant dispatch/readback overhead.
+    `step(x) -> x_like` must thread a data dependence."""
+    f1, f2 = _repeat(step, x0, k1), _repeat(step, x0, k2)
+    # float() forces a host readback: block_until_ready does not
+    # reliably block on tunneled backends (same workaround as bench.py)
+    float(f1())
+    float(f2())
+
+    def best(f):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(f())
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t1, t2 = best(f1), best(f2)
+    return max((t2 - t1) / (k2 - k1), 1e-9) * 1e6   # us
+
+
+def run_report(write_json=None):
+    from triton_dist_tpu.kernels import (
+        AllGatherMethod, AllReduceMethod, ag_gemm, all_gather, all_reduce,
+        create_ag_gemm_context, create_gemm_ar_context,
+        create_gemm_rs_context, flash_decode, gemm_allreduce, gemm_rs,
+        reduce_scatter)
+
+    ndev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = jax.make_mesh((ndev,), ("tp",))
+    spec = chip_specs()
+    n = ndev
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    isz = jnp.dtype(dt).itemsize
+    if on_tpu:
+        # M sized so the fused kernels' whole-activation VMEM staging
+        # fits a single chip's 16MB scoped vmem even at n=1 (m_loc = M)
+        M, K, N = 256, 4096, 4096
+    else:
+        M, K, N = 64, 128, 256
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(M, K), dt)
+    b = jnp.asarray(rng.randn(K, N), dt)
+    x = jnp.asarray(rng.randn(M * n, N), dt)
+    xs = jax.device_put(x, NamedSharding(mesh, P("tp")))
+    xp = jax.device_put(jnp.broadcast_to(x[None] / n, (n,) + x.shape),
+                        NamedSharding(mesh, P("tp", None, None)))
+    a_cols = jax.device_put(a, NamedSharding(mesh, P(None, "tp")))
+    b_rows = jax.device_put(b, NamedSharding(mesh, P("tp", None)))
+
+    rows = []
+
+    def add(name, step, x0, sol_us, note=""):
+        t = _time(step, x0)
+        rows.append({"op": name, "achieved_us": t, "sol_us": sol_us,
+                     "sol_frac": sol_us / t if t else 0.0,
+                     "note": note})
+        print(sol_report(name, t, sol_us) + (f"  [{note}]" if note else ""))
+
+    # Each step threads its output back into its input (same shape;
+    # XLA inserts a free reshard where the sharding differs) so the
+    # fori_loop chain is serial. The feed itself costs bandwidth for
+    # the AR/RS partials rebuild — noted per row.
+    shard_bytes = M * N * isz
+    add("all_gather(one_shot)",
+        lambda v: all_gather(v, mesh=mesh,
+                             method=AllGatherMethod.ONE_SHOT), xs,
+        collective_sol_us("ag", shard_bytes, n, spec=spec))
+    add("all_gather(ring)",
+        lambda v: all_gather(v, mesh=mesh, method=AllGatherMethod.RING),
+        xs, collective_sol_us("ag", shard_bytes, n, spec=spec))
+    add("all_reduce(one_shot)",
+        lambda v: v * 0 + all_reduce(v, mesh=mesh,
+                                     method=AllReduceMethod.ONE_SHOT)[None],
+        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec),
+        note="includes partials rebuild")
+    add("all_reduce(two_shot)",
+        lambda v: v * 0 + all_reduce(v, mesh=mesh,
+                                     method=AllReduceMethod.TWO_SHOT)[None],
+        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec),
+        note="includes partials rebuild")
+    add("reduce_scatter",
+        lambda v: v * 0 + reduce_scatter(v, mesh=mesh)[None],
+        xp, collective_sol_us("rs", n * M * N * isz, n, spec=spec),
+        note="includes partials rebuild")
+    gemm_sol = gemm_sol_us(M, K, N, itemsize=isz, spec=spec)
+    a_rows = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
+    b_cols = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
+    ag_ctx = create_ag_gemm_context(mesh)
+    rs_ctx = create_gemm_rs_context(mesh)
+    ar_ctx = create_gemm_ar_context(mesh)
+    add("ag_gemm",
+        lambda v: ag_gemm(v, b_cols, ag_ctx)[:, :K], a_rows,
+        gemm_sol + collective_sol_us("ag", M // n * K * isz, n, spec=spec))
+    add("gemm_rs",
+        lambda v: gemm_rs(v, b_rows, rs_ctx)[:, :K], a_cols,
+        gemm_sol + collective_sol_us("rs", M * N * isz, n, spec=spec))
+    add("gemm_allreduce",
+        lambda v: gemm_allreduce(v, b_rows, ar_ctx)[:, :K], a_cols,
+        gemm_sol + collective_sol_us("ar", M * N * isz, n, spec=spec))
+
+    # flash decode: B=8 heads=16/8 T=2048
+    B, S, Hq, Hkv, T, d = (8, 1, 16, 8, 2048, 128) if on_tpu else \
+                          (2, 1, 4, 2, 256, 64)
+    q = jnp.asarray(rng.randn(B, S, Hq, d), dt)
+    k = jnp.asarray(rng.randn(B, Hkv, T, d), dt)
+    v = jnp.asarray(rng.randn(B, Hkv, T, d), dt)
+    kv_bytes = 2 * B * Hkv * T * d * isz
+    add("flash_decode",
+        lambda u: flash_decode(u, k, v, jnp.int32(T)), q,
+        kv_bytes / (spec.hbm_gbps * 1e9) * 1e6)
+
+    header = {"backend": jax.default_backend(), "ndev": ndev,
+              "chip": spec.name, "interpreted": not on_tpu}
+    out = {"env": header, "ops": rows}
+    if write_json:
+        with open(write_json, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {write_json}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    run_report(args.json)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,185 @@
+"""Function-level autotuner with an on-disk JSON cache and distributed
+consensus.
+
+TPU-native re-design of the reference autotuner
+(`python/triton_dist/tools/tune.py`: `AutoTuner` :280, the `autotune`
+decorator :498, the JSON cache keyed by a hardware/software hash
+:255-279, and the cross-rank consensus that keeps every rank running
+the same config — a divergent tile size in a collective kernel is a
+deadlock). Differences that make it TPU-shaped:
+
+  - the cache key hashes (device kind, jax version, function name,
+    shapes/dtypes, config space) — the analog of the reference's
+    (arch, CUDA version, triton hash) key;
+  - timing uses jit-compiled calls with `block_until_ready`, warmed up
+    once so Mosaic compile time never pollutes a measurement;
+  - consensus: every process measures, the per-config times are summed
+    across processes (`psum` when jax.distributed is initialized), and
+    argmin of the SUM picks the config — deterministic everywhere, the
+    same scheme the reference uses over torch.distributed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+_CACHE_ENV = "TDTPU_AUTOTUNE_CACHE"
+
+
+def default_cache_path() -> str:
+    return os.environ.get(
+        _CACHE_ENV,
+        os.path.join(os.path.expanduser("~"), ".triton_dist_tpu",
+                     "autotune.json"))
+
+
+def _load_cache(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_cache(path: str, cache: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)          # atomic: concurrent tuners can't tear
+
+
+def clear_cache(path: Optional[str] = None) -> None:
+    path = path or default_cache_path()
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def _device_tag() -> str:
+    d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+
+
+def _arg_sig(args, kwargs) -> str:
+    def one(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return f"{tuple(a.shape)}{a.dtype}"
+        return repr(a)
+    parts = [one(a) for a in args]
+    parts += [f"{k}={one(v)}" for k, v in sorted(kwargs.items())]
+    return ",".join(parts)
+
+
+def _consensus_sum(times: List[float]) -> List[float]:
+    """Sum per-config times across processes so every process argmins
+    the same vector (reference: the all-reduce of timings in tune.py's
+    distributed path). Single-process: identity."""
+    if jax.process_count() == 1:
+        return times
+    import numpy as np
+    from jax.experimental import multihost_utils
+    arr = multihost_utils.process_allgather(np.asarray(times))
+    return list(np.asarray(arr).reshape(jax.process_count(), -1).sum(0))
+
+
+def _time_call(fn: Callable, args, kwargs, *, iters: int, warmup: int
+               ) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+@dataclasses.dataclass
+class AutoTuner:
+    """Reference: AutoTuner (tune.py:280). Measures `fn` under every
+    config dict, caches the winner on disk, and replays it on later
+    calls with the same signature."""
+
+    fn: Callable
+    configs: Sequence[Dict[str, Any]]
+    name: Optional[str] = None
+    cache_path: Optional[str] = None
+    iters: int = 3
+    warmup: int = 1
+
+    def __post_init__(self):
+        self.name = self.name or getattr(self.fn, "__name__", "fn")
+        self.cache_path = self.cache_path or default_cache_path()
+        self._mem: Dict[str, Dict[str, Any]] = {}
+
+    def _key(self, args, kwargs) -> str:
+        return "|".join([
+            _device_tag(), jax.__version__, self.name,
+            _arg_sig(args, kwargs),
+            json.dumps(list(self.configs), sort_keys=True),
+        ])
+
+    def pick(self, *args, **kwargs) -> Dict[str, Any]:
+        """Return the best config for this call signature (tuning on the
+        first sight of a signature, cached afterwards)."""
+        key = self._key(args, kwargs)
+        if key in self._mem:
+            return self._mem[key]["cfg"]
+        disk = _load_cache(self.cache_path)
+        if key in disk:
+            self._mem[key] = disk[key]
+            return disk[key]["cfg"]
+        times = []
+        for cfg in self.configs:
+            try:
+                t = _time_call(functools.partial(self.fn, **cfg), args,
+                               kwargs, iters=self.iters,
+                               warmup=self.warmup)
+            except Exception:
+                t = float("inf")   # config illegal for this shape
+            times.append(t)
+        times = _consensus_sum(times)
+        best = min(range(len(times)), key=times.__getitem__)
+        if times[best] == float("inf"):
+            raise ValueError(
+                f"autotune({self.name}): every config failed for "
+                f"signature {_arg_sig(args, kwargs)}")
+        entry = {"cfg": dict(self.configs[best]),
+                 "time_s": None if times[best] == float("inf")
+                 else times[best]}
+        self._mem[key] = entry
+        disk = _load_cache(self.cache_path)   # re-read: merge writers
+        disk[key] = entry
+        _store_cache(self.cache_path, disk)
+        return entry["cfg"]
+
+    def __call__(self, *args, **kwargs):
+        cfg = self.pick(*args, **kwargs)
+        return self.fn(*args, **kwargs, **cfg)
+
+
+def autotune(configs: Sequence[Dict[str, Any]], *,
+             name: Optional[str] = None,
+             cache_path: Optional[str] = None,
+             iters: int = 3, warmup: int = 1):
+    """Decorator form (reference: tune.py:498):
+
+        @autotune(configs=[{"block_n": 256}, {"block_n": 512}])
+        def op(x, *, block_n): ...
+
+    The wrapped op tunes per call-signature and replays the cached
+    winner afterwards."""
+    def wrap(fn):
+        tuner = AutoTuner(fn, configs, name=name, cache_path=cache_path,
+                          iters=iters, warmup=warmup)
+        functools.update_wrapper(tuner, fn, updated=())
+        return tuner
+    return wrap
