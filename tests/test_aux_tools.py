@@ -1,0 +1,134 @@
+"""Tests for the aux surface added in round 2: device-side broadcast /
+fcollect helpers (reference: libshmem_device collectives), topology
+probing (nv_utils analog), AOT export (compile_aot.py analog), and the
+host profiler (profiler_utils.py:205 analog)."""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+mesh = None
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+def _run_collective(kernel, x, out_rows_factor=1):
+    n = mesh.shape["tp"]
+    cid = next_collective_id()
+    rows, cols = x.shape[1], x.shape[2]
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P("tp", None, None),
+                       out_specs=P("tp", None, None), check_vma=False)
+    def _f(x_loc):
+        out = pl.pallas_call(
+            functools.partial(kernel, n),
+            out_shape=jax.ShapeDtypeStruct(
+                (out_rows_factor * rows, cols), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+            compiler_params=shmem_compiler_params(cid, n=n),
+            interpret=interpret_mode(),
+        )(x_loc[0])
+        return out[None]
+
+    xs = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P("tp", None, None)))
+    return np.asarray(jax.jit(_f)(xs))
+
+
+def test_broadcastmem():
+    n = mesh.shape["tp"]
+    x = np.random.RandomState(0).randn(n, 8, 128).astype(np.float32)
+
+    def kernel(n_, x_ref, o_ref, send_sem, recv_sem):
+        dl.barrier_all("tp")
+        dl.broadcastmem(o_ref, x_ref, jnp.int32(1), "tp", send_sem,
+                        recv_sem)
+
+    out = _run_collective(kernel, x)
+    for d in range(n):
+        np.testing.assert_array_equal(out[d], x[1])
+
+
+def test_fcollect():
+    n = mesh.shape["tp"]
+    x = np.random.RandomState(1).randn(n, 4, 128).astype(np.float32)
+
+    def kernel(n_, x_ref, o_ref, send_sem, recv_sem):
+        dl.barrier_all("tp")
+        dl.fcollect(o_ref, x_ref, "tp", send_sem, recv_sem)
+
+    out = _run_collective(kernel, x, out_rows_factor=n)
+    full = x.reshape(n * 4, 128)
+    for d in range(n):
+        np.testing.assert_array_equal(out[d], full)
+
+
+def test_topology_probe_and_mesh():
+    from triton_dist_tpu.runtime.topology import (Topology, probe_topology,
+                                                  recommend_mesh,
+                                                  ring_order)
+    topo = probe_topology()
+    assert topo.n_devices == len(jax.devices())
+    assert topo.n_slices >= 1
+    shape, names = recommend_mesh(topo)
+    assert int(np.prod(shape)) == topo.n_devices
+    assert len(shape) == len(names)
+    # tp subdivision
+    if topo.n_devices % 2 == 0 and not topo.multislice:
+        shape2, names2 = recommend_mesh(topo, tp=2)
+        assert shape2[-1] == 2 and names2[-1] == "tp"
+    # virtual CPU devices have no coords -> ring order unavailable
+    order = ring_order(topo)
+    assert order is None or sorted(order) == list(range(topo.n_devices))
+    # synthetic multislice topo: dcn axis goes outermost
+    fake = Topology(n_devices=8, platform="tpu", device_kind="v5e",
+                    coords=None, torus=None, n_slices=2,
+                    devices_per_slice=4)
+    shape3, names3 = recommend_mesh(fake)
+    assert names3[0] == "dcn" and shape3[0] == 2
+
+
+def test_aot_export_roundtrip():
+    from triton_dist_tpu.tools.aot import aot_export, aot_load
+
+    def f(x, y):
+        return jnp.tanh(x) @ y
+
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(16, 4), jnp.float32)
+    blob = aot_export(f, (x, y))
+    assert isinstance(blob, (bytes, bytearray)) and len(blob) > 100
+    g = aot_load(bytes(blob))
+    np.testing.assert_allclose(np.asarray(g(x, y)), np.asarray(f(x, y)),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_group_profile(tmp_path):
+    from triton_dist_tpu.tools.profile import group_profile, named_region
+
+    with group_profile("unit", log_dir=str(tmp_path)) as prof:
+        with named_region("unit_matmul"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(jax.jit(lambda v: v @ v)(x))
+    assert prof["wall_s"] > 0
+    assert prof["trace_dir"] == str(tmp_path)
+    assert any(os.path.isfile(f) for f in prof["files"])

@@ -57,6 +57,11 @@ from triton_dist_tpu.kernels.sp_flash_decode import (  # noqa: F401
 from triton_dist_tpu.kernels.p2p import (  # noqa: F401
     p2p_shift,
 )
+from triton_dist_tpu.kernels.two_tier import (  # noqa: F401
+    all_gather_2d,
+    all_reduce_2d,
+    reduce_scatter_2d,
+)
 from triton_dist_tpu.kernels.sp_attention import (  # noqa: F401
     gemm_all_to_all,
     qkv_gemm_a2a,

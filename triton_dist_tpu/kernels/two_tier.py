@@ -1,0 +1,160 @@
+"""Two-tier collectives: one-sided ICI inside a slice, XLA collectives
+across slices (DCN).
+
+TPU-native re-design of the reference's inter-node comm tier
+(`python/triton_dist/kernels/nvidia/allgather.py:294` 2D put kernels,
+`reduce_scatter.py:471` inter-node P2P stage): there, NVSHMEM gives
+one-sided semantics on BOTH tiers and the kernels pick per-peer paths
+by topology. DCN has no one-sided semantics (SURVEY §7 hard part 3), so
+each collective splits into an intra-slice stage that runs this repo's
+one-sided Pallas kernels over ICI and an inter-slice stage expressed as
+an XLA collective — which XLA schedules and overlaps on DCN, the layer
+it owns. The mesh carries both axes: ("dcn", "tp") with tp innermost
+(ICI-contiguous).
+
+Ops:
+  - ``all_gather_2d``   : DCN-first gather (each shard crosses DCN
+    exactly once), then the ICI AG kernel; a local transpose restores
+    global (slice, chip) block order.
+  - ``reduce_scatter_2d``: ICI ring-RS within the slice, then a DCN
+    psum_scatter — partials never cross DCN unreduced more than once.
+  - ``all_reduce_2d``   : hierarchical AR = ICI RS + DCN psum + ICI AG.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather import (AllGatherMethod,
+                                               _ag_pallas,
+                                               get_auto_all_gather_method)
+from triton_dist_tpu.kernels.reduce_scatter import (ReduceScatterMethod,
+                                                    _rs_pallas)
+from triton_dist_tpu.runtime import next_collective_id
+
+
+def all_gather_2d(x, *, mesh: Mesh, chip_axis: str = "tp",
+                  slice_axis: str = "dcn",
+                  collective_id: Optional[int] = None):
+    """AllGather a dim-0-sharded tensor over BOTH mesh axes.
+
+    x: [R, ...] with R sharded (slice-major, chip-minor) over
+    (slice_axis, chip_axis). Returns [R, ...] replicated everywhere.
+    Reference: the 2D put AG (allgather.py:294) — here the DCN hop runs
+    first (each shard crosses the slow tier once), then the ICI kernel
+    multiplies it within each slice.
+    """
+    n_s = mesh.shape[slice_axis]
+    n_c = mesh.shape[chip_axis]
+    if collective_id is None:
+        collective_id = next_collective_id()
+    rows = x.shape[0] // (n_s * n_c)
+    method = get_auto_all_gather_method(
+        int(n_s * rows * (x.size // x.shape[0]) * x.dtype.itemsize), n_c)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P((slice_axis, chip_axis), *(None,) * (x.ndim - 1)),
+        out_specs=P(*(None,) * x.ndim), check_vma=False)
+    def _f(x_loc):
+        # DCN: gather this chip-column's shards from every slice
+        col = jax.lax.all_gather(x_loc, slice_axis, axis=0, tiled=True)
+        # ICI: multiply across the slice's chips
+        flat = col.reshape(n_s * rows, -1)
+        full = _ag_pallas(flat, n=n_c, axis=chip_axis, method=method,
+                          collective_id=collective_id)
+        # arrived (chip, slice, rows)-ordered; restore (slice, chip, rows)
+        out = (full.reshape(n_c, n_s, rows, -1)
+                   .transpose(1, 0, 2, 3)
+                   .reshape((n_s * n_c * rows,) + x_loc.shape[1:]))
+        return out
+
+    return _f(x)
+
+
+def reduce_scatter_2d(x_partials, *, mesh: Mesh, chip_axis: str = "tp",
+                      slice_axis: str = "dcn",
+                      collective_id: Optional[int] = None):
+    """Sum per-device partials, scatter row-chunks over both axes.
+
+    x_partials: [N, M, cols] with N = n_s * n_c sharded (slice-major)
+    on dim 0. Returns [M, cols] sharded on rows CHIP-major (device
+    (s, c) owns rows [(c*n_s + s) * M/N, ...)): the ICI ring hands chip
+    c the slice-summed chunk c, and the DCN psum_scatter splits that
+    chunk slice-major — so chip stays the outer block. Reference:
+    reduce_scatter.py:471 (intra-node RS + inter-node P2P stage).
+    """
+    n_s = mesh.shape[slice_axis]
+    n_c = mesh.shape[chip_axis]
+    n_tot = n_s * n_c
+    _, M, cols = x_partials.shape
+    assert M % n_tot == 0, (M, n_tot)
+    if collective_id is None:
+        collective_id = next_collective_id()
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P((slice_axis, chip_axis), None, None),
+        out_specs=P((chip_axis, slice_axis), None), check_vma=False)
+    def _f(x_loc):
+        # ICI: ring-RS the slice's partials; chip c ends with rows
+        # [c*M/n_c, (c+1)*M/n_c) summed over the slice's chips
+        # (single-chip slice: nothing to reduce, the ring degenerates)
+        if n_c > 1:
+            chunk = _rs_pallas(x_loc.reshape(M, cols), n=n_c,
+                               axis=chip_axis,
+                               method=ReduceScatterMethod.RING,
+                               collective_id=collective_id)
+        else:
+            chunk = x_loc.reshape(M, cols)
+        # DCN: finish the sum across slices and scatter the chunk's
+        # rows slice-major; slice s keeps sub-block s
+        return jax.lax.psum_scatter(
+            chunk.reshape(n_s, M // n_tot, cols), slice_axis,
+            scatter_dimension=0, tiled=False)
+
+    return _f(x_partials)
+
+
+def all_reduce_2d(x_partials, *, mesh: Mesh, chip_axis: str = "tp",
+                  slice_axis: str = "dcn",
+                  collective_id: Optional[int] = None):
+    """Hierarchical AllReduce: ICI ring-RS -> DCN psum -> ICI ring-AG.
+
+    x_partials: [N, M, cols] sharded (slice-major) on dim 0; returns
+    [M, cols] replicated. The DCN tier carries M/n_c rows per chip (the
+    reduced chunks), never the full tensor — the 2-tier bandwidth shape
+    of the reference's inter-node AR."""
+    n_s = mesh.shape[slice_axis]
+    n_c = mesh.shape[chip_axis]
+    _, M, cols = x_partials.shape
+    assert M % n_c == 0, (M, n_c)
+    if collective_id is None:
+        collective_id = next_collective_id()
+    cid_ag = next_collective_id()
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P((slice_axis, chip_axis), None, None),
+        out_specs=P(None, None), check_vma=False)
+    def _f(x_loc):
+        if n_c > 1:
+            chunk = _rs_pallas(x_loc.reshape(M, cols), n=n_c,
+                               axis=chip_axis,
+                               method=ReduceScatterMethod.RING,
+                               collective_id=collective_id)
+        else:
+            chunk = x_loc.reshape(M, cols)
+        chunk = jax.lax.psum(chunk, slice_axis)
+        if n_c == 1:
+            return chunk
+        return _ag_pallas(chunk, n=n_c, axis=chip_axis,
+                          method=AllGatherMethod.RING,
+                          collective_id=cid_ag)
+
+    return _f(x_partials)

@@ -24,6 +24,12 @@ from triton_dist_tpu.language.shmem_device import (  # noqa: F401
     quiet,
     barrier_all,
     sem_value,
+    broadcastmem,
+    fcollect,
+    atomic_add,
+    atomic_read,
+    team_my_pe,
+    team_n_pes,
 )
 
 # aliases matching the reference `dl.` surface (language/__init__.py:26-50)

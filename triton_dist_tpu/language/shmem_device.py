@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl  # noqa: F401  (re-exported)
 from jax.experimental.pallas import tpu as pltpu
 
@@ -201,3 +202,70 @@ def _static_axis_size(axis: str) -> int:
 def sem_value(sem) -> jax.Array:
     """Non-destructive semaphore read (ref: ld of the flag word)."""
     return pltpu.semaphore_read(sem)
+
+
+# ---------------------------------------------------------------------------
+# Collective device helpers (reference: the libshmem_device collective
+# surface — broadcast/fcollect/teams, python/triton_dist/language/)
+# ---------------------------------------------------------------------------
+
+def broadcastmem(dst_ref, src_ref, root, axis: str, send_sem,
+                 recv_sem) -> None:
+    """In-kernel broadcast (ref: nvshmemx_broadcastmem_block): the root
+    puts src_ref into dst_ref on every PE (itself included, keeping the
+    control flow uniform); every PE waits exactly one arrival. Call on
+    ALL PEs of the axis."""
+    me = jax.lax.axis_index(axis)
+    n = _static_axis_size(axis)
+
+    @pl.when(me == root)
+    def _send():
+        for p in range(n):
+            putmem_nbi(dst_ref, src_ref, send_sem, recv_sem,
+                       jnp.int32(p), axis)
+
+    pltpu.make_async_copy(src_ref, src_ref, recv_sem).wait()
+
+    @pl.when(me == root)
+    def _drain():
+        quiet(send_sem, src_ref, n)
+
+
+def fcollect(dst_ref, src_ref, axis: str, send_sem, recv_sem) -> None:
+    """In-kernel allgather (ref: nvshmemx_fcollectmem_block): every PE
+    puts its src_ref into slot `me` of dst_ref on every peer, then
+    waits n arrivals. dst_ref rows = n * src_ref rows."""
+    me = jax.lax.axis_index(axis)
+    n = _static_axis_size(axis)
+    rows = src_ref.shape[0]
+    for p in range(n):
+        putmem_nbi(dst_ref.at[pl.ds(me * rows, rows)], src_ref,
+                   send_sem, recv_sem, jnp.int32(p), axis)
+    for _ in range(n):
+        pltpu.make_async_copy(src_ref, src_ref, recv_sem).wait()
+    quiet(send_sem, src_ref, n)
+
+
+def atomic_add(sem, value, pe=None, axis: Optional[str] = None) -> None:
+    """Remote atomic add (ref: nvshmem AMO_ADD on flag words): TPU's
+    remote atomics are semaphore increments — the flag-word AMO uses of
+    the reference map 1:1 onto semaphore_signal with an amount."""
+    signal_op(sem, value, pe, axis)
+
+
+def atomic_read(sem) -> jax.Array:
+    """Non-destructive flag read (ref: AMO_FETCH on a flag word)."""
+    return sem_value(sem)
+
+
+# Teams (ref: nvshmem teams / NVSHMEM_TEAM_WORLD + team_split): on a
+# named device mesh, a "team" IS a mesh axis — my_pe(axis)/n_pes(axis)
+# are the team-relative rank/size, and "team split" is mesh
+# construction (jax.make_mesh((a, b), ("outer", "inner"))). These
+# aliases keep ported kernel structure readable.
+def team_my_pe(axis: str) -> jax.Array:
+    return my_pe(axis)
+
+
+def team_n_pes(axis: str) -> jax.Array:
+    return n_pes(axis)

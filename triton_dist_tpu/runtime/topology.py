@@ -1,0 +1,111 @@
+"""ICI topology probing and mesh/method recommendation.
+
+TPU-native re-design of the reference topology utils
+(`python/triton_dist/utils/nv_utils.py` — NVLink/PCIe matrix probing
+that drives `get_auto_all_gather_method` etc.). On TPU the questions
+are different but isomorphic: what torus do the chips form (device
+coords), does the job span slices (DCN boundary = the NVLink/IB
+boundary analog), and which mesh axis order keeps collectives on
+contiguous ICI rings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """What the runtime could discover about the device fabric."""
+    n_devices: int
+    platform: str
+    device_kind: str
+    coords: Optional[Tuple[Tuple[int, ...], ...]]   # per-device, or None
+    torus: Optional[Tuple[int, ...]]                # inferred dims
+    n_slices: int
+    devices_per_slice: int
+
+    @property
+    def multislice(self) -> bool:
+        return self.n_slices > 1
+
+    @property
+    def has_wraparound(self) -> bool:
+        """A torus dim of >= 4 has wraparound links on real pods —
+        rings along it get bidirectional bandwidth."""
+        return self.torus is not None and any(d >= 4 for d in self.torus)
+
+
+def probe_topology(devices: Optional[Sequence] = None) -> Topology:
+    """Inspect jax.devices() for coords/slice structure (reference:
+    nv_utils' matrix probe; here the platform exposes the answers as
+    device attributes, and CPU/virtual devices fall back to a flat
+    ring)."""
+    devices = list(devices if devices is not None else jax.devices())
+    d0 = devices[0]
+    coords = None
+    torus = None
+    if all(getattr(d, "coords", None) is not None for d in devices):
+        coords = tuple(tuple(d.coords) for d in devices)
+        dims = tuple(
+            max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+            for i in range(len(coords[0])))
+        torus = tuple(d for d in dims if d > 1) or (1,)
+    slice_ids = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    n_slices = len(set(slice_ids))
+    return Topology(
+        n_devices=len(devices),
+        platform=d0.platform,
+        device_kind=getattr(d0, "device_kind", d0.platform),
+        coords=coords,
+        torus=torus,
+        n_slices=n_slices,
+        devices_per_slice=len(devices) // max(n_slices, 1),
+    )
+
+
+def recommend_mesh(topo: Optional[Topology] = None, *,
+                   tp: Optional[int] = None) -> Tuple[Tuple[int, ...],
+                                                      Tuple[str, ...]]:
+    """Pick (shape, axis_names) for jax.make_mesh: DCN axis outermost
+    when the job spans slices (collectives on the inner axes then ride
+    ICI, the property the reference gets from rank-ordering nodes)."""
+    topo = topo or probe_topology()
+    if topo.multislice:
+        inner = tp or topo.devices_per_slice
+        assert topo.devices_per_slice % inner == 0
+        extra = topo.devices_per_slice // inner
+        if extra > 1:
+            return ((topo.n_slices, extra, inner), ("dcn", "dp", "tp"))
+        return ((topo.n_slices, inner), ("dcn", "tp"))
+    inner = tp or topo.n_devices
+    if inner < topo.n_devices:
+        return ((topo.n_devices // inner, inner), ("dp", "tp"))
+    return ((inner,), ("tp",))
+
+
+def ring_order(topo: Optional[Topology] = None) -> Optional[list]:
+    """Device order forming a Hamiltonian ring over the torus (snake
+    order through coords) so neighbor puts are single-hop; None when
+    coords are unavailable (virtual devices — any order is equal)."""
+    topo = topo or probe_topology()
+    if topo.coords is None:
+        return None
+    idx = sorted(range(topo.n_devices),
+                 key=lambda i: _snake_key(topo.coords[i]))
+    return idx
+
+
+def _snake_key(coord):
+    """Boustrophedon ordering: reverse odd rows so consecutive devices
+    are torus neighbors."""
+    key = []
+    flip = False
+    for i, c in enumerate(coord):
+        key.append(-c if flip else c)
+        flip = (sum(coord[:i + 1]) % 2 == 1)
+    return tuple(key)

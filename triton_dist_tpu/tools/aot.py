@@ -1,0 +1,40 @@
+"""AOT compile/export of jitted programs.
+
+TPU-native re-design of the reference AOT pipeline
+(`python/triton_dist/tools/compile_aot.py:56` + `tools/runtime` — there
+Triton kernels are pre-compiled to cubins and launched by a C runtime;
+on TPU `jax.export` serializes the StableHLO of a jitted program —
+including every Pallas/Mosaic kernel — and reloads it without retracing
+Python, which is the whole point of the reference's AOT path (serving
+processes that must not pay tracing/compile time)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+from jax import export as jax_export
+
+
+def aot_export(fn: Callable, args: Sequence[Any], *,
+               platforms: Sequence[str] | None = None) -> bytes:
+    """Trace + lower `fn` for `args` and serialize the result (the
+    reference's compile_aot.py:56 product: a launchable artifact with
+    no Python tracing at load time)."""
+    exported = jax_export.export(
+        jax.jit(fn),
+        platforms=list(platforms) if platforms is not None else None,
+    )(*args)
+    return exported.serialize()
+
+
+def aot_load(blob: bytes) -> Callable:
+    """Deserialize an exported program into a callable (reference: the
+    AOT runtime's launch entry, tools/runtime)."""
+    exported = jax_export.deserialize(blob)
+    return exported.call
+
+
+def aot_roundtrip(fn: Callable, args: Sequence[Any], **kw) -> Callable:
+    """Export + reload in one step (test/deployment convenience)."""
+    return aot_load(aot_export(fn, args, **kw))

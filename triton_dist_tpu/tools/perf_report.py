@@ -115,14 +115,16 @@ def run_report(write_json=None):
     # XLA inserts a free reshard where the sharding differs) so the
     # fori_loop chain is serial. The feed itself costs bandwidth for
     # the AR/RS partials rebuild — noted per row.
-    shard_bytes = M * N * isz
+    # collective_sol_us expects FULL-tensor bytes (its (n-1)/n factor is
+    # the per-device share of the total payload)
+    full_bytes = n * M * N * isz
     add("all_gather(one_shot)",
         lambda v: all_gather(v, mesh=mesh,
                              method=AllGatherMethod.ONE_SHOT), xs,
-        collective_sol_us("ag", shard_bytes, n, spec=spec))
+        collective_sol_us("ag", full_bytes, n, spec=spec))
     add("all_gather(ring)",
         lambda v: all_gather(v, mesh=mesh, method=AllGatherMethod.RING),
-        xs, collective_sol_us("ag", shard_bytes, n, spec=spec))
+        xs, collective_sol_us("ag", full_bytes, n, spec=spec))
     add("all_reduce(one_shot)",
         lambda v: v * 0 + all_reduce(v, mesh=mesh,
                                      method=AllReduceMethod.ONE_SHOT)[None],
@@ -137,21 +139,25 @@ def run_report(write_json=None):
         lambda v: v * 0 + reduce_scatter(v, mesh=mesh)[None],
         xp, collective_sol_us("rs", n * M * N * isz, n, spec=spec),
         note="includes partials rebuild")
-    gemm_sol = gemm_sol_us(M, K, N, itemsize=isz, spec=spec)
     a_rows = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
     b_cols = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
     ag_ctx = create_ag_gemm_context(mesh)
     rs_ctx = create_gemm_rs_context(mesh)
     ar_ctx = create_gemm_ar_context(mesh)
+    # GEMM SOL terms use PER-CHIP dims: ag_gemm computes [M, K]@[K, N/n]
+    # per chip, gemm_rs/gemm_ar compute [M, K/n]@[K/n, N]
     add("ag_gemm",
         lambda v: ag_gemm(v, b_cols, ag_ctx)[:, :K], a_rows,
-        gemm_sol + collective_sol_us("ag", M // n * K * isz, n, spec=spec))
+        gemm_sol_us(M, K, N // n, itemsize=isz, spec=spec)
+        + collective_sol_us("ag", M * K * isz, n, spec=spec))
     add("gemm_rs",
         lambda v: gemm_rs(v, b_rows, rs_ctx)[:, :K], a_cols,
-        gemm_sol + collective_sol_us("rs", M * N * isz, n, spec=spec))
+        gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
+        + collective_sol_us("rs", M * N * isz, n, spec=spec))
     add("gemm_allreduce",
         lambda v: gemm_allreduce(v, b_rows, ar_ctx)[:, :K], a_cols,
-        gemm_sol + collective_sol_us("ar", M * N * isz, n, spec=spec))
+        gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
+        + collective_sol_us("ar", M * N * isz, n, spec=spec))
 
     # flash decode: B=8 heads=16/8 T=2048
     B, S, Hq, Hkv, T, d = (8, 1, 16, 8, 2048, 128) if on_tpu else \

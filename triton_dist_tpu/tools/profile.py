@@ -1,0 +1,70 @@
+"""Host-side profiling: group traces + named regions.
+
+TPU-native re-design of the reference profiling stack
+(`python/triton_dist/tools/profiler_utils.py:205` `group_profile` —
+per-rank torch-profiler traces gathered into one directory — and the
+intra-kernel profiler `tools/profiler/language.py:38` with its Perfetto
+export `viewer.py:115`). On TPU the platform profiler (xprof) already
+records per-core compute, DMA, and ICI traffic for every op — including
+inside Pallas kernels — so the intra-kernel instrumentation layer the
+reference had to build in-DSL is subsumed: ``group_profile`` captures a
+trace viewable in XProf/Perfetto/TensorBoard, and ``named_region``
+attaches readable names so framework ops are findable in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def group_profile(name: str, *, log_dir: Optional[str] = None,
+                  do_prof: bool = True,
+                  host_timing: bool = True) -> Iterator[dict]:
+    """Capture a profiler trace of the enclosed computation.
+
+    Reference: group_profile (profiler_utils.py:205) — there every rank
+    writes a torch-profiler trace into a shared dir; here the singleton
+    TPU profiler writes one trace covering all local devices. Yields a
+    dict filled at exit: {"trace_dir", "wall_s", "files"}.
+
+    with group_profile("decode_step") as prof:
+        run()
+    print(prof["trace_dir"], prof["wall_s"])
+    """
+    info: dict = {"name": name, "trace_dir": None, "wall_s": None,
+                  "files": []}
+    if log_dir is None:
+        log_dir = os.path.join("/tmp", "tdtpu_profiles", name)
+    t0 = time.perf_counter()
+    if do_prof:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+    try:
+        yield info
+    finally:
+        if do_prof:
+            jax.profiler.stop_trace()
+            info["trace_dir"] = log_dir
+            info["files"] = sorted(glob.glob(
+                os.path.join(log_dir, "**", "*"), recursive=True))
+        if host_timing:
+            info["wall_s"] = time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def named_region(name: str):
+    """Name the enclosed ops in the profiler timeline (reference: the
+    per-op annotations the intra-kernel profiler emits for Perfetto,
+    viewer.py:115). Composes trace-time (jax.named_scope) and run-time
+    (TraceAnnotation) labels so the region is visible both in HLO and
+    in the xprof timeline."""
+    with jax.named_scope(name):
+        with jax.profiler.TraceAnnotation(name):
+            yield

@@ -49,7 +49,8 @@ def _load_cache(path: str) -> Dict[str, Any]:
 
 
 def _store_cache(path: str, cache: Dict[str, Any]) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.dirname(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(cache, f, indent=1, sort_keys=True)
@@ -127,20 +128,47 @@ class AutoTuner:
             json.dumps(list(self.configs), sort_keys=True),
         ])
 
+    def _sync_cached_choice(self, entry) -> Optional[Dict[str, Any]]:
+        """Multi-process cache agreement: every process joins ONE
+        collective advertising its cached config (or -1); the first
+        process with a hit wins. Without this, a process whose disk
+        cache has the key would early-return while cold processes sit
+        in the consensus allgather — a deadlock (caches are per-host)."""
+        if jax.process_count() == 1:
+            return entry["cfg"] if entry is not None else None
+        import numpy as np
+        from jax.experimental import multihost_utils
+        idx = -1
+        if entry is not None:
+            for i, cfg in enumerate(self.configs):
+                if dict(cfg) == dict(entry["cfg"]):
+                    idx = i
+                    break
+        got = np.asarray(
+            multihost_utils.process_allgather(np.asarray([idx]))
+        ).reshape(-1)
+        for v in got:
+            if v >= 0:
+                return dict(self.configs[int(v)])
+        return None
+
     def pick(self, *args, **kwargs) -> Dict[str, Any]:
         """Return the best config for this call signature (tuning on the
         first sight of a signature, cached afterwards)."""
         key = self._key(args, kwargs)
-        if key in self._mem:
-            return self._mem[key]["cfg"]
-        disk = _load_cache(self.cache_path)
-        if key in disk:
-            self._mem[key] = disk[key]
-            return disk[key]["cfg"]
+        entry = self._mem.get(key)
+        if entry is None:
+            disk = _load_cache(self.cache_path)
+            entry = disk.get(key)
+        cfg = self._sync_cached_choice(entry)
+        if cfg is not None:
+            self._mem[key] = {"cfg": cfg,
+                              "time_s": (entry or {}).get("time_s")}
+            return cfg
         times = []
-        for cfg in self.configs:
+        for c in self.configs:
             try:
-                t = _time_call(functools.partial(self.fn, **cfg), args,
+                t = _time_call(functools.partial(self.fn, **c), args,
                                kwargs, iters=self.iters,
                                warmup=self.warmup)
             except Exception:
@@ -152,14 +180,13 @@ class AutoTuner:
             raise ValueError(
                 f"autotune({self.name}): every config failed for "
                 f"signature {_arg_sig(args, kwargs)}")
-        entry = {"cfg": dict(self.configs[best]),
-                 "time_s": None if times[best] == float("inf")
-                 else times[best]}
-        self._mem[key] = entry
+        new_entry = {"cfg": dict(self.configs[best]),
+                     "time_s": times[best]}
+        self._mem[key] = new_entry
         disk = _load_cache(self.cache_path)   # re-read: merge writers
-        disk[key] = entry
+        disk[key] = new_entry
         _store_cache(self.cache_path, disk)
-        return entry["cfg"]
+        return new_entry["cfg"]
 
     def __call__(self, *args, **kwargs):
         cfg = self.pick(*args, **kwargs)
