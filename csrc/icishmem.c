@@ -1,0 +1,255 @@
+/* icishmem: native host runtime for triton_dist_tpu.
+ *
+ * TPU-native re-design of the reference's native layer (csrc/ MoE
+ * alignment helpers, shmem/ *_bind symmetric-heap bookkeeping, and the
+ * tools/runtime bootstrap). On TPU the device memory itself is owned by
+ * XLA, so the native layer's jobs are the host-side ones: the symmetric
+ * buffer registry (nvshmem_create_tensors bookkeeping), the
+ * multi-process bootstrap barrier (nvshmem_init's socket exchange), and
+ * the MoE token-alignment kernels that sit on the host critical path of
+ * EP dispatch planning (reference csrc moe alignment: count tokens per
+ * expert, block-pad offsets, emit the sorted token order).
+ *
+ * Plain C + ctypes (this image has no pybind11); every entry point is
+ * re-entrant; the registry and barrier use pthread primitives. Built by
+ * triton_dist_tpu/runtime/native.py on first use (same pattern as
+ * tools/fakecpus.c).
+ */
+
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <arpa/inet.h>
+
+/* ------------------------------------------------------------------ */
+/* MoE token alignment (reference: csrc moe_align_block_size)          */
+/* ------------------------------------------------------------------ */
+
+/* topk: [T, k] expert ids (int32, -1 = dropped). Outputs:
+ *   counts  [E]    tokens routed to each expert
+ *   offsets [E+1]  block-padded start offset per expert
+ *                  (offsets[E] = total padded rows)
+ *   sorted_tok [T*k]  token index (t*k+j) order grouped by expert;
+ *                     entries beyond counts are -1
+ * Returns 0, or -1 on bad args. */
+int icishmem_moe_align(const int32_t *topk, int64_t T, int64_t k,
+                       int32_t E, int32_t block, int32_t *counts,
+                       int32_t *offsets, int32_t *sorted_tok) {
+  if (!topk || !counts || !offsets || !sorted_tok || E <= 0 || block <= 0)
+    return -1;
+  memset(counts, 0, (size_t)E * sizeof(int32_t));
+  const int64_t n = T * k;
+  for (int64_t i = 0; i < n; i++) {
+    int32_t e = topk[i];
+    if (e >= 0 && e < E) counts[e]++;
+  }
+  int32_t acc = 0;
+  for (int32_t e = 0; e < E; e++) {
+    offsets[e] = acc;
+    int32_t padded = (counts[e] + block - 1) / block * block;
+    acc += padded;
+  }
+  offsets[E] = acc;
+  /* fill: cursor per expert */
+  int32_t *cur = (int32_t *)malloc((size_t)E * sizeof(int32_t));
+  if (!cur) return -1;
+  memcpy(cur, offsets, (size_t)E * sizeof(int32_t));
+  for (int64_t i = 0; i < (int64_t)offsets[E]; i++) sorted_tok[i] = -1;
+  for (int64_t i = 0; i < n; i++) {
+    int32_t e = topk[i];
+    if (e >= 0 && e < E) sorted_tok[cur[e]++] = (int32_t)i;
+  }
+  free(cur);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Symmetric buffer registry (reference: nvshmem_create_tensors        */
+/* bookkeeping in shmem/ *_bind)                                       */
+/* ------------------------------------------------------------------ */
+
+#define REG_MAX 1024
+#define REG_NAME 128
+
+typedef struct {
+  char name[REG_NAME];
+  int64_t nbytes;
+  int64_t handle;
+  int used;
+} reg_entry;
+
+static reg_entry g_reg[REG_MAX];
+static int64_t g_next_handle = 1;
+static pthread_mutex_t g_reg_lock = PTHREAD_MUTEX_INITIALIZER;
+
+/* Register (or re-register, replacing) a named symmetric segment.
+ * Returns the handle (>0), or -1 when the table is full. */
+int64_t icishmem_register(const char *name, int64_t nbytes) {
+  pthread_mutex_lock(&g_reg_lock);
+  int free_i = -1;
+  for (int i = 0; i < REG_MAX; i++) {
+    if (g_reg[i].used && strncmp(g_reg[i].name, name, REG_NAME) == 0) {
+      g_reg[i].nbytes = nbytes;
+      int64_t h = g_reg[i].handle;
+      pthread_mutex_unlock(&g_reg_lock);
+      return h;
+    }
+    if (!g_reg[i].used && free_i < 0) free_i = i;
+  }
+  if (free_i < 0) {
+    pthread_mutex_unlock(&g_reg_lock);
+    return -1;
+  }
+  strncpy(g_reg[free_i].name, name, REG_NAME - 1);
+  g_reg[free_i].name[REG_NAME - 1] = 0;
+  g_reg[free_i].nbytes = nbytes;
+  g_reg[free_i].handle = g_next_handle++;
+  g_reg[free_i].used = 1;
+  int64_t h = g_reg[free_i].handle;
+  pthread_mutex_unlock(&g_reg_lock);
+  return h;
+}
+
+/* Returns the segment size, or -1 if unknown. */
+int64_t icishmem_lookup(const char *name) {
+  pthread_mutex_lock(&g_reg_lock);
+  for (int i = 0; i < REG_MAX; i++) {
+    if (g_reg[i].used && strncmp(g_reg[i].name, name, REG_NAME) == 0) {
+      int64_t n = g_reg[i].nbytes;
+      pthread_mutex_unlock(&g_reg_lock);
+      return n;
+    }
+  }
+  pthread_mutex_unlock(&g_reg_lock);
+  return -1;
+}
+
+int icishmem_unregister(const char *name) {
+  pthread_mutex_lock(&g_reg_lock);
+  for (int i = 0; i < REG_MAX; i++) {
+    if (g_reg[i].used && strncmp(g_reg[i].name, name, REG_NAME) == 0) {
+      g_reg[i].used = 0;
+      pthread_mutex_unlock(&g_reg_lock);
+      return 0;
+    }
+  }
+  pthread_mutex_unlock(&g_reg_lock);
+  return -1;
+}
+
+int64_t icishmem_registry_count(void) {
+  pthread_mutex_lock(&g_reg_lock);
+  int64_t c = 0;
+  for (int i = 0; i < REG_MAX; i++) c += g_reg[i].used ? 1 : 0;
+  pthread_mutex_unlock(&g_reg_lock);
+  return c;
+}
+
+/* ------------------------------------------------------------------ */
+/* Bootstrap barrier (reference: the socket bootstrap nvshmem_init     */
+/* runs before the symmetric heap exists)                              */
+/* ------------------------------------------------------------------ */
+
+static int read_full(int fd, void *buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, (char *)buf + got, n - got);
+    if (r <= 0) return -1;
+    got += (size_t)r;
+  }
+  return 0;
+}
+
+static int write_full(int fd, const void *buf, size_t n) {
+  size_t put = 0;
+  while (put < n) {
+    ssize_t w = write(fd, (const char *)buf + put, n - put);
+    if (w <= 0) return -1;
+    put += (size_t)w;
+  }
+  return 0;
+}
+
+/* Rank 0 listens on (host, port); every other rank connects, sends its
+ * rank, and blocks for the release byte. Returns 0 on success. */
+int icishmem_barrier(int rank, int world, const char *host, int port,
+                     int timeout_ms) {
+  if (world <= 1) return 0;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
+
+  if (rank == 0) {
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) return -1;
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    /* deadline applies to rank 0 too: a peer that never shows up must
+     * fail the barrier, not wedge it */
+    struct timeval tv;
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(lfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    if (bind(lfd, (struct sockaddr *)&addr, sizeof(addr)) != 0 ||
+        listen(lfd, world) != 0) {
+      close(lfd);
+      return -1;
+    }
+    int *fds = (int *)malloc((size_t)(world - 1) * sizeof(int));
+    if (!fds) { close(lfd); return -1; }
+    for (int i = 0; i < world - 1; i++) {
+      int fd = accept(lfd, NULL, NULL);
+      int32_t peer_rank;
+      if (fd >= 0) setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      if (fd < 0 || read_full(fd, &peer_rank, 4) != 0) {
+        if (fd >= 0) close(fd);
+        for (int j = 0; j < i; j++) close(fds[j]);
+        free(fds); close(lfd);
+        return -1;
+      }
+      fds[i] = fd;
+    }
+    char go = 1;
+    int rc = 0;
+    for (int i = 0; i < world - 1; i++) {
+      if (write_full(fds[i], &go, 1) != 0) rc = -1;
+      close(fds[i]);
+    }
+    free(fds);
+    close(lfd);
+    return rc;
+  }
+
+  /* non-root: connect with retry until timeout */
+  int waited = 0;
+  for (;;) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) == 0) {
+      struct timeval tv;
+      int remain = timeout_ms - waited;
+      if (remain < 1000) remain = 1000;
+      tv.tv_sec = remain / 1000;
+      tv.tv_usec = (remain % 1000) * 1000;
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      int32_t r32 = rank;
+      char go = 0;
+      int rc = (write_full(fd, &r32, 4) == 0 &&
+                read_full(fd, &go, 1) == 0 && go == 1) ? 0 : -1;
+      close(fd);
+      return rc;
+    }
+    close(fd);
+    if (waited >= timeout_ms) return -1;
+    usleep(50 * 1000);
+    waited += 50;
+  }
+}

@@ -128,6 +128,38 @@ def plan_dispatch(topk_idx, n: int, experts_per_rank: int, cap: int
     return DispatchPlan(slot=slot, valid=valid, token=token)
 
 
+def plan_dispatch_host(topk_idx, n: int, experts_per_rank: int, cap: int
+                       ) -> DispatchPlan:
+    """Host-side dispatch planning on the native icishmem alignment
+    kernel (reference: the csrc moe_align helpers driving the eager
+    dispatch path). Matches plan_dispatch on its contract — expert ids
+    in [0, n*experts_per_rank) (plan_dispatch's searchsorted path has
+    no defined behavior for -1, so this raises on it rather than
+    diverge silently); for serving loops that plan on CPU between
+    device steps instead of tracing the argsort into the program."""
+    import numpy as np
+    from triton_dist_tpu.runtime.native import moe_align
+    topk = np.asarray(topk_idx, np.int32)
+    if (topk < 0).any():
+        raise ValueError("plan_dispatch_host: negative expert ids are "
+                         "not part of the dispatch contract")
+    T, k = topk.shape
+    dest = topk.reshape(-1) // experts_per_rank
+    counts, offsets, sorted_tok = moe_align(dest.reshape(-1, 1), n, 1)
+    slot = np.full(T * k, n * cap, np.int32)
+    valid = np.zeros(T * k, bool)
+    for d in range(n):
+        seg = sorted_tok[offsets[d]:offsets[d] + counts[d]]
+        keep = seg[:cap]
+        slot[keep] = d * cap + np.arange(len(keep))
+        valid[keep] = True
+    token = np.arange(T * k) // k
+    import jax.numpy as _jnp
+    return DispatchPlan(slot=_jnp.asarray(slot),
+                        valid=_jnp.asarray(valid),
+                        token=_jnp.asarray(token))
+
+
 def fill_send_buffers(x, topk_idx, plan: DispatchPlan, n: int,
                       experts_per_rank: int, cap: int):
     """Scatter tokens (+ metadata) into the [n*cap] send layout.

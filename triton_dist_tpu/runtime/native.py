@@ -1,0 +1,169 @@
+"""ctypes bindings for the native icishmem host runtime (csrc/icishmem.c).
+
+Reference analog: the Python side of `shmem/nvshmem_bind` + the csrc
+MoE helpers' torch bindings. Built on demand with the system compiler
+(the image ships gcc; pybind11 is deliberately not assumed) and cached
+next to the source; every entry point has a NumPy fallback so the
+framework stays functional where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "icishmem.c")
+_SO = os.path.join(_REPO, "csrc", "icishmem.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO) and os.path.exists(_SRC):
+            cc = os.environ.get("CC", "gcc")
+            # build to a pid-unique temp and rename: concurrent ranks
+            # must never CDLL a half-written .so
+            tmp = f"{_SO}.tmp.{os.getpid()}"
+            r = subprocess.run(
+                [cc, "-shared", "-fPIC", "-O2", "-pthread", "-o", tmp,
+                 _SRC], capture_output=True)
+            if r.returncode != 0:
+                _build_failed = True
+                return None
+            os.replace(tmp, _SO)
+        if not os.path.exists(_SO):
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(_SO)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.icishmem_moe_align.restype = ctypes.c_int
+        lib.icishmem_moe_align.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, i32p, i32p, i32p]
+        lib.icishmem_register.restype = ctypes.c_int64
+        lib.icishmem_register.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.icishmem_lookup.restype = ctypes.c_int64
+        lib.icishmem_lookup.argtypes = [ctypes.c_char_p]
+        lib.icishmem_unregister.restype = ctypes.c_int
+        lib.icishmem_unregister.argtypes = [ctypes.c_char_p]
+        lib.icishmem_registry_count.restype = ctypes.c_int64
+        lib.icishmem_registry_count.argtypes = []
+        lib.icishmem_barrier.restype = ctypes.c_int
+        lib.icishmem_barrier.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def moe_align(topk_idx, num_experts: int, block: int = 1
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Group routed token slots by expert with block-padded offsets
+    (reference: csrc moe_align_block_size, the host planning step of EP
+    dispatch). topk_idx: [T, k] int32, -1 = dropped. Returns
+    (counts [E], offsets [E+1], sorted_tok [offsets[-1]]) where
+    sorted_tok holds flat slot ids t*k+j grouped by expert, -1 padding.
+    """
+    topk = np.ascontiguousarray(np.asarray(topk_idx, np.int32))
+    T, k = topk.shape if topk.ndim == 2 else (topk.shape[0], 1)
+    lib = _load()
+    counts = np.zeros(num_experts, np.int32)
+    offsets = np.zeros(num_experts + 1, np.int32)
+    if lib is not None:
+        # worst-case padded size: every expert padded up
+        max_rows = T * k + num_experts * block
+        sorted_tok = np.empty(max_rows, np.int32)
+        rc = lib.icishmem_moe_align(
+            topk.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            T, k, num_experts, block,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sorted_tok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc == 0:
+            return counts, offsets, sorted_tok[:offsets[-1]].copy()
+    # NumPy fallback (identical semantics)
+    flat = topk.reshape(-1)
+    valid = (flat >= 0) & (flat < num_experts)
+    counts[:] = np.bincount(flat[valid], minlength=num_experts)
+    padded = (counts + block - 1) // block * block
+    offsets[1:] = np.cumsum(padded)
+    sorted_tok = np.full(int(offsets[-1]), -1, np.int32)
+    cur = offsets[:-1].copy()
+    for i in np.nonzero(valid)[0]:
+        e = flat[i]
+        sorted_tok[cur[e]] = i
+        cur[e] += 1
+    return counts, offsets, sorted_tok
+
+
+class NativeRegistry:
+    """Named symmetric-segment registry backed by the C table when
+    available (reference: nvshmem_create_tensors bookkeeping); falls
+    back to a process-local dict."""
+
+    def __init__(self):
+        self._py = {}
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def register(self, name: str, nbytes: int) -> int:
+        lib = _load()
+        if lib is not None:
+            h = lib.icishmem_register(name.encode(), nbytes)
+            if h > 0:
+                return int(h)
+        with self._lock:
+            self._py[name] = nbytes
+            self._next += 1
+            return self._next - 1
+
+    def lookup(self, name: str) -> Optional[int]:
+        lib = _load()
+        if lib is not None:
+            n = lib.icishmem_lookup(name.encode())
+            if n >= 0:
+                return int(n)
+        return self._py.get(name)
+
+    def unregister(self, name: str) -> None:
+        lib = _load()
+        if lib is not None and lib.icishmem_unregister(name.encode()) == 0:
+            return
+        self._py.pop(name, None)
+
+
+def bootstrap_barrier(rank: int, world: int, *, host: str = "127.0.0.1",
+                      port: int = 29477, timeout_ms: int = 60000) -> None:
+    """Socket rendezvous across processes BEFORE any jax collective
+    exists (reference: the bootstrap in nvshmem_init). Raises on
+    failure; no-op for world <= 1."""
+    if world <= 1:
+        return
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("icishmem native library unavailable "
+                           "(no compiler?); bootstrap barrier needs it")
+    rc = lib.icishmem_barrier(rank, world, host.encode(), port,
+                              timeout_ms)
+    if rc != 0:
+        raise RuntimeError(
+            f"bootstrap barrier failed (rank {rank}/{world})")

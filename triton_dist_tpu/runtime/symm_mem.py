@@ -40,13 +40,25 @@ class SymmetricWorkspace:
 _REGISTRY: Dict[Tuple, SymmetricWorkspace] = {}
 
 
+def _native_registry():
+    from triton_dist_tpu.runtime.native import NativeRegistry
+    global _NATIVE
+    try:
+        _NATIVE
+    except NameError:
+        _NATIVE = NativeRegistry()
+    return _NATIVE
+
+
 def create_symm_buffer(name: str, local_shape: Tuple[int, ...],
                        dtype=jnp.float32, *, mesh: Mesh,
                        axis: str = "tp",
                        reuse: bool = True) -> SymmetricWorkspace:
     """Allocate (or fetch cached) a per-device buffer of `local_shape` on
     every device along `axis` (reference: nvshmem_create_tensor,
-    utils.py:232)."""
+    utils.py:232). Segment bookkeeping (name -> bytes) lives in the
+    native icishmem registry (csrc/icishmem.c), the nvshmem_bind
+    analog."""
     n = mesh.shape[axis]
     key = (name, tuple(local_shape), jnp.dtype(dtype).name, mesh, axis)
     if reuse and key in _REGISTRY:
@@ -55,10 +67,32 @@ def create_symm_buffer(name: str, local_shape: Tuple[int, ...],
     sharding = NamedSharding(mesh, P(axis))
     arr = jax.device_put(jnp.zeros(global_shape, dtype), sharding)
     ws = SymmetricWorkspace(name=name, array=arr, mesh=mesh, spec=P(axis))
+    nbytes = 1
+    for d in local_shape:
+        nbytes *= int(d)
+    _native_registry().register(_segment_name(key),
+                                nbytes * jnp.dtype(dtype).itemsize)
     if reuse:
         _REGISTRY[key] = ws
     return ws
 
 
+def _segment_name(key: Tuple) -> str:
+    """Native-registry key: same-name buffers with different shapes /
+    dtypes / axes are distinct segments."""
+    name, shape, dtype, _mesh, axis = key
+    return f"{name}:{'x'.join(map(str, shape))}:{dtype}:{axis}"
+
+
+def symm_buffer_nbytes(name: str, local_shape: Tuple[int, ...],
+                       dtype=jnp.float32, *, axis: str = "tp"
+                       ) -> Optional[int]:
+    """Per-device byte size of a registered segment (native lookup)."""
+    key = (name, tuple(local_shape), jnp.dtype(dtype).name, None, axis)
+    return _native_registry().lookup(_segment_name(key))
+
+
 def clear_registry() -> None:
+    for key in list(_REGISTRY):
+        _native_registry().unregister(_segment_name(key))
     _REGISTRY.clear()

@@ -83,6 +83,7 @@ def recommend_mesh(topo: Optional[Topology] = None, *,
             return ((topo.n_slices, extra, inner), ("dcn", "dp", "tp"))
         return ((topo.n_slices, inner), ("dcn", "tp"))
     inner = tp or topo.n_devices
+    assert topo.n_devices % inner == 0, (topo.n_devices, inner)
     if inner < topo.n_devices:
         return ((topo.n_devices // inner, inner), ("dp", "tp"))
     return ((inner,), ("tp",))

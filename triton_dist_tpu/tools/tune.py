@@ -156,10 +156,13 @@ class AutoTuner:
         """Return the best config for this call signature (tuning on the
         first sight of a signature, cached afterwards)."""
         key = self._key(args, kwargs)
-        entry = self._mem.get(key)
-        if entry is None:
-            disk = _load_cache(self.cache_path)
-            entry = disk.get(key)
+        hit = self._mem.get(key)
+        if hit is not None:
+            # warm path: _mem is only ever populated in lockstep across
+            # processes (allgather-hit or consensus-tune), so no
+            # per-call cross-host sync is needed here
+            return hit["cfg"]
+        entry = _load_cache(self.cache_path).get(key)
         cfg = self._sync_cached_choice(entry)
         if cfg is not None:
             self._mem[key] = {"cfg": cfg,
