@@ -1,0 +1,75 @@
+"""Megakernel tests: the single-kernel decode layer vs a jnp oracle,
+plus builder scoreboard-order validation (reference analogs: the
+mega_triton_kernel model tests and its dependency checking)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.mega import (MegaDecodeLayer, MegaKernelBuilder,
+                                  mega_decode_layer_ref)
+
+
+def _mk_layer(B=4, D=256, Hq=4, Hkv=2, hd=64, F=512, T=256, seed=0):
+    rng = np.random.RandomState(seed)
+    sc = 0.3 / np.sqrt(D)
+    half = hd // 2
+    w = {
+        "w_ln1": jnp.asarray(1 + 0.1 * rng.randn(1, D), jnp.float32),
+        "w_qkv": jnp.asarray(rng.randn(D, (Hq + 2 * Hkv) * hd) * sc,
+                             jnp.float32),
+        "q_norm": jnp.asarray(1 + 0.1 * rng.randn(1, hd), jnp.float32),
+        "k_norm": jnp.asarray(1 + 0.1 * rng.randn(1, hd), jnp.float32),
+        "w_o": jnp.asarray(rng.randn(Hq * hd, D) * sc, jnp.float32),
+        "w_ln2": jnp.asarray(1 + 0.1 * rng.randn(1, D), jnp.float32),
+        "w_gu": jnp.asarray(rng.randn(D, 2 * F) * sc, jnp.float32),
+        "w_d": jnp.asarray(rng.randn(F, D) * (0.3 / np.sqrt(F)),
+                           jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(B, D), jnp.float32) * 0.3
+    ck = jnp.asarray(rng.randn(Hkv, B, T, hd), jnp.bfloat16) * 0.3
+    cv = jnp.asarray(rng.randn(Hkv, B, T, hd), jnp.bfloat16) * 0.3
+    return x, w, ck, cv
+
+
+@pytest.mark.parametrize("pos", [0, 7, 130])
+def test_mega_decode_layer_vs_oracle(pos):
+    B, D, Hq, Hkv, hd, F, T = 4, 256, 4, 2, 64, 512, 256
+    x, w, ck, cv = _mk_layer(B, D, Hq, Hkv, hd, F, T, seed=pos)
+    inv = 1.0 / (1e6 ** (np.arange(0, hd, 2) / hd))
+    w = dict(w)
+    w["cos_row"] = jnp.asarray(np.cos(pos * inv)[None], jnp.float32)
+    w["sin_row"] = jnp.asarray(np.sin(pos * inv)[None], jnp.float32)
+
+    layer = MegaDecodeLayer(d_model=D, n_heads=Hq, n_kv_heads=Hkv,
+                            head_dim=hd, ffn=F, T=T)
+    with jax.default_matmul_precision("highest"):
+        y, ck2, cv2 = jax.jit(
+            lambda *a: layer(*a))(x, jnp.int32(pos), w, ck, cv)
+        ry, rck, rcv = mega_decode_layer_ref(
+            x, pos, w, ck, cv, n_heads=Hq, n_kv_heads=Hkv, head_dim=hd)
+    # bf16 weights inside the kernel vs f32 oracle: loose-ish tolerance
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry), atol=0.05,
+                               rtol=0.05)
+    np.testing.assert_allclose(
+        np.asarray(ck2, dtype=np.float32),
+        np.asarray(rck, dtype=np.float32), atol=1e-2, rtol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(cv2, dtype=np.float32),
+        np.asarray(rcv, dtype=np.float32), atol=1e-2, rtol=1e-2)
+
+
+def test_builder_rejects_misordered_program():
+    b = MegaKernelBuilder()
+    b.inputs("x")
+    b.buffer("tmp", (4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="before any task wrote"):
+        b.add_task("use_tmp", lambda env: None, reads=("tmp",),
+                   writes=("y",))
+    # correct order passes
+    b.add_task("make_tmp", lambda env: None, reads=("x",),
+               writes=("tmp",))
+    b.add_task("use_tmp", lambda env: None, reads=("tmp",),
+               writes=("y",))
+    assert [t.name for t in b.tasks] == ["make_tmp", "use_tmp"]

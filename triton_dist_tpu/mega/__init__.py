@@ -1,0 +1,20 @@
+"""Megakernel subsystem (reference analog: mega_triton_kernel/ —
+`models/model_builder.py:86` task-graph builder + the persistent-SM
+scoreboard runtime).
+
+On TPU the analog changes shape for a hardware reason worth recording:
+the reference needs a scoreboard because 100+ SMs execute tasks
+concurrently and dependencies must be enforced at runtime; a TPU core
+executes ONE instruction stream, so a topologically-sorted task list IS
+the schedule and the scoreboard degenerates to program order. What
+survives — and is the actual win on both platforms — is running an
+entire decode layer as ONE kernel with activations resident in VMEM:
+no HBM round-trips between norm/proj/attention/MLP, no per-op launch
+or pipeline-prologue cost.
+"""
+
+from triton_dist_tpu.mega.builder import MegaKernelBuilder  # noqa: F401
+from triton_dist_tpu.mega.decode_layer import (  # noqa: F401
+    MegaDecodeLayer,
+    mega_decode_layer_ref,
+)

@@ -1,0 +1,74 @@
+"""Task-program builder: compose a megakernel from task closures.
+
+Reference analog: `mega_triton_kernel/models/model_builder.py:86` — ops
+are recorded as tasks with buffer dependencies and compiled into one
+launch. Here each task is a Python closure emitted at trace time into a
+single Pallas kernel body; buffers are named VMEM residencies managed
+by the builder (the reference's buffer manager role). Because a TPU
+core is a single instruction stream, the recorded order is the
+schedule (see package docstring); the builder still validates the
+read-after-write chain so a misordered program fails at build, the
+role the runtime scoreboard plays in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class Task:
+    name: str
+    reads: Tuple[str, ...]
+    writes: Tuple[str, ...]
+    emit: Callable          # emit(env: dict[str, ref]) -> None
+
+
+class MegaKernelBuilder:
+    """Record named VMEM buffers and tasks; validate dependencies;
+    produce the ordered emit list a kernel body runs."""
+
+    def __init__(self):
+        self._buffers: Dict[str, Tuple[Tuple[int, ...], object]] = {}
+        self._tasks: List[Task] = []
+        self._written: set = set()
+
+    def buffer(self, name: str, shape: Tuple[int, ...], dtype) -> str:
+        """Declare a VMEM-resident intermediate (the buffer-manager
+        analog)."""
+        if name in self._buffers:
+            raise ValueError(f"buffer {name!r} already declared")
+        self._buffers[name] = (tuple(shape), dtype)
+        return name
+
+    def inputs(self, *names: str) -> None:
+        """Mark buffers produced outside the kernel (kernel operands)."""
+        self._written.update(names)
+
+    def add_task(self, name: str, emit: Callable, *,
+                 reads: Sequence[str] = (),
+                 writes: Sequence[str] = ()) -> None:
+        for r in reads:
+            if r not in self._written:
+                raise ValueError(
+                    f"task {name!r} reads {r!r} before any task wrote it "
+                    "(the scoreboard-order violation the reference "
+                    "detects at runtime)")
+        self._written.update(writes)
+        self._tasks.append(Task(name=name, reads=tuple(reads),
+                                writes=tuple(writes), emit=emit))
+
+    @property
+    def buffers(self) -> Dict[str, Tuple[Tuple[int, ...], object]]:
+        return dict(self._buffers)
+
+    @property
+    def tasks(self) -> List[Task]:
+        return list(self._tasks)
+
+    def emit_all(self, env: Dict[str, object]) -> None:
+        """Run every task's emitter in schedule order (called inside the
+        Pallas kernel body)."""
+        for t in self._tasks:
+            t.emit(env)

@@ -1,0 +1,383 @@
+"""MegaDecodeLayer: one transformer decode layer as ONE Pallas kernel.
+
+TPU-native re-design of the reference megakernel
+(`mega_triton_kernel/models/model_builder.py:86` builds the whole layer
+step as tasks executed by persistent SMs; task kinds at
+`mega_triton_kernel/task/`). Task list here (emitted in schedule order
+by MegaKernelBuilder — see mega/__init__ for why program order replaces
+the scoreboard on a sequential TPU core):
+
+  rmsnorm(x) -> qkv matmul -> per-head qk-norm + rope -> cache write at
+  pos -> flash decode over the cache -> o-proj (+residual) ->
+  rmsnorm -> gate/up matmul + swiglu -> down-proj (+residual)
+
+The payoff mirrors the reference's: activations stay resident in VMEM
+for the entire layer (zero HBM round-trips between ops), weights stream
+through a single staging tile, and the per-op pipeline
+prologue/epilogue cost of nine kernels collapses into one.
+
+Decode-only (S=1), single chip; the TP composition runs this under
+shard_map with the gemm_ar epilogue outside, like the other layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.mega.builder import MegaKernelBuilder
+from triton_dist_tpu.runtime import interpret_mode, shmem_compiler_params
+
+
+def _mm_tiles(env, dst, src, w, rows, cols, bn, wt_name, add=None,
+              act=None):
+    """Tiled matmul task body: dst[:, j*bn:...] = src @ w_tile (+add)."""
+    w_ref = env[w]
+    wt = env[wt_name]
+    copy_sem = env["copy_sem"]
+    for j in range(cols // bn):
+        sl = slice(j * bn, (j + 1) * bn)
+        cp = pltpu.make_async_copy(w_ref.at[:, sl], wt.at[:rows, :bn],
+                                   copy_sem)
+        cp.start()
+        cp.wait()
+        acc = jax.lax.dot(env[src][...].astype(jnp.bfloat16),
+                          wt[:rows, :bn],
+                          preferred_element_type=jnp.float32)
+        if add is not None:
+            acc = acc + env[add][:, sl]
+        if act is not None:
+            acc = act(acc)
+        env[dst][:, sl] = acc
+
+
+def _rmsnorm(env, dst, src, w_name, eps):
+    x = env[src][...]
+    g = env[w_name][...]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    env[dst][...] = x * jax.lax.rsqrt(ms + eps) * g
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MegaDecodeLayer:
+    """Static geometry + the compiled task program for one layer."""
+
+    d_model: int = dataclasses.field(metadata=dict(static=True))
+    n_heads: int = dataclasses.field(metadata=dict(static=True))
+    n_kv_heads: int = dataclasses.field(metadata=dict(static=True))
+    head_dim: int = dataclasses.field(metadata=dict(static=True))
+    ffn: int = dataclasses.field(metadata=dict(static=True))
+    T: int = dataclasses.field(metadata=dict(static=True))
+    eps: float = dataclasses.field(default=1e-6,
+                                   metadata=dict(static=True))
+    block_n: int = dataclasses.field(default=256,
+                                     metadata=dict(static=True))
+    block_t: int = dataclasses.field(default=128,
+                                     metadata=dict(static=True))
+
+    def __call__(self, x, pos, weights: Dict[str, jax.Array], cache_k,
+                 cache_v):
+        """x: [B, D]; pos: traced scalar (tokens already cached);
+        weights: w_ln1 [1,D], w_qkv [D,(Hq+2Hkv)hd], q_norm/k_norm
+        [1,hd], w_o [Hq*hd,D], w_ln2 [1,D], w_gu [D,2F] (gate|up),
+        w_d [F,D], cos_row/sin_row [1,hd//2] for position `pos`.
+        cache_k/v: [Hkv, B, T, hd]. Returns (y [B,D], cache_k, cache_v).
+        """
+        B, D = x.shape
+        Hq, Hkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        rep = Hq // Hkv
+        F = self.ffn
+        T = self.T
+        bn = self.block_n
+        bt = self.block_t
+        eps = self.eps
+        Nqkv = (Hq + 2 * Hkv) * hd
+        scale = hd ** -0.5
+        assert D % bn == 0 and F % bn == 0 and (Hq * hd) % bn == 0, \
+            (D, F, Hq * hd, bn)
+        assert Hq % Hkv == 0, (Hq, Hkv)
+        assert cache_k.shape == (Hkv, B, T, hd), (cache_k.shape,
+                                                  (Hkv, B, T, hd))
+        assert T % bt == 0
+
+        b = MegaKernelBuilder()
+        b.inputs("xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
+                 "w_ln2", "w_gu", "w_d", "cos", "sin", "ck", "cv",
+                 "pos", "copy_sem")
+        b.buffer("xn", (B, D), jnp.float32)
+        b.buffer("qkv", (B, Nqkv), jnp.float32)
+        b.buffer("attn", (B, Hq * hd), jnp.float32)
+        b.buffer("ores", (B, D), jnp.float32)
+        b.buffer("on", (B, D), jnp.float32)
+        b.buffer("h", (B, F), jnp.float32)
+        b.buffer("wt", (max(D, F, Hq * hd), bn), jnp.bfloat16)
+        b.buffer("kvst", (B, 8, hd), jnp.bfloat16)
+        b.buffer("kt", (B, bt, hd), jnp.bfloat16)
+        b.buffer("vt", (B, bt, hd), jnp.bfloat16)
+
+        b.add_task("ln1", functools.partial(_rmsnorm, dst="xn", src="xv",
+                                            w_name="w_ln1", eps=eps),
+                   reads=("xv", "w_ln1"), writes=("xn",))
+        b.add_task("qkv_mm",
+                   functools.partial(_mm_tiles, dst="qkv", src="xn",
+                                     w="w_qkv", rows=D, cols=Nqkv, bn=hd,
+                                     wt_name="wt"),
+                   reads=("xn", "w_qkv"), writes=("qkv",))
+
+        def rope_norm(env):
+            qkv = env["qkv"]
+            c = env["cos"][...]
+            s = env["sin"][...]
+            half = hd // 2
+            for hidx in range(Hq + Hkv):
+                off = hidx * hd
+                v = qkv[:, off:off + hd]
+                gw = (env["q_norm"][...] if hidx < Hq
+                      else env["k_norm"][...])
+                ms = jnp.mean(v * v, axis=-1, keepdims=True)
+                v = v * jax.lax.rsqrt(ms + eps) * gw
+                x1 = v[:, :half]
+                x2 = v[:, half:]
+                qkv[:, off:off + half] = x1 * c - x2 * s
+                qkv[:, off + half:off + hd] = x2 * c + x1 * s
+
+        b.add_task("rope_norm", rope_norm,
+                   reads=("qkv", "cos", "sin", "q_norm", "k_norm"),
+                   writes=("qkv",))
+
+        def cache_write(env):
+            # Mosaic requires T-dim DMA slices 8-sublane aligned, so a
+            # single-token append is a read-modify-write of its 8-token
+            # granule (cost: one [B, 8, hd] round trip per kv head)
+            qkv = env["qkv"]
+            p = env["pos"]
+            sem = env["copy_sem"]
+            gb = (p // 8) * 8
+            r = p - gb
+            row = jax.lax.broadcasted_iota(jnp.int32, (B, 8, hd), 1)
+            for g in range(Hkv):
+                for which, buf in (("k", "ck"), ("v", "cv")):
+                    base = (Hq + g) * hd if which == "k" else \
+                           (Hq + Hkv + g) * hd
+                    dst = env[buf].at[g, :, pl.ds(gb, 8), :]
+                    cp = pltpu.make_async_copy(dst, env["kvst"], sem)
+                    cp.start()
+                    cp.wait()
+                    new = qkv[:, base:base + hd].astype(jnp.bfloat16)
+                    env["kvst"][...] = jnp.where(
+                        row == r, new[:, None, :], env["kvst"][...])
+                    cp = pltpu.make_async_copy(env["kvst"], dst, sem)
+                    cp.start()
+                    cp.wait()
+
+        b.add_task("cache_write", cache_write,
+                   reads=("qkv", "ck", "cv"), writes=("ck", "cv"))
+
+        def flash(env):
+            qkv = env["qkv"]
+            p = env["pos"]
+            sem = env["copy_sem"]
+            nt = p // bt + 1
+            for g in range(Hkv):
+                q3 = qkv[:, g * rep * hd:(g + 1) * rep * hd].reshape(
+                    B, rep, hd).astype(jnp.bfloat16)
+
+                def body(t, carry, g=g, q3=q3):
+                    m, l, acc = carry
+                    cp_k = pltpu.make_async_copy(
+                        env["ck"].at[g, :, pl.ds(t * bt, bt), :],
+                        env["kt"], sem)
+                    cp_v = pltpu.make_async_copy(
+                        env["cv"].at[g, :, pl.ds(t * bt, bt), :],
+                        env["vt"], sem)
+                    cp_k.start()
+                    cp_v.start()
+                    cp_k.wait()
+                    cp_v.wait()
+                    s = jax.lax.dot_general(
+                        q3, env["kt"][...],
+                        (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32) * scale
+                    col = (t * bt
+                           + jax.lax.broadcasted_iota(
+                               jnp.int32, (B, rep, bt), 2))
+                    sm = jnp.where(col <= p, s, -1e30)
+                    m_new = jnp.maximum(m, jnp.max(sm, axis=-1))
+                    alpha = jnp.exp(m - m_new)
+                    pr = jnp.exp(sm - m_new[..., None])
+                    pr = jnp.where(col <= p, pr, 0.0)
+                    l_new = l * alpha + jnp.sum(pr, -1)
+                    acc_new = (acc * alpha[..., None]
+                               + jax.lax.dot_general(
+                                   pr.astype(jnp.bfloat16),
+                                   env["vt"][...],
+                                   (((2,), (1,)), ((0,), (0,))),
+                                   preferred_element_type=jnp.float32))
+                    return m_new, l_new, acc_new
+
+                m0 = jnp.full((B, rep), -1e30, jnp.float32)
+                l0 = jnp.zeros((B, rep), jnp.float32)
+                a0 = jnp.zeros((B, rep, hd), jnp.float32)
+                m, l, acc = jax.lax.fori_loop(0, nt, body, (m0, l0, a0))
+                out = (acc / jnp.maximum(l, 1e-30)[..., None]).reshape(
+                    B, rep * hd)
+                env["attn"][:, g * rep * hd:(g + 1) * rep * hd] = out
+
+        b.add_task("flash", flash, reads=("qkv", "ck", "cv"),
+                   writes=("attn",))
+        b.add_task("o_proj",
+                   functools.partial(_mm_tiles, dst="ores", src="attn",
+                                     w="w_o", rows=Hq * hd, cols=D,
+                                     bn=bn, wt_name="wt", add="xv"),
+                   reads=("attn", "w_o", "xv"), writes=("ores",))
+        b.add_task("ln2", functools.partial(_rmsnorm, dst="on",
+                                            src="ores", w_name="w_ln2",
+                                            eps=eps),
+                   reads=("ores", "w_ln2"), writes=("on",))
+
+        def gate_up(env):
+            # gate and up tiles fetched pairwise; swiglu fused in the
+            # epilogue (reference: the megakernel's MLP task)
+            wref = env["w_gu"]
+            wt = env["wt"]
+            sem = env["copy_sem"]
+            for j in range(F // bn):
+                sl = slice(j * bn, (j + 1) * bn)
+                cp = pltpu.make_async_copy(wref.at[:, sl], wt.at[:D, :bn],
+                                           sem)
+                cp.start()
+                cp.wait()
+                g = jax.lax.dot(env["on"][...].astype(jnp.bfloat16),
+                                wt[:D, :bn],
+                                preferred_element_type=jnp.float32)
+                sl2 = slice(F + j * bn, F + (j + 1) * bn)
+                cp = pltpu.make_async_copy(wref.at[:, sl2],
+                                           wt.at[:D, :bn], sem)
+                cp.start()
+                cp.wait()
+                u = jax.lax.dot(env["on"][...].astype(jnp.bfloat16),
+                                wt[:D, :bn],
+                                preferred_element_type=jnp.float32)
+                env["h"][:, sl] = g * jax.lax.logistic(g) * u
+
+        b.add_task("gate_up_swiglu", gate_up, reads=("on", "w_gu"),
+                   writes=("h",))
+        b.add_task("down_proj",
+                   functools.partial(_mm_tiles, dst="y", src="h",
+                                     w="w_d", rows=F, cols=D, bn=bn,
+                                     wt_name="wt", add="ores"),
+                   reads=("h", "w_d", "ores"), writes=("y",))
+
+        def kernel(pos_ref, x_ref, w_ln1, w_qkv, q_norm, k_norm, w_o,
+                   w_ln2, w_gu, w_d, cos_ref, sin_ref, ck, cv,
+                   y_ref, ck_out, cv_out,
+                   xn, qkvb, attn, ores, on, h, wt, kvst, kt, vt,
+                   copy_sem):
+            env = {
+                "pos": pos_ref[0], "xv": x_ref, "w_ln1": w_ln1,
+                "w_qkv": w_qkv, "q_norm": q_norm, "k_norm": k_norm,
+                "w_o": w_o, "w_ln2": w_ln2, "w_gu": w_gu, "w_d": w_d,
+                "cos": cos_ref, "sin": sin_ref, "ck": ck_out,
+                "cv": cv_out, "y": y_ref, "xn": xn, "qkv": qkvb,
+                "attn": attn, "ores": ores, "on": on, "h": h, "wt": wt,
+                "kvst": kvst, "kt": kt, "vt": vt, "copy_sem": copy_sem,
+            }
+            del ck, cv   # aliased to ck_out/cv_out
+            b.emit_all(env)
+
+        vm = pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM)
+        anym = pl.BlockSpec(memory_space=pl.ANY)
+        scratch = [pltpu.VMEM(shape, dt)
+                   for (shape, dt) in b.buffers.values()]
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+        y, ck2, cv2 = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[vm, vm, anym, vm, vm, anym, vm, anym, anym,
+                          vm, vm, anym, anym],
+                out_specs=(vm, anym, anym),
+                scratch_shapes=scratch,
+            ),
+            out_shape=(jax.ShapeDtypeStruct((B, D), jnp.float32),
+                       jax.ShapeDtypeStruct(cache_k.shape,
+                                            cache_k.dtype),
+                       jax.ShapeDtypeStruct(cache_v.shape,
+                                            cache_v.dtype)),
+            input_output_aliases={12: 1, 13: 2},
+            compiler_params=shmem_compiler_params(None),
+            interpret=interpret_mode(),
+        )(jnp.asarray(pos, jnp.int32)[None],
+          x.astype(jnp.float32),
+          weights["w_ln1"], weights["w_qkv"].astype(jnp.bfloat16),
+          weights["q_norm"], weights["k_norm"],
+          weights["w_o"].astype(jnp.bfloat16), weights["w_ln2"],
+          weights["w_gu"].astype(jnp.bfloat16),
+          weights["w_d"].astype(jnp.bfloat16),
+          weights["cos_row"], weights["sin_row"],
+          cache_k, cache_v)
+        return y, ck2, cv2
+
+
+def mega_decode_layer_ref(x, pos, weights, cache_k, cache_v, *,
+                          n_heads, n_kv_heads, head_dim, eps=1e-6):
+    """jnp oracle: the same layer step out of ordinary ops."""
+    B, D = x.shape
+    Hq, Hkv, hd = n_heads, n_kv_heads, head_dim
+    rep = Hq // Hkv
+    x = x.astype(jnp.float32)
+
+    def rms(v, g):
+        return v * jax.lax.rsqrt(
+            jnp.mean(v * v, -1, keepdims=True) + eps) * g
+
+    xn = rms(x, weights["w_ln1"][0])
+    qkv = xn @ weights["w_qkv"].astype(jnp.float32)
+    c = weights["cos_row"]
+    s = weights["sin_row"]
+    half = hd // 2
+
+    def rope_head(v, g):
+        v = rms(v, g)
+        x1, x2 = v[:, :half], v[:, half:]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+
+    heads = []
+    for hi in range(Hq + Hkv):
+        off = hi * hd
+        g = (weights["q_norm"][0] if hi < Hq else weights["k_norm"][0])
+        heads.append(rope_head(qkv[:, off:off + hd], g))
+    q = jnp.stack(heads[:Hq], 1)                       # [B, Hq, hd]
+    k_new = jnp.stack(heads[Hq:], 1)                   # [B, Hkv, hd]
+    v_new = qkv[:, (Hq + Hkv) * hd:].reshape(B, Hkv, hd)
+    ck = cache_k.at[:, :, pos, :].set(
+        k_new.transpose(1, 0, 2).astype(cache_k.dtype))
+    cv = cache_v.at[:, :, pos, :].set(
+        v_new.transpose(1, 0, 2).astype(cache_v.dtype))
+    T = ck.shape[2]
+    col = jnp.arange(T)
+    attn = []
+    for g in range(Hkv):
+        qg = q[:, g * rep:(g + 1) * rep].astype(jnp.float32)
+        kg = ck[g].astype(jnp.float32)                 # [B, T, hd]
+        vg = cv[g].astype(jnp.float32)
+        sc = jnp.einsum("brd,btd->brt", qg, kg) * hd ** -0.5
+        sc = jnp.where(col[None, None] <= pos, sc, -jnp.inf)
+        pr = jax.nn.softmax(sc, -1)
+        attn.append(jnp.einsum("brt,btd->brd", pr, vg))
+    a = jnp.concatenate(attn, 1).reshape(B, Hq * hd)
+    ores = a @ weights["w_o"].astype(jnp.float32) + x
+    on = rms(ores, weights["w_ln2"][0])
+    gu = on @ weights["w_gu"].astype(jnp.float32)
+    F = gu.shape[1] // 2
+    h = jax.nn.silu(gu[:, :F]) * gu[:, F:]
+    y = h @ weights["w_d"].astype(jnp.float32) + ores
+    return y, ck, cv
