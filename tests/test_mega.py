@@ -62,11 +62,15 @@ def test_mega_decode_layer_vs_oracle(pos):
 
 def test_builder_rejects_misordered_program():
     b = MegaKernelBuilder()
-    b.inputs("x")
+    b.inputs("x", "y")
     b.buffer("tmp", (4, 4), jnp.float32)
     with pytest.raises(ValueError, match="before any task wrote"):
         b.add_task("use_tmp", lambda env: None, reads=("tmp",),
                    writes=("y",))
+    # undeclared names are rejected outright
+    with pytest.raises(ValueError, match="undeclared"):
+        b.add_task("typo", lambda env: None, reads=("x",),
+                   writes=("tmpp",))
     # correct order passes
     b.add_task("make_tmp", lambda env: None, reads=("x",),
                writes=("tmp",))

@@ -40,6 +40,11 @@ from triton_dist_tpu.kernels.flash_attn import (  # noqa: F401
 )
 from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
     all_to_all,
+    low_latency_all_to_all,
+)
+from triton_dist_tpu.kernels.gdn import (  # noqa: F401
+    gdn_fwd,
+    gdn_fwd_ref,
 )
 from triton_dist_tpu.kernels.group_gemm import (  # noqa: F401
     grouped_gemm,

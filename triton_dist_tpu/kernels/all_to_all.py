@@ -71,6 +71,53 @@ def _a2a_pallas(x_local, *, n: int, axis: str, collective_id: int):
     return y[:, :cols] if colsp != cols else y
 
 
+def low_latency_all_to_all(x, *, mesh: Mesh, axis: str = "ep",
+                           quantize: bool = True,
+                           collective_id: Optional[int] = None):
+    """Latency-path A2A for tiny decode payloads (reference:
+    low_latency_all_to_all.py:198 — fp8-packed single-message exchange;
+    README.md:99's 137us EP dispatch). Same transpose semantics as
+    all_to_all; the payload is int8-quantized per row (scale rides in a
+    second small put), cutting the wire bytes ~2x vs bf16 / 4x vs f32
+    for the latency-bound small-token case. quantize=False degrades to
+    the plain one-shot path.
+
+    x: [n, n, C, D] sharded on dim 0 (row-major chunks). Lossy: int8
+    rowwise quantization (the same tradeoff the reference's fp8 LL
+    protocol makes)."""
+    n = mesh.shape[axis]
+    if n == 1 or not quantize:
+        return all_to_all(x, mesh=mesh, axis=axis,
+                          collective_id=collective_id)
+    if collective_id is None:
+        collective_id = next_collective_id()
+    _, n2, C, D = x.shape
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(axis, None, None, None),
+        out_specs=P(axis, None, None, None), check_vma=False)
+    def _f(x_loc):
+        flat = x_loc.reshape(n2 * C, D)
+        amax = jnp.max(jnp.abs(flat), axis=-1, keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q8 = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+        # ONE exchange: the f32 scale rides as 4 int8 lanes appended to
+        # its row's payload (the reference LL protocol packs the fp8
+        # scale into the same message for the same reason)
+        sc8 = jax.lax.bitcast_convert_type(
+            scale.astype(jnp.float32), jnp.int8).reshape(n2 * C, 4)
+        packed = jnp.concatenate([q8, sc8], axis=1)
+        y = _a2a_pallas(packed, n=n, axis=axis,
+                        collective_id=collective_id)
+        ys = jax.lax.bitcast_convert_type(
+            y[:, D:D + 4].reshape(n2 * C, 1, 4), jnp.float32)
+        out = y[:, :D].astype(jnp.float32) * ys.reshape(n2 * C, 1)
+        return out.reshape(x_loc.shape).astype(x_loc.dtype)
+
+    return _f(x)
+
+
 def all_to_all(x, *, mesh: Mesh, axis: str = "ep",
                collective_id: Optional[int] = None):
     """x: [n, n, C, ...] sharded on dim 0 over `axis`; x[d, p] is device

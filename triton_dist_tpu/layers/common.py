@@ -14,11 +14,16 @@ import numpy as np
 
 
 def rms_norm(x, weight, eps: float = 1e-6):
-    """RMSNorm in f32 accumulation (Qwen3-style)."""
+    """RMSNorm in f32 accumulation (Qwen3-style). The result keeps x's
+    dtype: an f32 weight must not promote the activation — a bf16
+    activation silently becoming f32 here used to cascade into
+    full-KV-cache dtype converts per layer per decode step (55% of the
+    step time on the profile)."""
     dt = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)).astype(dt) * weight
+    out = x32 * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)
+    return out.astype(dt)
 
 
 def precompute_rope(head_dim: int, max_seq: int, theta: float = 1e6):

@@ -238,7 +238,10 @@ class TP_Attn:
                 cv_loc, v.transpose(0, 2, 1, 3).astype(cv_loc.dtype),
                 (0, 0, kv_start, 0))
             attend = flash_decode if impl == "flash" else attention_cached_ref
-            o = attend(q, ck_loc.astype(q.dtype), cv_loc.astype(q.dtype),
+            # cast the [S]-sized query side to the cache dtype — NEVER
+            # the [T]-sized cache to the query dtype (a full-cache
+            # convert per layer per step)
+            o = attend(q.astype(ck_loc.dtype), ck_loc, cv_loc,
                        kv_start + S, scale=scale)
             return o.reshape(M, hq * hd), ck_loc, cv_loc
 

@@ -49,6 +49,12 @@ class MegaKernelBuilder:
     def add_task(self, name: str, emit: Callable, *,
                  reads: Sequence[str] = (),
                  writes: Sequence[str] = ()) -> None:
+        known = set(self._buffers) | self._written
+        for nm in (*reads, *writes):
+            if nm not in known:
+                raise ValueError(
+                    f"task {name!r} references undeclared name {nm!r} "
+                    "(declare it with buffer()/inputs())")
         for r in reads:
             if r not in self._written:
                 raise ValueError(

@@ -35,20 +35,43 @@ from triton_dist_tpu.mega.builder import MegaKernelBuilder
 from triton_dist_tpu.runtime import interpret_mode, shmem_compiler_params
 
 
+def _pick_bn(total: int, want: int) -> int:
+    """Largest 128-multiple tile <= want dividing `total` (sliced DMAs
+    must be 128-aligned in the minor dim)."""
+    b = min(want, total) // 128 * 128
+    while b > 0 and total % b:
+        b -= 128
+    assert b > 0, (total, want)
+    return b
+
+
 def _mm_tiles(env, dst, src, w, rows, cols, bn, wt_name, add=None,
               act=None):
-    """Tiled matmul task body: dst[:, j*bn:...] = src @ w_tile (+add)."""
+    """Tiled matmul task body: dst[:, j*bn:...] = src @ w_tile (+add).
+    Weight tiles are double-buffered: the fetch of tile j+1 is in
+    flight under the dot of tile j, so the MXU never stalls on HBM."""
     w_ref = env[w]
     wt = env[wt_name]
-    copy_sem = env["copy_sem"]
-    for j in range(cols // bn):
+    sems = env["copy_sems"]
+    nt = cols // bn
+
+    def fetch(j, slot):
         sl = slice(j * bn, (j + 1) * bn)
-        cp = pltpu.make_async_copy(w_ref.at[:, sl], wt.at[:rows, :bn],
-                                   copy_sem)
+        cp = pltpu.make_async_copy(
+            w_ref.at[:, sl], wt.at[slot, :rows, :bn], sems.at[slot])
         cp.start()
-        cp.wait()
+        return cp
+
+    fetch(0, 0)
+    for j in range(nt):
+        slot = j % 2
+        pltpu.make_async_copy(w_ref.at[:, :bn], wt.at[slot, :rows, :bn],
+                              sems.at[slot]).wait()
+        if j + 1 < nt:
+            fetch(j + 1, (j + 1) % 2)
+        sl = slice(j * bn, (j + 1) * bn)
         acc = jax.lax.dot(env[src][...].astype(jnp.bfloat16),
-                          wt[:rows, :bn],
+                          wt[slot, :rows, :bn],
                           preferred_element_type=jnp.float32)
         if add is not None:
             acc = acc + env[add][:, sl]
@@ -110,14 +133,14 @@ class MegaDecodeLayer:
         b = MegaKernelBuilder()
         b.inputs("xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
                  "w_ln2", "w_gu", "w_d", "cos", "sin", "ck", "cv",
-                 "pos", "copy_sem")
+                 "pos", "copy_sem", "copy_sems", "y")
         b.buffer("xn", (B, D), jnp.float32)
         b.buffer("qkv", (B, Nqkv), jnp.float32)
         b.buffer("attn", (B, Hq * hd), jnp.float32)
         b.buffer("ores", (B, D), jnp.float32)
         b.buffer("on", (B, D), jnp.float32)
         b.buffer("h", (B, F), jnp.float32)
-        b.buffer("wt", (max(D, F, Hq * hd), bn), jnp.bfloat16)
+        b.buffer("wt", (2, max(D, F, Hq * hd), bn), jnp.bfloat16)
         b.buffer("kvst", (B, 8, hd), jnp.bfloat16)
         b.buffer("kt", (B, bt, hd), jnp.bfloat16)
         b.buffer("vt", (B, bt, hd), jnp.bfloat16)
@@ -127,9 +150,10 @@ class MegaDecodeLayer:
                    reads=("xv", "w_ln1"), writes=("xn",))
         b.add_task("qkv_mm",
                    functools.partial(_mm_tiles, dst="qkv", src="xn",
-                                     w="w_qkv", rows=D, cols=Nqkv, bn=hd,
+                                     w="w_qkv", rows=D, cols=Nqkv,
+                                     bn=_pick_bn(Nqkv, bn),
                                      wt_name="wt"),
-                   reads=("xn", "w_qkv"), writes=("qkv",))
+                   reads=("xn", "w_qkv"), writes=("qkv", "wt"))
 
         def rope_norm(env):
             qkv = env["qkv"]
@@ -236,50 +260,52 @@ class MegaDecodeLayer:
                    functools.partial(_mm_tiles, dst="ores", src="attn",
                                      w="w_o", rows=Hq * hd, cols=D,
                                      bn=bn, wt_name="wt", add="xv"),
-                   reads=("attn", "w_o", "xv"), writes=("ores",))
+                   reads=("attn", "w_o", "xv"), writes=("ores", "wt"))
         b.add_task("ln2", functools.partial(_rmsnorm, dst="on",
                                             src="ores", w_name="w_ln2",
                                             eps=eps),
                    reads=("ores", "w_ln2"), writes=("on",))
 
         def gate_up(env):
-            # gate and up tiles fetched pairwise; swiglu fused in the
-            # epilogue (reference: the megakernel's MLP task)
+            # gate and up tiles in separate slots: the up-tile DMA is in
+            # flight under the gate dot; swiglu fused in the epilogue
+            # (reference: the megakernel's MLP task)
             wref = env["w_gu"]
             wt = env["wt"]
-            sem = env["copy_sem"]
+            sems = env["copy_sems"]
+            on_bf = None
             for j in range(F // bn):
                 sl = slice(j * bn, (j + 1) * bn)
-                cp = pltpu.make_async_copy(wref.at[:, sl], wt.at[:D, :bn],
-                                           sem)
-                cp.start()
-                cp.wait()
-                g = jax.lax.dot(env["on"][...].astype(jnp.bfloat16),
-                                wt[:D, :bn],
-                                preferred_element_type=jnp.float32)
                 sl2 = slice(F + j * bn, F + (j + 1) * bn)
-                cp = pltpu.make_async_copy(wref.at[:, sl2],
-                                           wt.at[:D, :bn], sem)
-                cp.start()
-                cp.wait()
-                u = jax.lax.dot(env["on"][...].astype(jnp.bfloat16),
-                                wt[:D, :bn],
+                cpg = pltpu.make_async_copy(wref.at[:, sl],
+                                            wt.at[0, :D, :bn], sems.at[0])
+                cpu = pltpu.make_async_copy(wref.at[:, sl2],
+                                            wt.at[1, :D, :bn], sems.at[1])
+                cpg.start()
+                cpu.start()
+                if on_bf is None:
+                    on_bf = env["on"][...].astype(jnp.bfloat16)
+                cpg.wait()
+                g = jax.lax.dot(on_bf, wt[0, :D, :bn],
+                                preferred_element_type=jnp.float32)
+                cpu.wait()
+                u = jax.lax.dot(on_bf, wt[1, :D, :bn],
                                 preferred_element_type=jnp.float32)
                 env["h"][:, sl] = g * jax.lax.logistic(g) * u
 
         b.add_task("gate_up_swiglu", gate_up, reads=("on", "w_gu"),
-                   writes=("h",))
+                   writes=("h", "wt"))
         b.add_task("down_proj",
                    functools.partial(_mm_tiles, dst="y", src="h",
                                      w="w_d", rows=F, cols=D, bn=bn,
                                      wt_name="wt", add="ores"),
-                   reads=("h", "w_d", "ores"), writes=("y",))
+                   reads=("h", "w_d", "ores"), writes=("y", "wt"))
 
         def kernel(pos_ref, x_ref, w_ln1, w_qkv, q_norm, k_norm, w_o,
                    w_ln2, w_gu, w_d, cos_ref, sin_ref, ck, cv,
                    y_ref, ck_out, cv_out,
                    xn, qkvb, attn, ores, on, h, wt, kvst, kt, vt,
-                   copy_sem):
+                   copy_sem, copy_sems):
             env = {
                 "pos": pos_ref[0], "xv": x_ref, "w_ln1": w_ln1,
                 "w_qkv": w_qkv, "q_norm": q_norm, "k_norm": k_norm,
@@ -288,6 +314,7 @@ class MegaDecodeLayer:
                 "cv": cv_out, "y": y_ref, "xn": xn, "qkv": qkvb,
                 "attn": attn, "ores": ores, "on": on, "h": h, "wt": wt,
                 "kvst": kvst, "kt": kt, "vt": vt, "copy_sem": copy_sem,
+                "copy_sems": copy_sems,
             }
             del ck, cv   # aliased to ck_out/cv_out
             b.emit_all(env)
@@ -297,6 +324,7 @@ class MegaDecodeLayer:
         scratch = [pltpu.VMEM(shape, dt)
                    for (shape, dt) in b.buffers.values()]
         scratch.append(pltpu.SemaphoreType.DMA(()))
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
         y, ck2, cv2 = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
