@@ -46,6 +46,11 @@ from triton_dist_tpu.kernels.gdn import (  # noqa: F401
     gdn_fwd,
     gdn_fwd_ref,
 )
+from triton_dist_tpu.kernels.grad import (  # noqa: F401
+    ag_gemm_grad,
+    gemm_ar_grad,
+    gemm_rs_grad,
+)
 from triton_dist_tpu.kernels.group_gemm import (  # noqa: F401
     grouped_gemm,
     grouped_gemm_ref,

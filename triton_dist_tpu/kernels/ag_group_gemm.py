@@ -1,0 +1,154 @@
+"""Fused AllGather + GroupGEMM: the MoE tensor-parallel prefill path.
+
+TPU-native re-design of the reference AG-GroupGEMM
+(`python/triton_dist/kernels/nvidia/allgather_group_gemm.py:253` —
+cp-engine producers push token chunks while a persistent grouped GEMM
+consumes them per-expert as their barriers land). Same structure as
+this repo's dense ag_gemm ring: every ring step forwards the
+capacity-chunk received last step to the right neighbor while the MXU
+multiplies the chunk that just arrived against every expert's local
+weight columns — the chunk DMA for step s+1 rides under the E grouped
+dots of step s.
+
+Contract (capacity-grouped layout, the static-shape analog of the
+reference's max_M workspaces):
+  x_e [E, capT, D]  tokens grouped per expert, capT sharded over `axis`
+  w   [E, D, N]     expert weights, N sharded over `axis`
+  ->  y [E, capT, N] with N sharded (every rank holds all tokens'
+      activations for its N/n expert-weight columns)
+
+v1 rereads each expert's B panel once per ring step when it exceeds
+the resident tile (same tradeoff as ag_gemm's nt>1 path; the autotuner
+picks block_n so typical MoE column shards stay resident).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
+
+
+def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
+                          x_ref, w_ref, ag_ref, o_ref,
+                          a_vmem, b_vmem, o_vmem,
+                          copy_sem, send_sem, o_sem, b_sem, recv_sems):
+    """Ring AG of capacity chunks + per-expert GEMM consumption.
+    x_ref: [E, c_loc, D]; w_ref: [E, D, n_loc]; ag_ref: [E, capT, D];
+    o_ref: [E, capT, n_loc]."""
+    me = dl.my_pe(axis)
+    _, c_loc, D = x_ref.shape
+    n_loc = w_ref.shape[2]
+    nt = pl.cdiv(n_loc, block_n)
+
+    # stage own chunk into the gathered buffer
+    cp = pltpu.make_async_copy(
+        x_ref, ag_ref.at[:, pl.ds(me * c_loc, c_loc), :], copy_sem)
+    cp.start()
+    cp.wait()
+    dl.barrier_all(axis)
+
+    _, right = dl.ring_neighbors(axis)
+    for s in range(n):
+        src = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+        if s < n - 1:
+            # forward the chunk we are about to consume (per-chunk recv
+            # semaphores: arrivals may complete out of order)
+            dl.putmem_nbi(ag_ref.at[:, pl.ds(src * c_loc, c_loc), :],
+                          ag_ref.at[:, pl.ds(src * c_loc, c_loc), :],
+                          send_sem, recv_sems.at[src], right, axis)
+        for e in range(E):
+            cp = pltpu.make_async_copy(
+                ag_ref.at[e, pl.ds(src * c_loc, c_loc), :], a_vmem,
+                copy_sem)
+            cp.start()
+            cp.wait()
+            for j in range(nt):
+                cp = pltpu.make_async_copy(
+                    w_ref.at[e, :, pl.ds(j * block_n, block_n)], b_vmem,
+                    b_sem)
+                cp.start()
+                cp.wait()
+                acc = jnp.dot(a_vmem[...], b_vmem[...],
+                              preferred_element_type=jnp.float32)
+                o_vmem[...] = acc.astype(o_vmem.dtype)
+                cp = pltpu.make_async_copy(
+                    o_vmem,
+                    o_ref.at[e, pl.ds(src * c_loc, c_loc),
+                             pl.ds(j * block_n, block_n)], o_sem)
+                cp.start()
+                cp.wait()
+        if s < n - 1:
+            nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
+            pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
+    dl.quiet(send_sem, x_ref, n - 1)
+
+
+def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
+                  block_n: int = 512,
+                  collective_id: Optional[int] = None):
+    """y[e] = allgather(x_e[e]) @ w[e] for every expert, overlapped
+    (reference: ag_group_gemm, allgather_group_gemm.py:253).
+
+    x_e: [E, capT, D] capacity-grouped tokens, capT sharded over `axis`;
+    w: [E, D, N] expert weights, N sharded. Returns [E, capT, N] with N
+    sharded over `axis`."""
+    n = mesh.shape[axis]
+    E, capT, D = x_e.shape
+    N = w.shape[2]
+    assert capT % n == 0 and N % n == 0, (capT, N, n)
+    c_loc, n_loc = capT // n, N // n
+    if collective_id is None:
+        collective_id = next_collective_id()
+    bn = _divisor_block(n_loc, block_n)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis, None), P(None, None, axis)),
+        out_specs=P(None, None, axis), check_vma=False)
+    def _f(x_loc, w_loc):
+        kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn)
+        _, out = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((E, capT, D), x_loc.dtype),
+                jax.ShapeDtypeStruct((E, capT, n_loc), x_loc.dtype),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.VMEM((c_loc, D), x_loc.dtype),
+                pltpu.VMEM((D, bn), w_loc.dtype),
+                pltpu.VMEM((c_loc, bn), x_loc.dtype),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((n,)),
+            ],
+            compiler_params=shmem_compiler_params(collective_id, n=n),
+            interpret=interpret_mode(),
+        )(x_loc, w_loc)
+        return out
+
+    return _f(x_e, w)
+
+
+def ag_group_gemm_ref(x_e, w):
+    """jnp oracle: per-expert full GEMM on gathered tokens."""
+    return jnp.einsum("ecd,edn->ecn", x_e.astype(jnp.float32),
+                      w.astype(jnp.float32)).astype(x_e.dtype)

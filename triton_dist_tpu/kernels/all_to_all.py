@@ -77,10 +77,11 @@ def low_latency_all_to_all(x, *, mesh: Mesh, axis: str = "ep",
     """Latency-path A2A for tiny decode payloads (reference:
     low_latency_all_to_all.py:198 — fp8-packed single-message exchange;
     README.md:99's 137us EP dispatch). Same transpose semantics as
-    all_to_all; the payload is int8-quantized per row (scale rides in a
-    second small put), cutting the wire bytes ~2x vs bf16 / 4x vs f32
-    for the latency-bound small-token case. quantize=False degrades to
-    the plain one-shot path.
+    all_to_all; the payload is int8-quantized per row with the f32 scale
+    packed into the SAME message as 4 extra int8 lanes (one exchange),
+    cutting the wire bytes ~2x vs bf16 / 4x vs f32 for the
+    latency-bound small-token case. quantize=False degrades to the
+    plain one-shot path.
 
     x: [n, n, C, D] sharded on dim 0 (row-major chunks). Lossy: int8
     rowwise quantization (the same tradeoff the reference's fp8 LL

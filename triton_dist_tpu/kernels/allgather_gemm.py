@@ -159,16 +159,7 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
     dl.quiet(send_sem, a_ref, n - 1)
 
 
-def _divisor_block(n_loc: int, block_n: int) -> int:
-    """Shrink block_n (in lane-width steps) until it divides n_loc; tiles
-    must cover n_loc exactly since the DMA slices are unmasked."""
-    b = min(block_n, n_loc)
-    if n_loc < 128:
-        return n_loc
-    b = b // 128 * 128
-    while b > 0 and n_loc % b:
-        b -= 128
-    return b if b > 0 else n_loc
+from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext):

@@ -64,14 +64,7 @@ def create_gemm_rs_context(mesh: Mesh, axis: str = "tp", *,
                        else next_collective_id()))
 
 
-def _divisor_block(n_total: int, block: int) -> int:
-    b = min(block, n_total)
-    if n_total < 128:
-        return n_total
-    b = b // 128 * 128
-    while b > 0 and n_total % b:
-        b -= 128
-    return b if b > 0 else n_total
+from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _gemm_rs_kernel(n: int, axis: str, block_n: int,

@@ -1,0 +1,137 @@
+"""Custom VJPs for the fused comm ops: training through the overlapped
+kernels.
+
+Reference analog: the autograd wrappers over the dist ops
+(`python/triton_dist/layers/nvidia/` forward modes are wrapped in
+torch.autograd.Functions so TP training runs through the Triton
+kernels). Here each backward is itself one of this repo's fused
+kernels — the TP calculus closes over {ag_gemm, gemm_rs, gemm_ar}:
+
+    y = ag_gemm(a, b)      = AG(a) @ b      (a row-sharded, b col-sharded)
+      da = gemm_rs(dy, b^T)                 (dy col-sh as rows-of-K... see below)
+      db = AG(a)^T @ dy                     (local GEMM on the saved gather)
+    y = gemm_rs(a, b)      = RS(a @ b)      (a col-sharded K, b row-sharded K)
+      da = ag_gemm(dy, b^T)
+      db = a^T @ AG(dy)                     (local partial — b is row-sharded)
+    y = gemm_allreduce(a, b) = AR(a @ b)
+      da = dy @ b^T (col slice), db = a^T slice @ dy
+
+Shapes follow each op's host contract; every backward was checked
+against jax.grad of the pure-XLA oracle path (tests/test_grad.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm
+from triton_dist_tpu.kernels.gemm_allreduce import gemm_allreduce
+from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+
+def _local(mesh, in_specs, out_specs, f):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def ag_gemm_grad(mesh: Mesh, axis: str = "tp"):
+    """Differentiable ag_gemm: a [M, K] row-sharded, b [K, N]
+    col-sharded -> y [M, N] col-sharded."""
+
+    @jax.custom_vjp
+    def op(a, b):
+        return ag_gemm(a, b, mesh=mesh, axis=axis)
+
+    def fwd(a, b):
+        y, ag = ag_gemm(a, b, mesh=mesh, axis=axis, return_ag=True)
+        return y, (ag, b)
+
+    def bwd(res, dy):
+        ag, b = res
+        # da_full = dy @ b^T has a col-sharded contraction -> the
+        # row-parallel GEMM+RS epilogue IS that computation
+        da = gemm_rs(dy, _transpose_rows(b, mesh, axis), mesh=mesh,
+                     axis=axis)
+        # db: contraction over M with dy col-sharded -> local GEMM on
+        # the saved gathered activations (the reference reuses the ctx
+        # workspace the same way)
+        db = _local(mesh, (P(None, None), P(None, axis)),
+                    P(None, axis),
+                    lambda agf, dyl: agf.T @ dyl)(ag, dy)
+        return da, db
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def gemm_rs_grad(mesh: Mesh, axis: str = "tp"):
+    """Differentiable gemm_rs: a [M, K] col-sharded (K over axis),
+    b [K, N] row-sharded -> y [M, N] row-sharded over axis."""
+
+    @jax.custom_vjp
+    def op(a, b):
+        return gemm_rs(a, b, mesh=mesh, axis=axis)
+
+    def fwd(a, b):
+        return gemm_rs(a, b, mesh=mesh, axis=axis), (a, b)
+
+    def bwd(res, dy):
+        a, b = res
+        # da = AG(dy) @ b^T with b row-sharded -> ag_gemm
+        da = ag_gemm(dy, _transpose_cols(b, mesh, axis), mesh=mesh,
+                     axis=axis)
+        # db_loc = a_loc^T @ AG(dy): gather dy once, local contraction
+        db = _local(mesh, (P(None, axis), P(axis, None)),
+                    P(axis, None),
+                    lambda al, dyl: al.T @ jax.lax.all_gather(
+                        dyl, axis, axis=0, tiled=True))(a, dy)
+        return da, db
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def gemm_ar_grad(mesh: Mesh, axis: str = "tp"):
+    """Differentiable gemm_allreduce: a [M, K] col-sharded, b [K, N]
+    row-sharded -> y [M, N] replicated."""
+
+    @jax.custom_vjp
+    def op(a, b):
+        return gemm_allreduce(a, b, mesh=mesh, axis=axis)
+
+    def fwd(a, b):
+        return gemm_allreduce(a, b, mesh=mesh, axis=axis), (a, b)
+
+    def bwd(res, dy):
+        a, b = res
+        # dy replicated: da col slice = dy @ (b_loc)^T; db row slice =
+        # a_loc^T @ dy — both local, zero collectives (the AR's adjoint
+        # is the identity on a replicated cotangent)
+        da = _local(mesh, (P(None, None), P(axis, None)),
+                    P(None, axis),
+                    lambda dyr, bl: dyr @ bl.T)(dy, b)
+        db = _local(mesh, (P(None, axis), P(None, None)),
+                    P(axis, None),
+                    lambda al, dyr: al.T @ dyr)(a, dy)
+        return da, db
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def _transpose_rows(b, mesh, axis):
+    """b [K, N] col-sharded -> b^T [N, K] row-sharded (a local
+    transpose: the shard each device holds is its own slice of both)."""
+    return _local(mesh, P(None, axis), P(axis, None),
+                  lambda bl: bl.T)(b)
+
+
+def _transpose_cols(b, mesh, axis):
+    """b [K, N] row-sharded -> b^T [N, K] col-sharded."""
+    return _local(mesh, P(axis, None), P(None, axis),
+                  lambda bl: bl.T)(b)

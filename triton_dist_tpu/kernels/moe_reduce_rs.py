@@ -1,0 +1,159 @@
+"""Fused GroupGEMM + ReduceScatter: the MoE TP down-projection epilogue.
+
+TPU-native re-design of the reference MoE-reduce-RS
+(`python/triton_dist/kernels/nvidia/moe_reduce_rs.py:168` — the expert
+down-proj GEMM whose epilogue feeds a reduce-scatter over the TP group
+instead of materializing full partials). Ring protocol identical to
+this repo's dense gemm_rs (producer GEMM under the in-flight RDMA,
+credit/slot semaphores), with the per-step payload widened to a SLAB:
+all E experts' [c_loc, D] partial chunks travel in one ring message, so
+the grouped structure adds zero extra protocol rounds.
+
+Contract (row-parallel expert weights):
+  h  [E, capT, F]  expert activations, F sharded over `axis`
+  w2 [E, F, D]     down-proj weights, F (rows) sharded
+  -> y [E, capT, D] summed over ranks, capT sharded (rank r owns rows
+     [r*capT/n, (r+1)*capT/n) of every expert)
+
+v1 rereads each expert's B panel once per ring step (same tradeoff the
+dense gemm_rs takes for nt > 1)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+def _moe_rs_kernel(n: int, axis: str, E: int,
+                   a_ref, b_ref, o_ref, land_ref, send_buf,
+                   a_vmem, b_vmem, p_vmem, tmp_vmem,
+                   copy_sem, send_sems, recv_sems, credit_sem):
+    """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
+    o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D]."""
+    me = dl.my_pe(axis)
+    _, c_loc, D = o_ref.shape
+    left, right = dl.ring_neighbors(axis)
+    dl.barrier_all(axis)
+
+    for s in range(n):
+        slot = s % 2
+        last = s == n - 1
+        chunk = jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
+        dest = o_ref if last else send_buf.at[slot]
+        if s >= 2 and not last:
+            dl.quiet(send_sems.at[slot], send_buf.at[slot], 1)
+        # --- producer: E grouped dots for this chunk; the slab RDMA of
+        # step s-1 is in flight under them
+        for e in range(E):
+            cp = pltpu.make_async_copy(
+                a_ref.at[e, pl.ds(chunk * c_loc, c_loc), :], a_vmem,
+                copy_sem)
+            cp.start()
+            cp.wait()
+            cp = pltpu.make_async_copy(b_ref.at[e], b_vmem, copy_sem)
+            cp.start()
+            cp.wait()
+            p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
+                                  preferred_element_type=jnp.float32)
+            tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+            cp = pltpu.make_async_copy(tmp_vmem, dest.at[e], copy_sem)
+            cp.start()
+            cp.wait()
+        if s >= 1:
+            # consumer: fold the accumulated slab from the left
+            pltpu.make_async_copy(o_ref, o_ref,
+                                  recv_sems.at[(s - 1) % 2]).wait()
+            prev = (s - 1) % 2
+            for e in range(E):
+                cp = pltpu.make_async_copy(dest.at[e], tmp_vmem, copy_sem)
+                cp.start()
+                cp.wait()
+                p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
+                cp = pltpu.make_async_copy(land_ref.at[prev, e], tmp_vmem,
+                                           copy_sem)
+                cp.start()
+                cp.wait()
+                p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(
+                    jnp.float32)
+                tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
+                cp = pltpu.make_async_copy(tmp_vmem, dest.at[e], copy_sem)
+                cp.start()
+                cp.wait()
+            dl.signal_op(credit_sem, 1, left, axis)
+        if not last:
+            if s >= 2:
+                pltpu.semaphore_wait(credit_sem, 1)
+            dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
+                          send_sems.at[slot], recv_sems.at[slot], right,
+                          axis)
+    if n > 1:
+        dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
+        if n > 2:
+            dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
+        pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+
+
+def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
+                  collective_id: Optional[int] = None):
+    """y = reduce_scatter(sum over F of h @ w2) per expert, fused
+    (reference: moe_reduce_rs.py:168). h: [E, capT, F] F-sharded;
+    w2: [E, F, D] F-row-sharded. Returns [E, capT, D] capT-sharded."""
+    n = mesh.shape[axis]
+    E, capT, F = h.shape
+    D = w2.shape[2]
+    assert capT % n == 0, (capT, n)
+    c_loc = capT // n
+    if collective_id is None:
+        collective_id = next_collective_id()
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis, None)),
+        out_specs=P(None, axis, None), check_vma=False)
+    def _f(h_loc, w_loc):
+        f_loc = h_loc.shape[2]
+        kernel = functools.partial(_moe_rs_kernel, n, axis, E)
+        out, _, _ = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((E, c_loc, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((2, E, c_loc, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((2, E, c_loc, D), h_loc.dtype),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                            for _ in range(3)),
+            scratch_shapes=[
+                pltpu.VMEM((c_loc, f_loc), h_loc.dtype),
+                pltpu.VMEM((f_loc, D), w_loc.dtype),
+                pltpu.VMEM((c_loc, D), jnp.float32),
+                pltpu.VMEM((c_loc, D), h_loc.dtype),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=shmem_compiler_params(collective_id, n=n),
+            interpret=interpret_mode(),
+        )(h_loc, w_loc)
+        return out
+
+    return _f(h, w2)
+
+
+def moe_reduce_rs_ref(h, w2):
+    """jnp oracle: full grouped GEMM (the reduce over F happens in the
+    unsharded contraction; callers slice rows per rank)."""
+    return jnp.einsum("ecf,efd->ecd", h.astype(jnp.float32),
+                      w2.astype(jnp.float32)).astype(h.dtype)

@@ -157,6 +157,62 @@ class TP_MoE:
         y_partial = jax.vmap(_scatter)(y_parts).astype(x.dtype)  # [n, M, D]
         return reduce_scatter(y_partial, mesh=self.mesh, axis=self.axis)
 
+    def fwd_fused(self, x):
+        """Fully fused path: ag_group_gemm (ring-AG of capacity chunks
+        consumed by per-expert GEMMs) + moe_reduce_rs (grouped down-proj
+        whose epilogue ring-reduce-scatters the slabs) — the reference's
+        allgather_group_gemm.py:253 + moe_reduce_rs.py:168 pair. x
+        row-sharded [M, D] -> row-sharded [M, D]; routing/grouping is
+        rank-local, so rank r's capacity block r holds its own tokens
+        and the RS hands each rank exactly its combine inputs back."""
+        from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
+        from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
+        axis = self.axis
+        n = self.mesh.shape[axis]
+        M = x.shape[0]
+        m_loc = M // n
+        E, k = self.num_experts, self.top_k
+        cap_loc = self._cap(m_loc)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=(P(None, axis, None), P(axis, None), P(axis, None),
+                       P(axis, None, None)),
+            check_vma=False)
+        def prep(x_loc, w_router):
+            topk_w, topk_idx = route(x_loc @ w_router, k)
+            x_e, inv_slot, token = group_tokens_by_expert(
+                x_loc, topk_idx, E, cap_loc)
+            return (x_e, inv_slot[None], token[None], topk_w[None])
+
+        x_e, inv_slot, token, topk_w = prep(x, self.w_router)
+        h = ag_group_gemm(x_e, self.w_gate_up.astype(x.dtype),
+                          mesh=self.mesh, axis=axis)
+
+        # local slice is packed [gate_r | up_r]: swiglu splits halves
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=P(None, None, axis), out_specs=P(None, None, axis),
+            check_vma=False)
+        def act(h_loc):
+            return swiglu_ref(h_loc)
+
+        h2 = act(h)
+        y_e = moe_reduce_rs(h2, self.w_down.astype(x.dtype),
+                            mesh=self.mesh, axis=axis)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, axis, None), P(axis, None), P(axis, None),
+                      P(axis, None, None)),
+            out_specs=P(axis, None), check_vma=False)
+        def combine(y_loc, inv_loc, tok_loc, w_loc):
+            return scatter_weighted(y_loc, inv_loc[0], tok_loc[0],
+                                    w_loc[0], m_loc).astype(x.dtype)
+
+        return combine(y_e, inv_slot, token, topk_w)
+
     def fwd_local(self, x):
         """Single-chip framework path: route + grouped-GEMM kernels with
         everything resident (the MoE analog of TP_MLP.fwd_flash)."""
@@ -171,6 +227,8 @@ class TP_MoE:
                                 M).astype(x.dtype)
 
     def __call__(self, x, mode: str = "dist"):
+        if mode == "fused":
+            return self.fwd_fused(x)
         if mode in ("dist",):
             return self.fwd_dist(x)
         if mode in ("flash", "ar", "gemm_ar"):

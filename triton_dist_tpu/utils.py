@@ -87,3 +87,17 @@ def env_flag(name: str, default: bool = False) -> bool:
     if v is None:
         return default
     return v.lower() not in ("0", "false", "off", "")
+
+
+def divisor_block(n_total: int, block: int) -> int:
+    """Largest lane-aligned (128-multiple) tile <= block dividing
+    n_total; totals under one lane row pass through whole. Shared by
+    every fused kernel that slices weight panels (sliced DMAs must be
+    128-aligned in the minor dim)."""
+    b = min(block, n_total)
+    if n_total < 128:
+        return n_total
+    b = b // 128 * 128
+    while b > 0 and n_total % b:
+        b -= 128
+    return b if b > 0 else n_total
