@@ -1,0 +1,82 @@
+"""End-to-end training-path tests: loss AND weight gradients of the
+kernel train mode (custom-VJP ag_gemm/gemm_rs + Pallas flash attention)
+vs jax.grad through the pure-XLA oracle (reference analog: training
+through the autograd-wrapped dist layers checked against the torch
+path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import AutoLLM, tiny_qwen3
+
+mesh = None
+model = None
+
+
+def setup_module(module):
+    global mesh, model
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+    model = AutoLLM.from_config(tiny_qwen3(n), mesh)
+
+
+def _loss_fn(mode):
+    def loss(m, ids, labels):
+        logits = m.forward_train(ids, mode=mode)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[..., None], axis=-1))
+    return loss
+
+
+@pytest.mark.parametrize("B", [1, 2])
+def test_train_grads_match_oracle(B):
+    n = mesh.shape["tp"]
+    S = 4 * n // B if B <= 4 * n else 1
+    rng = np.random.RandomState(B)
+    vocab = model.config.vocab_size
+    ids = jnp.asarray(rng.randint(0, vocab, size=(B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, vocab, size=(B, S)), jnp.int32)
+
+    with jax.default_matmul_precision("highest"):
+        lt, gt = jax.jit(jax.value_and_grad(_loss_fn("train")))(
+            model, ids, labels)
+        lx, gx = jax.jit(jax.value_and_grad(_loss_fn("xla")))(
+            model, ids, labels)
+    np.testing.assert_allclose(float(lt), float(lx), atol=1e-5, rtol=1e-5)
+
+    flat_t, _ = jax.tree.flatten(gt)
+    flat_x, tree = jax.tree.flatten(gx)
+    assert len(flat_t) == len(flat_x) and len(flat_t) > 0
+    for a, b in zip(flat_t, flat_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_train_step_improves_loss():
+    """One SGD step through the kernel train mode must reduce the loss —
+    the smoke the dryrun train step runs, but through the Pallas path."""
+    n = mesh.shape["tp"]
+    B, S = 2, 2 * n
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    ids = jnp.asarray(rng.randint(0, vocab, size=(B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, vocab, size=(B, S)), jnp.int32)
+    loss = _loss_fn("train")
+
+    @jax.jit
+    def step(m, ids, labels):
+        l, g = jax.value_and_grad(loss)(m, ids, labels)
+        m2 = jax.tree.map(
+            lambda p, gr: p - 5e-2 * gr if gr is not None else p, m, g)
+        return l, m2
+
+    l0, m2 = step(model, ids, labels)
+    # the TPU interpreter's shared-memory substrate is per-execution:
+    # fully materialize step 1 (not just l0) before launching step 2, or
+    # async dispatch overlaps the two interpreted executions
+    jax.block_until_ready(m2)
+    l1, _ = step(m2, ids, labels)
+    assert float(l1) < float(l0)

@@ -1,0 +1,332 @@
+"""Differentiable flash attention (training path) on TPU.
+
+The reference trains through its fused kernels by wrapping them in
+autograd Functions (`python/triton_dist/layers/nvidia/tp_attn.py` fwd
+modes are used under torch autograd; the attention itself falls back to
+a flash kernel with saved LSE). Here the forward reuses the split-KV
+flash kernel's *partial* outputs (unnormalized acc + (m, l) stats,
+`kernels/flash_attn.py::_flash_call`) so the softmax statistics needed
+by the backward come for free, and the backward is two Pallas kernels:
+
+  dq    — grid (X, R-tiles, T-tiles), T innermost, online accumulation
+          of dq = scale * dS @ K in VMEM scratch;
+  dk/dv — grid (X, T-tiles, R-tiles), R innermost, accumulating
+          dv = P^T @ dO and dk = scale * dS^T @ Q.
+
+with dS = P * (dO V^T - D), D = rowsum(dO * O), P = exp(S - LSE) —
+the standard recompute-based flash backward, laid out for the MXU with
+the same (batch, kv-head)-folded GQA layout as the forward: queries of
+one KV group are rows of a single batched matmul, so dk/dv sum over
+the group's `rep` query heads *by construction*, no scatter needed.
+
+Causal convention matches `flash_decode`: suffix alignment — query s
+(global row position q_off + s, q_off = T - S) attends keys <= that.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+from triton_dist_tpu.kernels.flash_attn import _flash_call
+
+
+def _fold_q(a, B, S, Hkv, rep, d):
+    """[B, S, Hq, d] -> [B*Hkv, S*rep, d] grouped by KV head."""
+    return (a.reshape(B, S, Hkv, rep, d)
+             .transpose(0, 2, 1, 3, 4)
+             .reshape(B * Hkv, S * rep, d))
+
+
+def _unfold_q(a, B, S, Hkv, rep, d):
+    return (a.reshape(B, Hkv, S, rep, d)
+             .transpose(0, 2, 1, 3, 4)
+             .reshape(B, S, Hkv * rep, d))
+
+
+def _zero_pad_cols(a_ref, T, start, bt):
+    """Zero the rows of a [bx, bt, d] KV tile past the true T (the pad
+    of a trailing partial block may be NaN; 0 * NaN would poison the
+    contractions)."""
+    a = a_ref[...]
+    if T % bt:
+        tcol = jax.lax.broadcasted_iota(jnp.int32, (bt, 1), 0) + start
+        a = jnp.where(tcol < T, a, 0)
+    return a
+
+
+def _mask(rep, q_off, T, r0, start, br, bt):
+    row = jax.lax.broadcasted_iota(jnp.int32, (br, bt), 0) + r0
+    col = jax.lax.broadcasted_iota(jnp.int32, (br, bt), 1) + start
+    return (col <= (row // rep + q_off)) & (col < T)
+
+
+def _dq_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               d_ref, dq_ref, acc_scr):
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    br = q_ref.shape[1]
+    bt = k_ref.shape[1]
+    r0 = pl.program_id(1) * br
+    start = t * bt
+
+    @pl.when(t == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # the whole tile is masked iff its first col is past the last row's
+    # causal frontier
+    @pl.when(start <= q_off + (r0 + br - 1) // rep)
+    def _compute():
+        q = q_ref[...]
+        k = _zero_pad_cols(k_ref, T, start, bt)
+        v = _zero_pad_cols(v_ref, T, start, bt)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [bx, br, bt]
+        mask = _mask(rep, q_off, T, r0, start, br, bt)
+        p = jnp.where(mask[None], jnp.exp(s - lse_ref[...][..., None]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[...], v, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [bx, br, bt]
+        ds = p * (dp - d_ref[...][..., None])
+        acc_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [bx, br, d]
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        dq_ref[...] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                 d_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+    r = pl.program_id(2)
+    nr = pl.num_programs(2)
+    br = q_ref.shape[1]
+    bt = k_ref.shape[1]
+    r0 = r * br
+    start = pl.program_id(1) * bt
+
+    @pl.when(r == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(start <= q_off + (r0 + br - 1) // rep)
+    def _compute():
+        q = q_ref[...]
+        k = _zero_pad_cols(k_ref, T, start, bt)
+        v = _zero_pad_cols(v_ref, T, start, bt)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [bx, br, bt]
+        mask = _mask(rep, q_off, T, r0, start, br, bt)
+        p = jnp.where(mask[None], jnp.exp(s - lse_ref[...][..., None]), 0.0)
+        do = do_ref[...]
+        dp = jax.lax.dot_general(
+            do, v, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - d_ref[...][..., None])
+        # contract the query-row axis: [bx, br, bt] x [bx, br, d]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [bx, bt, d]
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [bx, bt, d]
+
+    @pl.when(r == nr - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pick_bx_bwd(X, br, bt, d, itemsize):
+    """Largest divisor of X whose double-buffered backward footprint
+    (q/do/k/v tiles + lse/D rows + two f32 accumulators) fits VMEM."""
+    budget = 10 << 20
+    for bx in range(min(64, X), 0, -1):
+        if X % bx:
+            continue
+        tiles = 2 * bx * d * (2 * br + 2 * bt) * itemsize
+        rows = 2 * bx * br * 8
+        scratch = bx * d * (br + 2 * bt) * 4
+        if tiles + rows + scratch <= budget:
+            return bx
+    raise ValueError(
+        f"flash_attention backward: no batch block fits VMEM "
+        f"(br={br}, bt={bt}, d={d}); lower block_r/block_t.")
+
+
+def _pick_block(n, target):
+    """Largest divisor of n that is <= target (block shapes must tile
+    the folded row axis exactly; T-tiles may be ragged instead)."""
+    for b in range(min(target, n), 0, -1):
+        if n % b == 0:
+            return b
+    return n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, scale, block_r, block_t):
+    o, _ = _flash_attention_fwd(q, k, v, scale, block_r, block_t)
+    return o
+
+
+# single source of truth for the default tile sizes — the layer-level
+# VMEM guard (TP_Attn._flash_or_ref) must size against the same blocks
+# the kernel will actually allocate
+DEFAULT_BLOCK_R = 256
+DEFAULT_BLOCK_T = 256
+_MAX_FWD_CHUNKS = 32
+
+
+def query_chunk(S: int, rep: int, block_r: int) -> int:
+    """Largest divisor Sc of S with Sc*rep <= block_r (1 always works):
+    the forward runs one split-KV call per Sc-query chunk so only
+    Sc*rep rows need be VMEM-resident at once. Divisor-poor S (primes)
+    would unroll into S tiny launches — cap the chunk count and let the
+    single big call (or the caller's VMEM guard) take over instead."""
+    for sc in range(min(S, max(block_r // max(rep, 1), 1)), 0, -1):
+        if S % sc == 0:
+            if S // sc > _MAX_FWD_CHUNKS:
+                return S
+            return sc
+    return 1
+
+
+def _flash_attention_fwd(q, k, v, scale, block_r, block_t):
+    B, S, Hq, d = q.shape
+    _, Hkv, T, _ = k.shape
+    rep = Hq // Hkv
+    X = B * Hkv
+    qx = _fold_q(q, B, S, Hkv, rep, d)
+    kx = k.reshape(X, T, d)
+    vx = v.reshape(X, T, d)
+    # tile the query axis: one suffix-aligned split-KV call per chunk of
+    # Sc queries; chunk c sees cols <= T - S + (c+1)*Sc - 1, so the
+    # kv_len clamp also skips the not-yet-visible KV tail DMAs
+    sc = query_chunk(S, rep, block_r)
+    rows_c = sc * rep
+    accs, ms, ls = [], [], []
+    for c in range(S // sc):
+        acc_c, m_c, l_c = _flash_call(
+            qx[:, c * rows_c:(c + 1) * rows_c], kx, vx,
+            T - S + (c + 1) * sc, T - S + c * sc, scale=scale, rep=rep,
+            S=sc, T=T, partial=True, block_x=64, block_t=block_t)
+        accs.append(acc_c)
+        ms.append(m_c)
+        ls.append(l_c)
+    acc = jnp.concatenate(accs, axis=1) if len(accs) > 1 else accs[0]
+    m = jnp.concatenate(ms, axis=1) if len(ms) > 1 else ms[0]
+    l = jnp.concatenate(ls, axis=1) if len(ls) > 1 else ls[0]
+    l_safe = jnp.maximum(l, 1e-30)
+    of32 = acc / l_safe[..., None]
+    lse = m + jnp.log(l_safe)
+    o = _unfold_q(of32.astype(q.dtype), B, S, Hkv, rep, d)
+    return o, (qx, kx, vx, of32, lse)
+
+
+def _flash_attention_bwd(scale, block_r, block_t, res, do):
+    qx, kx, vx, of32, lse = res
+    X, R, d = qx.shape
+    T = kx.shape[1]
+    # recover static factors from the residual shapes + cotangent shape
+    B, S, Hq, _ = do.shape
+    Hkv = X // B
+    rep = Hq // Hkv
+    dox = _fold_q(do, B, S, Hkv, rep, d)
+    dvec = jnp.sum(dox.astype(jnp.float32) * of32, axis=-1)   # [X, R]
+    q_off = T - S
+
+    br = _pick_block(R, block_r)
+    bt = min(block_t, T)
+    bx = _pick_bx_bwd(X, br, bt, d, jnp.dtype(qx.dtype).itemsize)
+    nr, nt = R // br, pl.cdiv(T, bt)
+
+    qspec = pl.BlockSpec((bx, br, d), lambda x, r, t: (x, r, 0))
+    kspec = pl.BlockSpec((bx, bt, d), lambda x, r, t: (x, t, 0))
+    rowspec = pl.BlockSpec((bx, br), lambda x, r, t: (x, r))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale, rep, T, q_off),
+        grid=(X // bx, nr, nt),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=pl.BlockSpec((bx, br, d), lambda x, r, t: (x, r, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, R, d), qx.dtype),
+        scratch_shapes=[pltpu.VMEM((bx, br, d), jnp.float32)],
+        interpret=interpret_mode(),
+    )(qx, kx, vx, dox, lse, dvec)
+
+    qspec2 = pl.BlockSpec((bx, br, d), lambda x, t, r: (x, r, 0))
+    kspec2 = pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0))
+    rowspec2 = pl.BlockSpec((bx, br), lambda x, t, r: (x, r))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale, rep, T, q_off),
+        grid=(X // bx, nt, nr),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=(pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0)),
+                   pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0))),
+        out_shape=(jax.ShapeDtypeStruct((X, T, d), kx.dtype),
+                   jax.ShapeDtypeStruct((X, T, d), vx.dtype)),
+        scratch_shapes=[pltpu.VMEM((bx, bt, d), jnp.float32),
+                        pltpu.VMEM((bx, bt, d), jnp.float32)],
+        interpret=interpret_mode(),
+    )(qx, kx, vx, dox, lse, dvec)
+
+    dq = _unfold_q(dq, B, S, Hkv, rep, d)
+    dk = dk.reshape(B, Hkv, T, d)
+    dv = dv.reshape(B, Hkv, T, d)
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, *, scale: Optional[float] = None,
+                    block_r: int = DEFAULT_BLOCK_R,
+                    block_t: int = DEFAULT_BLOCK_T):
+    """Causal GQA flash attention, differentiable (training path).
+
+    q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] with T >= S, suffix-aligned
+    causal (query s attends keys <= T - S + s). Returns [B, S, Hq, d].
+
+    block_r tiles the query-row axis (S*rep folded rows) in BOTH
+    directions: the forward runs one split-KV call per chunk of
+    ~block_r rows (long prefills never need all rows VMEM-resident),
+    the backward blocks its grids by it. block_t tiles the KV axis.
+
+    Forward = the split-KV kernel's partial path (saves LSE for free);
+    backward = recompute-based Pallas kernels (module docstring).
+    Reference analog: the flash kernels the reference's TP layers train
+    through under autograd (layers/nvidia/tp_attn.py fwd + torch.autograd).
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    return _flash_attention(q, k, v, float(scale), block_r, block_t)
+
+
+def flash_attention_ref(q, k, v, *, scale: Optional[float] = None):
+    """jnp oracle (differentiable) with the same contract."""
+    B, S, Hq, d = q.shape
+    _, Hkv, T, _ = k.shape
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(B, S, Hkv, rep, d)
+    logits = jnp.einsum("bsgrd,bgtd->bgsrt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    si = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+    ti = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    mask = ti <= (si + (T - S))
+    logits = jnp.where(mask[None, None, :, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgsrt,bgtd->bsgrd", p, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, d).astype(q.dtype)

@@ -95,11 +95,19 @@ class TP_Attn:
     def _hkv_loc(self):
         return self.n_kv_heads // self.mesh.shape[self.axis]
 
-    def _local_attn(self, qkv, cos, sin, positions):
+    def _local_attn(self, qkv, cos, sin, positions, impl: str = "flash"):
         """Split a rank's packed [q|k|v] slice, QK-norm + RoPE, causal
-        attention over the rank's heads (ref: tp_attn.py:165-213)."""
+        attention over the rank's heads (ref: tp_attn.py:165-213).
+
+        impl="flash" runs the differentiable Pallas flash kernel
+        (kernels/flash_attn_train.py) — training through the framework
+        kernel, the role the reference's autograd-wrapped flash attention
+        plays; impl="ref" is the jnp full-softmax oracle."""
+        from triton_dist_tpu.kernels.flash_attn_train import flash_attention
         hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
         scale = hd ** -0.5
+        impl = self._flash_or_ref(impl, qkv.shape[0], hq // hkv, hd,
+                                  qkv.dtype)
 
         @functools.partial(jax.shard_map, mesh=self.mesh,
                            in_specs=P(None, self.axis),
@@ -115,7 +123,12 @@ class TP_Attn:
                 k = rms_norm(k, self.k_norm)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-            o = causal_attention(q, k, v, scale)
+            if impl == "flash":
+                o = flash_attention(q[None], k.transpose(1, 0, 2)[None],
+                                    v.transpose(1, 0, 2)[None],
+                                    scale=scale)[0]
+            else:
+                o = causal_attention(q, k, v, scale)
             return o.reshape(S, hq * hd)
 
         return f(qkv)
@@ -124,8 +137,11 @@ class TP_Attn:
         """Pure-XLA oracle (reference: torch_fwd): jnp + XLA psum
         collective — the torch/NCCL role from the reference."""
         qkv = x @ self.w_qkv
-        o = self._local_attn(qkv, cos, sin, positions)
+        o = self._local_attn(qkv, cos, sin, positions, impl="ref")
+        return self._down_psum(o)
 
+    def _down_psum(self, o):
+        """Partial O-projection + psum epilogue (the oracle down-proj)."""
         @functools.partial(jax.shard_map, mesh=self.mesh,
                            in_specs=(P(None, self.axis), P(self.axis, None)),
                            out_specs=P(None, None), check_vma=False)
@@ -133,6 +149,88 @@ class TP_Attn:
             return jax.lax.psum(o_loc @ wo_loc, self.axis)
 
         return down(o, self.w_o)
+
+    @staticmethod
+    def _flash_or_ref(impl: str, S: int, rep: int, hd: int, dtype) -> str:
+        """Static guard: the flash forward keeps one query CHUNK
+        (query_chunk rows) of a batch block resident in VMEM; fall back
+        to the jnp path when even that does not fit, rather than failing
+        inside pallas_call."""
+        if impl != "flash":
+            return impl
+        from triton_dist_tpu.kernels.flash_attn import _pick_bx
+        from triton_dist_tpu.kernels.flash_attn_train import (
+            DEFAULT_BLOCK_R, DEFAULT_BLOCK_T, query_chunk)
+        try:
+            _pick_bx(1, query_chunk(S, rep, DEFAULT_BLOCK_R) * rep, hd,
+                     min(DEFAULT_BLOCK_T, S), jnp.dtype(dtype).itemsize, 1)
+            return "flash"
+        except ValueError:
+            return "ref"
+
+    def _local_attn_train(self, qkv, cos, sin, batch: int,
+                          impl: str = "flash"):
+        """Batched full-causal attention for training: each of `batch`
+        sequences of length M//batch attends within itself.
+        impl="flash" = the differentiable Pallas kernel; "ref" = the jnp
+        oracle (flash_attention_ref)."""
+        from triton_dist_tpu.kernels.flash_attn_train import (
+            flash_attention, flash_attention_ref)
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        scale = hd ** -0.5
+        impl = self._flash_or_ref(impl, qkv.shape[0] // batch, hq // hkv,
+                                  hd, qkv.dtype)
+        attend = flash_attention if impl == "flash" else flash_attention_ref
+        # every trainable (or potentially updated) array must be a
+        # shard_map ARGUMENT, not a closure: closures over
+        # Explicit-sharded arrays are rejected, and the q/k-norm
+        # cotangents must come back psum-replicated
+        norms = [a for a in (self.q_norm, self.k_norm) if a is not None]
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(None, None), P(None, None))
+                     + (P(None),) * len(norms),
+            out_specs=P(None, self.axis), check_vma=False)
+        def f(qkv_loc, cos, sin, *norms):
+            ni = iter(norms)
+            M = qkv_loc.shape[0]
+            S = M // batch
+            q = qkv_loc[:, :hq * hd].reshape(batch, S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(batch, S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(batch, S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, next(ni))
+            if self.k_norm is not None:
+                k = rms_norm(k, next(ni))
+            positions = jnp.arange(S)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            o = attend(q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                       scale=scale)
+            return o.reshape(M, hq * hd)
+
+        return f(qkv, cos, sin, *norms)
+
+    def fwd_train(self, x, cos, sin, batch: int, impl: str = "flash"):
+        """Differentiable TP attention block for training (no KV cache):
+        custom-VJP AG-GEMM -> differentiable Pallas flash attention ->
+        custom-VJP GEMM-RS — the whole block trains through framework
+        kernels (reference analog: the autograd Function wrappers over
+        the dist ops, layers/nvidia/tp_attn.py under torch.autograd).
+        impl="ref" is the pure-XLA oracle (jnp matmuls + psum + jnp
+        attention) for differential gradient tests.
+
+        x: [B*S, D] row-sharded over tp (replicated for "ref");
+        returns same sharding as input convention of each path."""
+        from triton_dist_tpu.kernels.grad import ag_gemm_grad, gemm_rs_grad
+        if impl == "flash":
+            qkv = ag_gemm_grad(self.mesh, self.axis)(x, self.w_qkv)
+            o = self._local_attn_train(qkv, cos, sin, batch, impl="flash")
+            return gemm_rs_grad(self.mesh, self.axis)(o, self.w_o)
+        qkv = x @ self.w_qkv
+        o = self._local_attn_train(qkv, cos, sin, batch, impl="ref")
+        return self._down_psum(o)
 
     def fwd_dist(self, x, cos, sin, positions):
         """AG-GEMM -> attention -> GEMM-RS (reference: dist_triton_fwd,
@@ -290,11 +388,5 @@ class TP_Attn:
 
             y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
         else:  # "xla" oracle and "flash": psum epilogue
-            @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, axis), P(axis, None)),
-                               out_specs=P(None, None), check_vma=False)
-            def down(o_loc, wo_loc):
-                return jax.lax.psum(o_loc @ wo_loc, axis)
-
-            y = down(o, self.w_o)
+            y = self._down_psum(o)
         return y, ck, cv

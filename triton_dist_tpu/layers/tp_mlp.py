@@ -155,6 +155,18 @@ class TP_MLP:
 
         return f(x, self.w_gate_up, self.w_down)
 
+    def fwd_train(self, x, impl: str = "dist"):
+        """Differentiable TP MLP for training: custom-VJP AG-GEMM ->
+        SwiGLU -> custom-VJP GEMM-RS (kernels/grad.py); the backward of
+        each projection is itself a fused comm kernel. impl="ref" is the
+        pure-XLA oracle for differential gradient tests."""
+        if impl != "dist":
+            return self.fwd_xla(x)
+        from triton_dist_tpu.kernels.grad import ag_gemm_grad, gemm_rs_grad
+        c = ag_gemm_grad(self.mesh, self.axis)(x, self.w_gate_up)
+        h = self._local_swiglu(c)
+        return gemm_rs_grad(self.mesh, self.axis)(h, self.w_down)
+
     def __call__(self, x, mode: str = "dist"):
         """Mode switch (reference: DenseLLM set_fwd, models/dense.py:84)."""
         return dict(xla=self.fwd_xla, dist=self.fwd_dist, ar=self.fwd_ar,

@@ -170,23 +170,65 @@ class DenseLLM:
         x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
         if mode == "dist":
             # activations are row-sharded; gather for the LM head tail
-            import functools
-
-            @functools.partial(
-                jax.shard_map, mesh=self.mesh,
-                in_specs=P(self.axis, None), out_specs=P(None, None),
-                check_vma=False)
-            def gather_rows(x_loc):
-                return jax.lax.all_gather(x_loc, self.axis, axis=0,
-                                          tiled=True)
-
-            x = gather_rows(x)
+            x = self._gather_rows(x)
         last = x.reshape(B, S, -1)[:, -1]
         # bf16 x bf16 -> f32 on the MXU; casting the [D, V] weight to f32
         # would materialize (and re-read) gigabytes per decode step
         logits = jnp.dot(last, self.lm_head,
                          preferred_element_type=jnp.float32)
         return logits, cache
+
+    def forward_train(self, ids, mode: str = "train"):
+        """Training forward (no KV cache): full-causal attention over
+        each sequence, all-position logits [B, S, V].
+
+        mode="train": every projection and the attention run through the
+        framework's differentiable kernels (custom-VJP ag_gemm/gemm_rs +
+        Pallas flash attention, kernels/grad.py + flash_attn_train.py) —
+        the reference's autograd-wrapped dist path
+        (layers/nvidia/tp_attn.py under torch.autograd).
+        mode="xla": pure-XLA oracle for differential gradient tests.
+        B*S must be divisible by the TP size for "train".
+        """
+        B, S = ids.shape
+        impl = "flash" if mode == "train" else "ref"
+        mlp_impl = "dist" if mode == "train" else "xla"
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        from jax.sharding import AxisType
+        if any(t == AxisType.Explicit for t in self.mesh.axis_types):
+            # pin the embed-gather cotangent to replicated: its transpose
+            # is a scatter-add into the (replicated) table, which
+            # explicit-sharding mode rejects for a tp-sharded cotangent
+            x = jax.sharding.reshard(
+                x, NamedSharding(self.mesh, P(None, None)))
+        for layer in self.layers:
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            x = x + layer.attn.fwd_train(h, self.cos, self.sin, B, impl)
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp.fwd_train(h, mlp_impl)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "train":
+            # activations are row-sharded; gather for the LM head so the
+            # head dot (and its transpose, d lm_head = x^T @ dlogits)
+            # contracts a replicated dimension
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits.reshape(B, S, -1)
+
+    def _gather_rows(self, x):
+        """Row-sharded [M, D] -> replicated (the LM-head prologue)."""
+        import functools
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=P(self.axis, None), out_specs=P(None, None),
+            check_vma=False)
+        def gather_rows(x_loc):
+            return jax.lax.all_gather(x_loc, self.axis, axis=0,
+                                      tiled=True)
+
+        return gather_rows(x)
 
     def make_cache(self, batch: int, max_seq: int,
                    dtype=None) -> KVCache:
