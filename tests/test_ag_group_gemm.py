@@ -20,11 +20,12 @@ def setup_module(module):
     mesh = jax.make_mesh((n,), ("tp",))
 
 
+@pytest.mark.parametrize("resident_b", [True, False])
 @pytest.mark.parametrize("E,cap_loc,D,N", [
     (4, 4, 128, 256),
     (2, 8, 64, 128),    # D below lane width
 ])
-def test_ag_group_gemm_vs_oracle(E, cap_loc, D, N):
+def test_ag_group_gemm_vs_oracle(E, cap_loc, D, N, resident_b):
     n = mesh.shape["tp"]
     capT = cap_loc * n
     rng = np.random.RandomState(E + D)
@@ -33,7 +34,8 @@ def test_ag_group_gemm_vs_oracle(E, cap_loc, D, N):
     xs = jax.device_put(x, NamedSharding(mesh, P(None, "tp", None)))
     ws = jax.device_put(w, NamedSharding(mesh, P(None, None, "tp")))
     with jax.default_matmul_precision("highest"):
-        y = jax.jit(lambda a, b: ag_group_gemm(a, b, mesh=mesh))(xs, ws)
+        y = jax.jit(lambda a, b: ag_group_gemm(
+            a, b, mesh=mesh, resident_b=resident_b, block_n=64))(xs, ws)
         ref = ag_group_gemm_ref(x, w)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                atol=2e-4, rtol=1e-4)

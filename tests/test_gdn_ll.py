@@ -10,11 +10,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_fwd_ref
 
 
+@pytest.mark.parametrize("mode", ["ut", "scan"])
 @pytest.mark.parametrize("B,H,T,dk,dv,chunk", [
     (2, 3, 65, 16, 32, 16),   # ragged T (pad path)
     (1, 2, 128, 32, 32, 64),
 ])
-def test_gdn_fwd_vs_recurrent_oracle(B, H, T, dk, dv, chunk):
+def test_gdn_fwd_vs_recurrent_oracle(B, H, T, dk, dv, chunk, mode):
     rng = np.random.RandomState(T)
     q = jnp.asarray(rng.randn(B, H, T, dk), jnp.float32) * 0.3
     k = jnp.asarray(rng.randn(B, H, T, dk), jnp.float32) * 0.3
@@ -22,7 +23,7 @@ def test_gdn_fwd_vs_recurrent_oracle(B, H, T, dk, dv, chunk):
     g = jnp.asarray(-np.abs(rng.rand(B, H, T)) * 0.1, jnp.float32)
     beta = jnp.asarray(rng.rand(B, H, T), jnp.float32)
     with jax.default_matmul_precision("highest"):
-        o, S = jax.jit(lambda *a: gdn_fwd(*a, chunk=chunk))(
+        o, S = jax.jit(lambda *a: gdn_fwd(*a, chunk=chunk, mode=mode))(
             q, k, v, g, beta)
     ro, rS = gdn_fwd_ref(q, k, v, g, beta)
     np.testing.assert_allclose(np.asarray(o), ro, atol=1e-4, rtol=1e-4)

@@ -20,11 +20,12 @@ def setup_module(module):
     mesh = jax.make_mesh((n,), ("tp",))
 
 
+@pytest.mark.parametrize("resident_b", [True, False])
 @pytest.mark.parametrize("E,cap_loc,F,D", [
     (4, 4, 256, 128),
     (2, 8, 128, 256),
 ])
-def test_moe_reduce_rs_vs_oracle(E, cap_loc, F, D):
+def test_moe_reduce_rs_vs_oracle(E, cap_loc, F, D, resident_b):
     n = mesh.shape["tp"]
     assert F % n == 0
     capT = cap_loc * n
@@ -34,7 +35,8 @@ def test_moe_reduce_rs_vs_oracle(E, cap_loc, F, D):
     hs = jax.device_put(h, NamedSharding(mesh, P(None, None, "tp")))
     ws = jax.device_put(w2, NamedSharding(mesh, P(None, "tp", None)))
     with jax.default_matmul_precision("highest"):
-        y = jax.jit(lambda a, b: moe_reduce_rs(a, b, mesh=mesh))(hs, ws)
+        y = jax.jit(lambda a, b: moe_reduce_rs(
+            a, b, mesh=mesh, resident_b=resident_b))(hs, ws)
         ref = moe_reduce_rs_ref(h, w2)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                atol=5e-4, rtol=1e-4)

@@ -17,9 +17,10 @@ reference's max_M workspaces):
   ->  y [E, capT, N] with N sharded (every rank holds all tokens'
       activations for its N/n expert-weight columns)
 
-v1 rereads each expert's B panel once per ring step when it exceeds
-the resident tile (same tradeoff as ag_gemm's nt>1 path; the autotuner
-picks block_n so typical MoE column shards stay resident).
+When all experts' panels fit VMEM next to the a/o tiles, B is loaded
+exactly ONCE and stays resident across ring steps; otherwise each ring
+step rereads the B tiles (same tradeoff as ag_gemm's nt>1 path; the
+autotuner picks block_n so typical MoE column shards stay resident).
 """
 
 from __future__ import annotations
@@ -42,22 +43,31 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
+                          resident_b: bool,
                           x_ref, w_ref, ag_ref, o_ref,
                           a_vmem, b_vmem, o_vmem,
                           copy_sem, send_sem, o_sem, b_sem, recv_sems):
     """Ring AG of capacity chunks + per-expert GEMM consumption.
     x_ref: [E, c_loc, D]; w_ref: [E, D, n_loc]; ag_ref: [E, capT, D];
-    o_ref: [E, capT, n_loc]."""
+    o_ref: [E, capT, n_loc].
+
+    resident_b: all experts' panels fit VMEM (b_vmem is [E, D, n_loc]):
+    load B exactly once before the ring loop instead of once per ring
+    step per tile (n x the B bandwidth otherwise)."""
     me = dl.my_pe(axis)
     _, c_loc, D = x_ref.shape
     n_loc = w_ref.shape[2]
-    nt = pl.cdiv(n_loc, block_n)
+    nt = 1 if resident_b else pl.cdiv(n_loc, block_n)
 
     # stage own chunk into the gathered buffer
     cp = pltpu.make_async_copy(
         x_ref, ag_ref.at[:, pl.ds(me * c_loc, c_loc), :], copy_sem)
     cp.start()
     cp.wait()
+    if resident_b:
+        cp = pltpu.make_async_copy(w_ref, b_vmem, b_sem)
+        cp.start()
+        cp.wait()
     dl.barrier_all(axis)
 
     _, right = dl.ring_neighbors(axis)
@@ -76,18 +86,24 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
             cp.start()
             cp.wait()
             for j in range(nt):
-                cp = pltpu.make_async_copy(
-                    w_ref.at[e, :, pl.ds(j * block_n, block_n)], b_vmem,
-                    b_sem)
-                cp.start()
-                cp.wait()
-                acc = jnp.dot(a_vmem[...], b_vmem[...],
+                if resident_b:
+                    b_tile = b_vmem[e]
+                else:
+                    cp = pltpu.make_async_copy(
+                        w_ref.at[e, :, pl.ds(j * block_n, block_n)],
+                        b_vmem, b_sem)
+                    cp.start()
+                    cp.wait()
+                    b_tile = b_vmem[...]
+                acc = jnp.dot(a_vmem[...], b_tile,
                               preferred_element_type=jnp.float32)
                 o_vmem[...] = acc.astype(o_vmem.dtype)
                 cp = pltpu.make_async_copy(
                     o_vmem,
                     o_ref.at[e, pl.ds(src * c_loc, c_loc),
-                             pl.ds(j * block_n, block_n)], o_sem)
+                             pl.ds(j * block_n,
+                                   n_loc if resident_b else block_n)],
+                    o_sem)
                 cp.start()
                 cp.wait()
         if s < n - 1:
@@ -98,7 +114,8 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
 
 def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
                   block_n: int = 512,
-                  collective_id: Optional[int] = None):
+                  collective_id: Optional[int] = None,
+                  resident_b: Optional[bool] = None):
     """y[e] = allgather(x_e[e]) @ w[e] for every expert, overlapped
     (reference: ag_group_gemm, allgather_group_gemm.py:253).
 
@@ -113,13 +130,24 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     if collective_id is None:
         collective_id = next_collective_id()
     bn = _divisor_block(n_loc, block_n)
+    # when every expert's whole panel fits VMEM alongside the a/o tiles,
+    # hold B resident across ring steps (loaded once, not n times)
+    isz = jnp.dtype(x_e.dtype).itemsize
+    wsz = jnp.dtype(w.dtype).itemsize
+    resident = (E * D * n_loc * wsz
+                + c_loc * D * isz + c_loc * n_loc * isz) <= (6 << 20)
+    if resident_b is not None:   # test/tuning override
+        resident = resident_b
+    if resident:
+        bn = n_loc
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(None, axis, None), P(None, None, axis)),
         out_specs=P(None, None, axis), check_vma=False)
     def _f(x_loc, w_loc):
-        kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn)
+        kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn,
+                                   resident)
         _, out = pl.pallas_call(
             kernel,
             out_shape=(
@@ -132,7 +160,8 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
                        pl.BlockSpec(memory_space=pl.ANY)),
             scratch_shapes=[
                 pltpu.VMEM((c_loc, D), x_loc.dtype),
-                pltpu.VMEM((D, bn), w_loc.dtype),
+                pltpu.VMEM((E, D, n_loc) if resident else (D, bn),
+                           w_loc.dtype),
                 pltpu.VMEM((c_loc, bn), x_loc.dtype),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),

@@ -8,14 +8,21 @@ recurrence per head (state S [dk, dv]):
     S_t = exp(g_t) * S_{t-1} + beta_t * k_t (v_t - exp(g_t) S_{t-1}^T k_t)^T
     o_t = S_t^T q_t
 
-The reference parallelizes within chunks via Triton's UT transform; on
-TPU the idiomatic shape is different: the token recurrence is a
-`lax.scan` whose per-step work is a batched outer product / matvec that
-the MXU executes across (batch x heads) lanes — sequential in T but
-fully vectorized across everything else, with static shapes XLA can
-pipeline. ``gdn_fwd`` processes tokens in chunks so the state round
-trips HBM once per chunk rather than per token; within a chunk the scan
-carries the state in registers/VMEM.
+The reference parallelizes within chunks via Triton's UT transform;
+``gdn_fwd`` does the same closed form TPU-style (mode="ut", default):
+within a chunk of C tokens the delta-rule corrections form a unit
+lower-triangular system
+
+    (I + diag(beta) L) U = diag(beta) (V - diag(A) K S_0),
+    L_ij = exp(cum_i - cum_j) (k_i . k_j)   for j < i
+    (A_t = exp(cum_t), INCLUSIVE decay — the recurrence decays the
+    state before predicting),
+
+solved with one batched triangular_solve; outputs and the chunk-exit
+state are then plain [C, C] / [C, d] matmuls — everything MXU-shaped,
+sequential only across chunks (a lax.scan of length T/C). mode="scan"
+keeps the exact per-token recurrence (a lax.scan over tokens whose step
+is a batched outer product) as the slow-but-transparent oracle path.
 """
 
 from __future__ import annotations
@@ -27,14 +34,14 @@ import jax.numpy as jnp
 
 
 def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
-            chunk: int = 64) -> Tuple[jax.Array, jax.Array]:
+            chunk: int = 64, mode: str = "ut") -> Tuple[jax.Array, jax.Array]:
     """q, k: [B, H, T, dk]; v: [B, H, T, dv]; g (log decay, <= 0) and
     beta (write strength, in [0, 1]): [B, H, T]. Returns (o [B,H,T,dv],
     S_T [B,H,dk,dv]).
 
-    Reference: gdn.py's chunked forward — chunking here bounds the scan
-    carry's live range; the math is the exact recurrence (no chunk
-    approximation)."""
+    mode="ut": closed-form chunkwise UT transform (module docstring) —
+    the MXU path, exact (no chunk approximation). mode="scan": per-token
+    recurrence. Reference: gdn.py's chunked forward."""
     B, H, T, dk = q.shape
     dv = v.shape[-1]
     if S0 is None:
@@ -55,6 +62,41 @@ def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
 
     qc, kc, vc = to_chunks(q), to_chunks(k), to_chunks(v)
     gc, bc = to_chunks(g), to_chunks(beta)
+
+    def chunk_ut(S, inp):
+        """Closed-form chunk: one triangular solve + MXU matmuls.
+        S [B,H,dk,dv]; chunk arrays [B,H,C,*] / [B,H,C]."""
+        q_c, k_c, v_c, g_c, b_c = inp
+        f32 = jnp.float32
+        qf, kf, vf = (a.astype(f32) for a in (q_c, k_c, v_c))
+        gf, bf = g_c.astype(f32), b_c.astype(f32)
+        C = q_c.shape[2]
+        cum = jnp.cumsum(gf, axis=-1)                    # [B,H,C]
+        A = jnp.exp(cum)                                 # A_t (inclusive)
+        # the recurrence decays BEFORE predicting (pred uses a_i S_{i-1}
+        # = (A_i/A_{i-1}) S_{i-1}), so the correction system runs on the
+        # INCLUSIVE cumulative decay A_i. Mask exponents BEFORE exp:
+        # unmasked upper-triangle entries are positive and overflow.
+        decay = cum[..., :, None] - cum[..., None, :]   # cum_i - cum_j
+        strict = jnp.tril(jnp.ones((C, C), bool), -1)
+        kk = jnp.einsum("bhik,bhjk->bhij", kf, kf)
+        L = jnp.exp(jnp.where(strict, decay, -1e30)) * kk
+        rhs = bf[..., None] * (vf - A[..., None] * jnp.einsum(
+            "bhck,bhkv->bhcv", kf, S))
+        # unit_diagonal: the solver ignores the (zero) diagonal of bf*L
+        # and treats it as I + diag(b) L
+        U = jax.lax.linalg.triangular_solve(
+            bf[..., None] * L, rhs, left_side=True, lower=True,
+            unit_diagonal=True)                          # [B,H,C,dv]
+        incl = jnp.tril(jnp.ones((C, C), bool))
+        N = jnp.exp(jnp.where(incl, decay, -1e30)) * jnp.einsum(
+            "bhik,bhjk->bhij", qf, kf)
+        O = (A[..., None] * jnp.einsum("bhck,bhkv->bhcv", qf, S)
+             + jnp.einsum("bhts,bhsv->bhtv", N, U))
+        w = jnp.exp(cum[..., -1:] - cum)[..., None] * kf
+        S_new = (jnp.exp(cum[..., -1])[..., None, None] * S
+                 + jnp.einsum("bhck,bhcv->bhkv", w, U))
+        return S_new, O
 
     def chunk_step(S, inp):
         q_c, k_c, v_c, g_c, b_c = inp
@@ -78,7 +120,11 @@ def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
              b_c.transpose(2, 0, 1)))
         return S_out, o.transpose(1, 2, 0, 3)       # [B,H,chunk,dv]
 
-    S_T, oc = jax.lax.scan(chunk_step, S0, (qc, kc, vc, gc, bc))
+    if mode not in ("ut", "scan"):
+        raise ValueError(f"gdn_fwd: unknown mode {mode!r} "
+                         "(expected 'ut' or 'scan')")
+    body = chunk_ut if mode == "ut" else chunk_step
+    S_T, oc = jax.lax.scan(body, S0, (qc, kc, vc, gc, bc))
     o = (oc.transpose(1, 2, 0, 3, 4)
            .reshape(B, H, Tp, dv))[:, :, :T]
     return o.astype(q.dtype), S_T

@@ -15,8 +15,9 @@ Contract (row-parallel expert weights):
   -> y [E, capT, D] summed over ranks, capT sharded (rank r owns rows
      [r*capT/n, (r+1)*capT/n) of every expert)
 
-v1 rereads each expert's B panel once per ring step (same tradeoff the
-dense gemm_rs takes for nt > 1)."""
+When all experts' down-proj panels fit VMEM, B is loaded exactly once
+and stays resident across ring steps; otherwise each step rereads the
+per-expert panel (same tradeoff the dense gemm_rs takes for nt > 1)."""
 
 from __future__ import annotations
 
@@ -34,15 +35,22 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
                                      shmem_compiler_params)
 
 
-def _moe_rs_kernel(n: int, axis: str, E: int,
+def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
                    a_ref, b_ref, o_ref, land_ref, send_buf,
                    a_vmem, b_vmem, p_vmem, tmp_vmem,
                    copy_sem, send_sems, recv_sems, credit_sem):
     """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
-    o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D]."""
+    o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D].
+
+    resident_b: all experts' down-proj panels fit VMEM (b_vmem is
+    [E, F_loc, D]): B is loaded once, not once per expert per step."""
     me = dl.my_pe(axis)
     _, c_loc, D = o_ref.shape
     left, right = dl.ring_neighbors(axis)
+    if resident_b:
+        cp = pltpu.make_async_copy(b_ref, b_vmem, copy_sem)
+        cp.start()
+        cp.wait()
     dl.barrier_all(axis)
 
     for s in range(n):
@@ -60,10 +68,14 @@ def _moe_rs_kernel(n: int, axis: str, E: int,
                 copy_sem)
             cp.start()
             cp.wait()
-            cp = pltpu.make_async_copy(b_ref.at[e], b_vmem, copy_sem)
-            cp.start()
-            cp.wait()
-            p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
+            if resident_b:
+                b_tile = b_vmem[e]
+            else:
+                cp = pltpu.make_async_copy(b_ref.at[e], b_vmem, copy_sem)
+                cp.start()
+                cp.wait()
+                b_tile = b_vmem[...]
+            p_vmem[...] = jnp.dot(a_vmem[...], b_tile,
                                   preferred_element_type=jnp.float32)
             tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
             cp = pltpu.make_async_copy(tmp_vmem, dest.at[e], copy_sem)
@@ -104,7 +116,8 @@ def _moe_rs_kernel(n: int, axis: str, E: int,
 
 
 def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
-                  collective_id: Optional[int] = None):
+                  collective_id: Optional[int] = None,
+                  resident_b: Optional[bool] = None):
     """y = reduce_scatter(sum over F of h @ w2) per expert, fused
     (reference: moe_reduce_rs.py:168). h: [E, capT, F] F-sharded;
     w2: [E, F, D] F-row-sharded. Returns [E, capT, D] capT-sharded."""
@@ -115,6 +128,12 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
     c_loc = capT // n
     if collective_id is None:
         collective_id = next_collective_id()
+    isz = jnp.dtype(h.dtype).itemsize
+    wsz = jnp.dtype(w2.dtype).itemsize
+    f_l = F // n
+    if resident_b is None:   # hold B across ring steps when it fits
+        resident_b = (E * f_l * D * wsz + c_loc * f_l * isz
+                      + c_loc * D * (4 + isz)) <= (6 << 20)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -122,7 +141,7 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
         out_specs=P(None, axis, None), check_vma=False)
     def _f(h_loc, w_loc):
         f_loc = h_loc.shape[2]
-        kernel = functools.partial(_moe_rs_kernel, n, axis, E)
+        kernel = functools.partial(_moe_rs_kernel, n, axis, E, resident_b)
         out, _, _ = pl.pallas_call(
             kernel,
             out_shape=(
@@ -136,7 +155,8 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
                             for _ in range(3)),
             scratch_shapes=[
                 pltpu.VMEM((c_loc, f_loc), h_loc.dtype),
-                pltpu.VMEM((f_loc, D), w_loc.dtype),
+                pltpu.VMEM((E, f_loc, D) if resident_b else (f_loc, D),
+                           w_loc.dtype),
                 pltpu.VMEM((c_loc, D), jnp.float32),
                 pltpu.VMEM((c_loc, D), h_loc.dtype),
                 pltpu.SemaphoreType.DMA(()),
