@@ -77,3 +77,39 @@ def test_builder_rejects_misordered_program():
     b.add_task("use_tmp", lambda env: None, reads=("tmp",),
                writes=("y",))
     assert [t.name for t in b.tasks] == ["make_tmp", "use_tmp"]
+
+
+def test_mega_engine_backend_matches_flash():
+    """Greedy decode through backend='mega' (one megakernel per layer)
+    must match the flash backend's tokens on a bf16 model — the e2e
+    differential the reference's megakernel example runs against its
+    torch engine (mega_triton_kernel/models/model_builder.py:86)."""
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+    cfg = tiny_qwen3(1, hidden_size=128, intermediate_size=256,
+                     num_heads=2, num_kv_heads=1, head_dim=64,
+                     dtype="bfloat16", max_position_embeddings=256)
+    model = AutoLLM.from_config(cfg, mesh1)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    toks_f = np.asarray(
+        Engine(model, max_seq=64, backend="flash").serve(ids, 5))
+    toks_m = np.asarray(
+        Engine(model, max_seq=64, backend="mega").serve(ids, 5))
+    np.testing.assert_array_equal(toks_f, toks_m)
+
+
+def test_mega_engine_rejects_tp():
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+
+    n = len(jax.devices())
+    if n == 1:
+        pytest.skip("needs a multi-device mesh")
+    mesh = jax.make_mesh((n,), ("tp",))
+    model = AutoLLM.from_config(tiny_qwen3(n), mesh)
+    with pytest.raises(ValueError, match="single-chip"):
+        Engine(model, backend="mega")

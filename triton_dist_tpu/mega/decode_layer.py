@@ -104,6 +104,10 @@ class MegaDecodeLayer:
                                      metadata=dict(static=True))
     block_t: int = dataclasses.field(default=128,
                                      metadata=dict(static=True))
+    # Qwen3-style per-head RMS norm on q/k before RoPE; False skips it
+    # (matching the other backends' `if q_norm is not None` gate)
+    qk_norm: bool = dataclasses.field(default=True,
+                                      metadata=dict(static=True))
 
     def __call__(self, x, pos, weights: Dict[str, jax.Array], cache_k,
                  cache_v):
@@ -142,8 +146,10 @@ class MegaDecodeLayer:
         b.buffer("h", (B, F), jnp.float32)
         b.buffer("wt", (2, max(D, F, Hq * hd), bn), jnp.bfloat16)
         b.buffer("kvst", (B, 8, hd), jnp.bfloat16)
-        b.buffer("kt", (B, bt, hd), jnp.bfloat16)
-        b.buffer("vt", (B, bt, hd), jnp.bfloat16)
+        # double-buffered KV tiles: the fetch of tile t+1 rides under
+        # the online-softmax update of tile t
+        b.buffer("kt", (2, B, bt, hd), jnp.bfloat16)
+        b.buffer("vt", (2, B, bt, hd), jnp.bfloat16)
 
         b.add_task("ln1", functools.partial(_rmsnorm, dst="xn", src="xv",
                                             w_name="w_ln1", eps=eps),
@@ -163,10 +169,11 @@ class MegaDecodeLayer:
             for hidx in range(Hq + Hkv):
                 off = hidx * hd
                 v = qkv[:, off:off + hd]
-                gw = (env["q_norm"][...] if hidx < Hq
-                      else env["k_norm"][...])
-                ms = jnp.mean(v * v, axis=-1, keepdims=True)
-                v = v * jax.lax.rsqrt(ms + eps) * gw
+                if self.qk_norm:
+                    gw = (env["q_norm"][...] if hidx < Hq
+                          else env["k_norm"][...])
+                    ms = jnp.mean(v * v, axis=-1, keepdims=True)
+                    v = v * jax.lax.rsqrt(ms + eps) * gw
                 x1 = v[:, :half]
                 x2 = v[:, half:]
                 qkv[:, off:off + half] = x1 * c - x2 * s
@@ -207,28 +214,42 @@ class MegaDecodeLayer:
         def flash(env):
             qkv = env["qkv"]
             p = env["pos"]
-            sem = env["copy_sem"]
+            sems = env["copy_sems"]
             nt = p // bt + 1
             for g in range(Hkv):
                 q3 = qkv[:, g * rep * hd:(g + 1) * rep * hd].reshape(
                     B, rep, hd).astype(jnp.bfloat16)
 
+                # double-buffered: copies are reconstructible
+                # descriptors, so start tile t+1 in iteration t and
+                # wait on its semaphore in iteration t+1
+                def k_copy(t, slot, g=g):
+                    return pltpu.make_async_copy(
+                        env["ck"].at[g, :, pl.ds(t * bt, bt), :],
+                        env["kt"].at[slot], sems.at[0])
+
+                def v_copy(t, slot, g=g):
+                    return pltpu.make_async_copy(
+                        env["cv"].at[g, :, pl.ds(t * bt, bt), :],
+                        env["vt"].at[slot], sems.at[1])
+
+                k_copy(0, 0).start()
+                v_copy(0, 0).start()
+
                 def body(t, carry, g=g, q3=q3):
                     m, l, acc = carry
-                    cp_k = pltpu.make_async_copy(
-                        env["ck"].at[g, :, pl.ds(t * bt, bt), :],
-                        env["kt"], sem)
-                    cp_v = pltpu.make_async_copy(
-                        env["cv"].at[g, :, pl.ds(t * bt, bt), :],
-                        env["vt"], sem)
-                    cp_k.start()
-                    cp_v.start()
-                    cp_k.wait()
-                    cp_v.wait()
+                    slot = jax.lax.rem(t, 2)
+                    k_copy(t, slot).wait()
+                    kt_t = env["kt"][slot]
                     s = jax.lax.dot_general(
-                        q3, env["kt"][...],
+                        q3, kt_t,
                         (((2,), (2,)), ((0,), (0,))),
                         preferred_element_type=jnp.float32) * scale
+
+                    @pl.when(t + 1 < nt)
+                    def _prefetch_k():
+                        k_copy(t + 1, 1 - slot).start()
+
                     col = (t * bt
                            + jax.lax.broadcasted_iota(
                                jnp.int32, (B, rep, bt), 2))
@@ -238,12 +259,18 @@ class MegaDecodeLayer:
                     pr = jnp.exp(sm - m_new[..., None])
                     pr = jnp.where(col <= p, pr, 0.0)
                     l_new = l * alpha + jnp.sum(pr, -1)
+                    v_copy(t, slot).wait()
                     acc_new = (acc * alpha[..., None]
                                + jax.lax.dot_general(
                                    pr.astype(jnp.bfloat16),
-                                   env["vt"][...],
+                                   env["vt"][slot],
                                    (((2,), (1,)), ((0,), (0,))),
                                    preferred_element_type=jnp.float32))
+
+                    @pl.when(t + 1 < nt)
+                    def _prefetch_v():
+                        v_copy(t + 1, 1 - slot).start()
+
                     return m_new, l_new, acc_new
 
                 m0 = jnp.full((B, rep), -1e30, jnp.float32)
@@ -341,7 +368,11 @@ class MegaDecodeLayer:
                        jax.ShapeDtypeStruct(cache_v.shape,
                                             cache_v.dtype)),
             input_output_aliases={12: 1, 13: 2},
-            compiler_params=shmem_compiler_params(None),
+            # the megakernel deliberately holds a whole layer's
+            # activations + staging tiles in VMEM; lift the default 16MB
+            # scoped-vmem ceiling (v5e has 128MB physical VMEM)
+            compiler_params=shmem_compiler_params(
+                None, vmem_limit_bytes=100 << 20),
             interpret=interpret_mode(),
         )(jnp.asarray(pos, jnp.int32)[None],
           x.astype(jnp.float32),

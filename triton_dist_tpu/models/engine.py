@@ -14,6 +14,17 @@ Backends (reference backend strings engine.py:126-135):
   "dist"    <- triton_dist      (AG-GEMM / GEMM-RS)
   "ar"      <- triton_dist_AR   (partial GEMMs + AR kernel)
   "gemm_ar" <- triton_dist_gemm_ar (fused GEMM+AR)
+  "mega"    <- mega_triton_kernel (models/engine.py backend "mega",
+               mega_triton_kernel/models/model_builder.py:86): each
+               decode layer is ONE Pallas megakernel
+               (mega/decode_layer.py); single chip, decode only
+               (prefill runs the flash backend). Measured on a v5e with
+               Qwen3-1.7B bsz=128: ~21 ms/step vs ~12.5 for "flash" —
+               on TPU the XLA scan already fuses and software-pipelines
+               across ops/layers, so the hand-scheduled megakernel is
+               the architecture-parity path, not the fast path (the
+               reference's megakernel wins by eliminating GPU launch
+               overhead, which the TPU path never pays).
 """
 
 from __future__ import annotations
@@ -33,17 +44,32 @@ class Engine:
         self.model = model
         self.max_seq = max_seq
         self.backend = backend
+        if backend == "mega":
+            if model.mesh.size != 1:
+                raise ValueError(
+                    "backend='mega' is the single-chip megakernel decode "
+                    "path (mega/decode_layer.py); use 'dist'/'gemm_ar' "
+                    "for TP decode")
+            # the megakernel's flash loop walks the cache in
+            # block_t-sized tiles; round the cache capacity up
+            import dataclasses as _dc
+            from triton_dist_tpu.mega import MegaDecodeLayer
+            bt = {f.name: f for f in _dc.fields(MegaDecodeLayer)}[
+                "block_t"].default
+            self.max_seq = -(-max_seq // bt) * bt
         # the reference prefills with the torch fwd (engine.py:121); the
         # analog here is the XLA-collective mode unless overridden
         self.prefill_backend = prefill_backend or (
-            backend if backend in ("dist", "flash") else "xla")
+            backend if backend in ("dist", "flash") else
+            "flash" if backend == "mega" else "xla")
         # The model is a jit ARGUMENT (weights must not be captured as
         # program constants — that would bake GBs into the executable)
         self._prefill = jax.jit(functools.partial(
             _prefill_fn, mode=self.prefill_backend))
+        scan_fn = (_mega_scan_decode_fn if backend == "mega"
+                   else functools.partial(_scan_decode_fn, backend))
         self._decode_scan = jax.jit(
-            functools.partial(_scan_decode_fn, backend),
-            static_argnames=("gen_len",), donate_argnums=(2,))
+            scan_fn, static_argnames=("gen_len",), donate_argnums=(2,))
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -83,3 +109,99 @@ def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):
     (logits, cache), toks = jax.lax.scan(
         step, (logits0, cache), None, length=gen_len)
     return toks.T, logits, cache                     # [B, gen_len]
+
+
+def _pick_mega_bn(cfg) -> int:
+    """Largest 128-multiple weight tile dividing the projection widths
+    the megakernel asserts on (D, ffn, Hq*hd); the qkv matmul down-tiles
+    its own width independently (decode_layer.py _pick_bn)."""
+    widths = (cfg.hidden_size, cfg.intermediate_size,
+              cfg.num_heads * cfg.head_dim)
+    for bn in (512, 384, 256, 128):
+        if all(w % bn == 0 for w in widths):
+            return bn
+    raise ValueError(
+        f"no 128-multiple tile divides the projection widths {widths}; "
+        "backend='mega' needs 128-aligned layer geometry")
+
+
+def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
+    """Megakernel decode loop: one Pallas kernel per layer per step
+    (reference: the megakernel engine backend replaying the built task
+    graph, mega_triton_kernel/models/model_builder.py:86). Weights are
+    repacked into the megakernel's layout ONCE (outside the scan); the
+    KV cache converts to the head-major [Hkv, B, T, hd] layout the
+    kernel's per-head DMA walk wants."""
+    from triton_dist_tpu.layers.common import rms_norm
+    from triton_dist_tpu.mega import MegaDecodeLayer
+
+    cfg = model.config
+    hd = cfg.head_dim
+    T = cache.k[0].shape[2]
+    mega = MegaDecodeLayer(
+        d_model=cfg.hidden_size, n_heads=cfg.num_heads,
+        n_kv_heads=cfg.num_kv_heads, head_dim=hd,
+        ffn=cfg.intermediate_size, T=T, eps=cfg.rms_norm_eps,
+        block_n=_pick_mega_bn(cfg),
+        qk_norm=model.layers[0].attn.q_norm is not None)
+    ones = jnp.ones((1, hd), jnp.float32)
+    bf = jnp.bfloat16
+    weights = []
+    for layer in model.layers:
+        attn, mlp = layer.attn, layer.mlp
+        weights.append(dict(
+            w_ln1=layer.ln_attn[None].astype(jnp.float32),
+            w_qkv=attn.w_qkv.astype(bf),
+            q_norm=(ones if attn.q_norm is None
+                    else attn.q_norm[None].astype(jnp.float32)),
+            k_norm=(ones if attn.k_norm is None
+                    else attn.k_norm[None].astype(jnp.float32)),
+            w_o=attn.w_o.astype(bf),
+            w_ln2=layer.ln_mlp[None].astype(jnp.float32),
+            w_gu=mlp.w_gate_up.astype(bf),
+            w_d=mlp.w_down.astype(bf),
+        ))
+    from jax.sharding import AxisType, NamedSharding, PartitionSpec as _P
+
+    def _replicate(a):
+        # the cache arrives head-sharded over the (size-1) tp axis; the
+        # megakernel outputs are replicated — pin the scan carry to one
+        # consistent (replicated) type under explicit-sharding meshes
+        if any(t == AxisType.Explicit for t in model.mesh.axis_types):
+            return jax.sharding.reshard(a, NamedSharding(model.mesh, _P()))
+        return a
+
+    ks = tuple(_replicate(jnp.transpose(k, (1, 0, 2, 3))) for k in cache.k)
+    vs = tuple(_replicate(jnp.transpose(v, (1, 0, 2, 3))) for v in cache.v)
+
+    # pallas_call needs Manual mesh axes: run each layer's megakernel
+    # under a fully-replicated shard_map over the (size-1) mesh, with
+    # every array an ARGUMENT (closures over sharded arrays are
+    # rejected in explicit-sharding mode)
+    from jax.sharding import PartitionSpec as P
+    mega_call = jax.shard_map(
+        lambda x, pos, wd, ck, cv: mega(x, pos, wd, ck, cv),
+        mesh=model.mesh, in_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False)
+
+    def step(carry, _):
+        logits, pos, ks, vs = carry
+        tok = jnp.argmax(logits, axis=-1)           # greedy [B]
+        x = model.embed[tok].astype(jnp.float32)    # [B, D]
+        crow = model.cos[pos][None]
+        srow = model.sin[pos][None]
+        new_ks, new_vs = [], []
+        for li, w in enumerate(weights):
+            wd = dict(w, cos_row=crow, sin_row=srow)
+            x, ck, cv = mega_call(x, pos, wd, ks[li], vs[li])
+            new_ks.append(ck)
+            new_vs.append(cv)
+        xf = rms_norm(x, model.final_norm.astype(jnp.float32),
+                      cfg.rms_norm_eps)
+        logits = jnp.dot(xf.astype(model.lm_head.dtype), model.lm_head,
+                         preferred_element_type=jnp.float32)
+        return (logits, pos + 1, tuple(new_ks), tuple(new_vs)), tok
+
+    (logits, _, ks, vs), toks = jax.lax.scan(
+        step, (logits0, cache.offset, ks, vs), None, length=gen_len)
+    return toks.T, logits, None                      # [B, gen_len]
