@@ -124,6 +124,83 @@ def gemm_ar_grad(mesh: Mesh, axis: str = "tp"):
     return op
 
 
+def grouped_gemm_grad():
+    """Differentiable grouped GEMM: y[e] = a[e] @ b[e] (a [E, C, K],
+    b [E, K, N]); both backward contractions are themselves grouped
+    GEMMs on the same Pallas kernel. Per-device op — compose inside
+    shard_map (the MoE expert MLP does)."""
+    from triton_dist_tpu.kernels.group_gemm import grouped_gemm
+
+    @jax.custom_vjp
+    def op(a, b):
+        return grouped_gemm(a, b)
+
+    def fwd(a, b):
+        return grouped_gemm(a, b), (a, b)
+
+    def bwd(res, dy):
+        a, b = res
+        dy = dy.astype(a.dtype)
+        da = grouped_gemm(dy, jnp.swapaxes(b, 1, 2))
+        db = grouped_gemm(jnp.swapaxes(a, 1, 2), dy)
+        return da, db
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def all_gather_grad(mesh: Mesh, axis: str = "tp"):
+    """Differentiable all_gather over dim 0: x [M, D] row-sharded ->
+    [M, D] replicated. Adjoint = each rank keeps its own row slice (the
+    gather's transpose; no comm — the cotangent is already global)."""
+    from triton_dist_tpu.kernels.allgather import all_gather
+
+    @jax.custom_vjp
+    def op(x):
+        return all_gather(x, mesh=mesh, axis=axis)
+
+    def fwd(x):
+        return all_gather(x, mesh=mesh, axis=axis), None
+
+    def bwd(_, dy):
+        dx = _local(mesh, P(None, None), P(axis, None),
+                    lambda dyf: jax.lax.dynamic_slice_in_dim(
+                        dyf, jax.lax.axis_index(axis)
+                        * (dyf.shape[0] // jax.lax.axis_size(axis)),
+                        dyf.shape[0] // jax.lax.axis_size(axis), 0))(dy)
+        return (dx,)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def reduce_scatter_grad(mesh: Mesh, axis: str = "tp"):
+    """Differentiable reduce_scatter of stacked partials: parts
+    [n, M, D] (dim 0 sharded over axis: each rank holds its partial) ->
+    y [M, D] row-sharded. Adjoint: every partial's every row receives
+    the (gathered) output cotangent."""
+    from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter
+
+    @jax.custom_vjp
+    def op(parts):
+        return reduce_scatter(parts, mesh=mesh, axis=axis)
+
+    def fwd(parts):
+        return reduce_scatter(parts, mesh=mesh, axis=axis), None
+
+    def bwd(_, dy):
+        dyg = _local(mesh, P(axis, None), P(None, None),
+                     lambda dyl: jax.lax.all_gather(
+                         dyl, axis, axis=0, tiled=True))(dy)
+        dparts = _local(
+            mesh, (P(None, None),), P(axis, None, None),
+            lambda dyf: dyf[None])(dyg)
+        return (dparts,)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def _transpose_rows(b, mesh, axis):
     """b [K, N] col-sharded -> b^T [N, K] row-sharded (a local
     transpose: the shard each device holds is its own slice of both)."""

@@ -94,22 +94,23 @@ class TP_MoE:
         c = int(self.capacity_factor * self.top_k * M / E) + 1
         return min(max(8, -(-c // 8) * 8), M * self.top_k)
 
-    def _expert_mlp_sharded(self, x_e):
+    def _expert_mlp_sharded(self, x_e, gemm=None):
         """Per-rank grouped GEMMs over the sharded intermediate dim;
         output is this rank's PARTIAL [E, cap, D] (needs a sum over tp).
         Stacked via out_specs P(axis, ...) for the explicit RS/AR kernels.
-        """
-        axis = self.axis
+        `gemm` swaps the grouped-GEMM callable (the train path passes
+        the custom-VJP wrapper)."""
+        gemm = gemm or grouped_gemm
 
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
-            in_specs=(P(None, None, None), P(None, None, axis),
-                      P(None, axis, None)),
-            out_specs=P(axis, None, None, None), check_vma=False)
+            in_specs=(P(None, None, None), P(None, None, self.axis),
+                      P(None, self.axis, None)),
+            out_specs=P(self.axis, None, None, None), check_vma=False)
         def f(x_e, wgu_loc, wd_loc):
-            h = grouped_gemm(x_e, wgu_loc.astype(x_e.dtype))
+            h = gemm(x_e, wgu_loc.astype(x_e.dtype))
             h = swiglu_ref(h)
-            y = grouped_gemm(h, wd_loc.astype(x_e.dtype))
+            y = gemm(h, wd_loc.astype(x_e.dtype))
             return y[None]
 
         return f(x_e, self.w_gate_up, self.w_down)   # [n, E, cap, D]
@@ -226,7 +227,44 @@ class TP_MoE:
         return scatter_weighted(y_sum, inv_slot, token, topk_w,
                                 M).astype(x.dtype)
 
+    def fwd_train(self, x):
+        """Training path through framework kernels: custom-VJP
+        all_gather -> route/group (XLA, differentiable) -> custom-VJP
+        grouped GEMMs -> weighted scatter -> custom-VJP reduce_scatter
+        (reference analog: the autograd Function over the fused MoE ops,
+        function/nvidia/ep_moe_fused.py:42). x row-sharded [M/n, D] ->
+        row-sharded [M/n, D]; gradients reach w_router (via the top-k
+        softmax weights), w_gate_up and w_down."""
+        from triton_dist_tpu.kernels.grad import (all_gather_grad,
+                                                  grouped_gemm_grad,
+                                                  reduce_scatter_grad)
+        xg = all_gather_grad(self.mesh, self.axis)(x)
+        M = xg.shape[0]
+        cap = self._cap(M)
+        topk_w, topk_idx = route(xg @ self.w_router, self.top_k)
+        x_e, inv_slot, token = group_tokens_by_expert(
+            xg, topk_idx, self.num_experts, cap)
+        y_parts = self._expert_mlp_sharded(
+            x_e, gemm=grouped_gemm_grad())   # [n, E, cap, D]
+
+        # per-rank weighted combine under shard_map (Manual axes: the
+        # scatter-add and its transpose stay rank-local, which
+        # explicit-sharding mode cannot express for a tp-stacked vmap)
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(self.axis, None, None, None), P(None), P(None),
+                      P(None, None)),
+            out_specs=P(self.axis, None, None), check_vma=False)
+        def scat(y_loc, inv, tok, w):
+            return scatter_weighted(y_loc[0], inv, tok, w, M)[None]
+
+        y_partial = scat(y_parts, inv_slot, token,
+                         topk_w).astype(x.dtype)
+        return reduce_scatter_grad(self.mesh, self.axis)(y_partial)
+
     def __call__(self, x, mode: str = "dist"):
+        if mode == "train":
+            return self.fwd_train(x)
         if mode == "fused":
             return self.fwd_fused(x)
         if mode in ("dist",):

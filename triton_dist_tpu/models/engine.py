@@ -50,12 +50,14 @@ class Engine:
                     "backend='mega' is the single-chip megakernel decode "
                     "path (mega/decode_layer.py); use 'dist'/'gemm_ar' "
                     "for TP decode")
+            if not all(hasattr(l, "mlp") for l in model.layers):
+                raise ValueError(
+                    "backend='mega' supports dense (attention + MLP) "
+                    "layers only; MoE models have no megakernel path")
             # the megakernel's flash loop walks the cache in
             # block_t-sized tiles; round the cache capacity up
-            import dataclasses as _dc
             from triton_dist_tpu.mega import MegaDecodeLayer
-            bt = {f.name: f for f in _dc.fields(MegaDecodeLayer)}[
-                "block_t"].default
+            bt = MegaDecodeLayer.block_t
             self.max_seq = -(-max_seq // bt) * bt
         # the reference prefills with the torch fwd (engine.py:121); the
         # analog here is the XLA-collective mode unless overridden

@@ -188,21 +188,64 @@ class Qwen3MoE:
         cache = cache.advance(S)
         x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
         if mode in ("dist", "ep"):
-            import functools
-
-            @functools.partial(
-                jax.shard_map, mesh=self.mesh,
-                in_specs=P(self.axis, None), out_specs=P(None, None),
-                check_vma=False)
-            def gather_rows(x_loc):
-                return jax.lax.all_gather(x_loc, self.axis, axis=0,
-                                          tiled=True)
-
-            x = gather_rows(x)
+            x = self._gather_rows(x)
         last = x.reshape(B, S, -1)[:, -1]
         logits = jnp.dot(last, self.lm_head,
                          preferred_element_type=jnp.float32)
         return logits, cache
+
+    def forward_train(self, ids, mode: str = "train"):
+        """Training forward (no KV cache), mirroring
+        DenseLLM.forward_train: full-causal attention, all-position
+        logits [B, S, V].
+
+        mode="train" (moe_impl="tp" only): attention through the
+        custom-VJP ag_gemm/gemm_rs + Pallas flash kernels, the MoE FFN
+        through custom-VJP all_gather/grouped-GEMM/reduce_scatter
+        (layers/tp_moe.py::fwd_train — the reference's autograd Function
+        over the fused MoE ops, function/nvidia/ep_moe_fused.py:42).
+        mode="xla": the dense all-experts oracle for gradient tests.
+        """
+        if mode == "train" and self.moe_impl != "tp":
+            raise NotImplementedError(
+                "kernel-path MoE training is the TP-MoE composition; "
+                "construct the model with moe_impl='tp'")
+        B, S = ids.shape
+        impl = "flash" if mode == "train" else "ref"
+        moe_mode = "train" if mode == "train" else "xla"
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        from jax.sharding import AxisType, NamedSharding
+        if any(t == AxisType.Explicit for t in self.mesh.axis_types):
+            # pin the embed-gather cotangent replicated (see
+            # models/dense.py::forward_train)
+            x = jax.sharding.reshard(
+                x, NamedSharding(self.mesh, P(None, None)))
+        for layer in self.layers:
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            x = x + layer.attn.fwd_train(h, self.cos, self.sin, B, impl)
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.moe(h, moe_mode).astype(x.dtype)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "train":
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits.reshape(B, S, -1)
+
+    def _gather_rows(self, x):
+        """Row-sharded [M, D] -> replicated (the LM-head prologue; same
+        helper as DenseLLM._gather_rows)."""
+        import functools
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=P(self.axis, None), out_specs=P(None, None),
+            check_vma=False)
+        def gather_rows(x_loc):
+            return jax.lax.all_gather(x_loc, self.axis, axis=0,
+                                      tiled=True)
+
+        return gather_rows(x)
 
     def make_cache(self, batch: int, max_seq: int, dtype=None) -> KVCache:
         cfg = self.config
