@@ -1,7 +1,9 @@
-"""MoE training-path tests: gradients of TP_MoE.fwd_train (custom-VJP
-all_gather / grouped-GEMM / reduce_scatter kernels) vs jax.grad of the
-dense all-experts XLA oracle, plus a model-level SGD smoke (reference
-analog: training through the autograd Function over the fused MoE ops,
+"""MoE training-path tests, BOTH compositions: gradients of
+TP_MoE.fwd_train (custom-VJP all_gather / grouped-GEMM /
+reduce_scatter) and EP_MoE.fwd_train (custom-VJP a2a dispatch/combine +
+grouped GEMMs) vs jax.grad of the dense all-experts XLA oracle, plus
+model-level SGD smokes over both moe_impls (reference analog: training
+through the autograd Function over the fused MoE ops,
 function/nvidia/ep_moe_fused.py:42, checked against the torch path)."""
 
 import jax
@@ -67,13 +69,53 @@ def test_tp_moe_train_grads_vs_oracle():
                                atol=5e-4, rtol=5e-4, err_msg="dx")
 
 
-def test_qwen_moe_train_step_improves_loss():
+def test_ep_moe_train_grads_vs_oracle():
+    """EP composition: custom-VJP a2a dispatch/combine + grouped GEMMs
+    vs the dense oracle (drop-free capacity)."""
+    from triton_dist_tpu.layers.ep_moe import EP_MoE
+
+    n = mesh.shape["tp"]
+    E, D, I, k = 2 * n, 64, 32, 2
+    rng = np.random.RandomState(5)
+    s = 0.3 / np.sqrt(D)
+    moe = EP_MoE.init(
+        rng.randn(D, E).astype(np.float32) * 0.1,
+        rng.randn(E, D, I).astype(np.float32) * s,
+        rng.randn(E, D, I).astype(np.float32) * s,
+        rng.randn(E, I, D).astype(np.float32) * (0.3 / np.sqrt(I)),
+        mesh=mesh, axis="tp", top_k=k, capacity_factor=float(E))
+    M = 4 * n
+    x = jnp.asarray(rng.randn(M, D), jnp.float32) * 0.3
+    w_out = jnp.asarray(rng.randn(M, D), jnp.float32)
+    x_sh = jax.device_put(x, NamedSharding(mesh, P("tp", None)))
+
+    def loss(mode):
+        return lambda moe, x: jnp.sum(
+            moe(x, mode).astype(jnp.float32) * w_out)
+
+    with jax.default_matmul_precision("highest"):
+        lt, gt = jax.jit(jax.value_and_grad(loss("train"),
+                                            argnums=(0, 1)))(moe, x_sh)
+        lx, gx = jax.jit(jax.value_and_grad(loss("xla"),
+                                            argnums=(0, 1)))(moe, x_sh)
+    np.testing.assert_allclose(float(lt), float(lx), rtol=1e-5)
+    for name in ("w_router", "w_gate_up", "w_down"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(gt[0], name)),
+            np.asarray(getattr(gx[0], name)),
+            atol=5e-4, rtol=5e-4, err_msg=name)
+    np.testing.assert_allclose(np.asarray(gt[1]), np.asarray(gx[1]),
+                               atol=5e-4, rtol=5e-4, err_msg="dx")
+
+
+@pytest.mark.parametrize("impl", ["tp", "ep"])
+def test_qwen_moe_train_step_improves_loss(impl):
     from triton_dist_tpu.models.qwen_moe import Qwen3MoE
     from triton_dist_tpu.models.config import tiny_qwen3_moe
 
     n = mesh.shape["tp"]
     cfg = tiny_qwen3_moe(n, num_layers=1)
-    model = Qwen3MoE.random_init(cfg, mesh, moe_impl="tp")
+    model = Qwen3MoE.random_init(cfg, mesh, moe_impl=impl)
     rng = np.random.RandomState(0)
     B, S = 2, 2 * n
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, S)),
@@ -100,13 +142,3 @@ def test_qwen_moe_train_step_improves_loss():
     assert float(l1) < float(l0), (float(l0), float(l1))
 
 
-def test_qwen_moe_train_rejects_ep():
-    from triton_dist_tpu.models.qwen_moe import Qwen3MoE
-    from triton_dist_tpu.models.config import tiny_qwen3_moe
-
-    n = mesh.shape["tp"]
-    cfg = tiny_qwen3_moe(n, num_layers=1)
-    model = Qwen3MoE.random_init(cfg, mesh, moe_impl="ep")
-    ids = jnp.zeros((1, n), jnp.int32)
-    with pytest.raises(NotImplementedError, match="tp"):
-        model.forward_train(ids, mode="train")

@@ -201,6 +201,58 @@ def reduce_scatter_grad(mesh: Mesh, axis: str = "tp"):
     return op
 
 
+def dispatch_a2a_grad(n: int, axis: str):
+    """Differentiable EP dispatch (device-local, inside shard_map): the
+    block a2a is an orthogonal permutation, so its adjoint is the
+    REVERSE a2a — the payload cotangent rides the combine kernel.
+    Metadata is integer (routing) and carries float0 cotangents."""
+    import numpy as np
+    from triton_dist_tpu.kernels.ep_a2a import combine_a2a, dispatch_a2a
+    from triton_dist_tpu.runtime import next_collective_id
+
+    @jax.custom_vjp
+    def op(send_x, send_meta):
+        return dispatch_a2a(send_x, send_meta, n=n, axis=axis,
+                            collective_id=next_collective_id())
+
+    def fwd(send_x, send_meta):
+        out = dispatch_a2a(send_x, send_meta, n=n, axis=axis,
+                           collective_id=next_collective_id())
+        return out, send_meta.shape
+
+    def bwd(meta_shape, ct):
+        d_recv_x, _ = ct
+        d_send = combine_a2a(d_recv_x, n=n, axis=axis,
+                             collective_id=next_collective_id())
+        return d_send, np.zeros(meta_shape, jax.dtypes.float0)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def combine_a2a_grad(n: int, axis: str):
+    """Differentiable EP combine: adjoint = the dispatch-direction a2a
+    (the same self-adjoint block permutation)."""
+    from triton_dist_tpu.kernels.ep_a2a import combine_a2a
+    from triton_dist_tpu.runtime import next_collective_id
+
+    @jax.custom_vjp
+    def op(y_slots):
+        return combine_a2a(y_slots, n=n, axis=axis,
+                           collective_id=next_collective_id())
+
+    def fwd(y_slots):
+        return combine_a2a(y_slots, n=n, axis=axis,
+                           collective_id=next_collective_id()), None
+
+    def bwd(_, dy):
+        return (combine_a2a(dy, n=n, axis=axis,
+                            collective_id=next_collective_id()),)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def _transpose_rows(b, mesh, axis):
     """b [K, N] col-sharded -> b^T [N, K] row-sharded (a local
     transpose: the shard each device holds is its own slice of both)."""

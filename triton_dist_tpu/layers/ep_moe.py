@@ -81,15 +81,23 @@ class EP_MoE:
         e_cap = min(max(8, -(-e_cap // 8) * 8), n * pair)
         return pair, e_cap
 
-    def fwd_ep(self, x):
-        """x: [T, D] row-sharded over the ep axis -> same sharding."""
+    def fwd_ep(self, x, disp=None, comb=None, gemm=None):
+        """x: [T, D] row-sharded over the ep axis -> same sharding.
+        disp/comb/gemm swap the a2a and grouped-GEMM callables (the
+        train path passes the custom-VJP wrappers)."""
         n = self.mesh.shape[self.axis]
         axis = self.axis
         epr = self.num_experts // n
         k = self.top_k
         T = x.shape[0]
         cap, e_cap = self._caps(T // n)
-        cid = next_collective_id()
+        if disp is None:
+            cid = next_collective_id()
+            disp = functools.partial(dispatch_a2a, n=n, axis=axis,
+                                     collective_id=cid)
+            comb = functools.partial(combine_a2a, n=n, axis=axis,
+                                     collective_id=cid)
+        gemm = gemm or grouped_gemm
 
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
@@ -102,20 +110,18 @@ class EP_MoE:
             plan = plan_dispatch(topk_idx, n, epr, cap)
             send_x, send_meta = fill_send_buffers(x_loc, topk_idx, plan,
                                                   n, epr, cap)
-            recv_x, recv_meta = dispatch_a2a(send_x, send_meta, n=n,
-                                             axis=axis, collective_id=cid)
+            recv_x, recv_meta = disp(send_x, send_meta)
             x_e, inv_slot = group_by_expert(recv_x, recv_meta, epr, e_cap)
-            h = grouped_gemm(x_e, wgu_loc.astype(x_e.dtype))
+            h = gemm(x_e, wgu_loc.astype(x_e.dtype))
             h = swiglu_ref(h)
-            y_e = grouped_gemm(h, wd_loc.astype(x_e.dtype))
+            y_e = gemm(h, wd_loc.astype(x_e.dtype))
             y_flat = y_e.reshape(epr * e_cap, -1)
             gathered = jnp.take(y_flat,
                                 jnp.minimum(inv_slot, epr * e_cap - 1),
                                 axis=0)
             y_slots = gathered * (inv_slot < epr * e_cap)[:, None].astype(
                 gathered.dtype)
-            y_back = combine_a2a(y_slots, n=n, axis=axis,
-                                 collective_id=cid)
+            y_back = comb(y_slots)
             y = combine_from_slots(y_back, plan, topk_w, t_loc)
             return y.astype(x_loc.dtype)
 
@@ -155,5 +161,23 @@ class EP_MoE:
 
         return _f(x, self.w_router, self.w_gate_up, self.w_down)
 
+    def fwd_train(self, x):
+        """Training path through the framework kernels (reference: the
+        autograd Function over the fused EP ops,
+        function/nvidia/ep_moe_fused.py:42): fwd_ep's per-rank program
+        with custom-VJP a2a kernels (each a2a's adjoint IS the reverse
+        a2a kernel) and custom-VJP grouped GEMMs. Gradients reach the
+        router (via the top-k softmax weights), both expert
+        projections, and x."""
+        from triton_dist_tpu.kernels.grad import (combine_a2a_grad,
+                                                  dispatch_a2a_grad,
+                                                  grouped_gemm_grad)
+        n = self.mesh.shape[self.axis]
+        return self.fwd_ep(x, disp=dispatch_a2a_grad(n, self.axis),
+                           comb=combine_a2a_grad(n, self.axis),
+                           gemm=grouped_gemm_grad())
+
     def __call__(self, x, mode: str = "ep"):
+        if mode == "train":
+            return self.fwd_train(x)
         return self.fwd_ep(x) if mode == "ep" else self.fwd_xla(x)

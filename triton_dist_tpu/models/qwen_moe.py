@@ -199,17 +199,15 @@ class Qwen3MoE:
         DenseLLM.forward_train: full-causal attention, all-position
         logits [B, S, V].
 
-        mode="train" (moe_impl="tp" only): attention through the
-        custom-VJP ag_gemm/gemm_rs + Pallas flash kernels, the MoE FFN
-        through custom-VJP all_gather/grouped-GEMM/reduce_scatter
-        (layers/tp_moe.py::fwd_train — the reference's autograd Function
-        over the fused MoE ops, function/nvidia/ep_moe_fused.py:42).
+        mode="train": attention through the custom-VJP ag_gemm/gemm_rs +
+        Pallas flash kernels; the MoE FFN through custom-VJP
+        all_gather/grouped-GEMM/reduce_scatter (moe_impl="tp",
+        layers/tp_moe.py::fwd_train) or custom-VJP a2a dispatch/combine
+        + grouped GEMMs (moe_impl="ep", layers/ep_moe.py::fwd_train) —
+        the reference's autograd Function over the fused MoE ops,
+        function/nvidia/ep_moe_fused.py:42.
         mode="xla": the dense all-experts oracle for gradient tests.
         """
-        if mode == "train" and self.moe_impl != "tp":
-            raise NotImplementedError(
-                "kernel-path MoE training is the TP-MoE composition; "
-                "construct the model with moe_impl='tp'")
         B, S = ids.shape
         impl = "flash" if mode == "train" else "ref"
         moe_mode = "train" if mode == "train" else "xla"
