@@ -253,6 +253,46 @@ def combine_a2a_grad(n: int, axis: str):
     return op
 
 
+def ulysses_dispatch_grad(mesh: Mesh, axis: str = "sp"):
+    """Differentiable Ulysses pre-attention a2a: seq-sharded ->
+    head-sharded [B, S, H, d]. The reshard is an orthogonal permutation
+    whose adjoint is the inverse reshard — the combine kernel."""
+    from triton_dist_tpu.kernels.sp_attention import (ulysses_combine,
+                                                      ulysses_dispatch)
+
+    @jax.custom_vjp
+    def op(x):
+        return ulysses_dispatch(x, mesh=mesh, axis=axis)
+
+    def fwd(x):
+        return ulysses_dispatch(x, mesh=mesh, axis=axis), None
+
+    def bwd(_, dy):
+        return (ulysses_combine(dy, mesh=mesh, axis=axis),)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def ulysses_combine_grad(mesh: Mesh, axis: str = "sp"):
+    """Differentiable Ulysses post-attention a2a (adjoint = dispatch)."""
+    from triton_dist_tpu.kernels.sp_attention import (ulysses_combine,
+                                                      ulysses_dispatch)
+
+    @jax.custom_vjp
+    def op(x):
+        return ulysses_combine(x, mesh=mesh, axis=axis)
+
+    def fwd(x):
+        return ulysses_combine(x, mesh=mesh, axis=axis), None
+
+    def bwd(_, dy):
+        return (ulysses_dispatch(dy, mesh=mesh, axis=axis),)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def _transpose_rows(b, mesh, axis):
     """b [K, N] col-sharded -> b^T [N, K] row-sharded (a local
     transpose: the shard each device holds is its own slice of both)."""

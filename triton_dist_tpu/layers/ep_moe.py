@@ -91,6 +91,8 @@ class EP_MoE:
         k = self.top_k
         T = x.shape[0]
         cap, e_cap = self._caps(T // n)
+        assert (disp is None) == (comb is None), \
+            "disp and comb must be overridden together"
         if disp is None:
             cid = next_collective_id()
             disp = functools.partial(dispatch_a2a, n=n, axis=axis,

@@ -271,24 +271,84 @@ class UlyssesAttn:
                            out_specs=P(None, None, axis, None),
                            check_vma=False)
         def attend(qkv_loc):
-            q = qkv_loc[..., :hq_loc * hd].reshape(B, S, hq_loc, hd)
-            k = qkv_loc[..., hq_loc * hd:(hq_loc + hkv_loc) * hd]
-            v = qkv_loc[..., (hq_loc + hkv_loc) * hd:]
-            k = k.reshape(B, S, hkv_loc, hd)
-            v = v.reshape(B, S, hkv_loc, hd)
-            if self.q_norm is not None:
-                q = rms_norm(q, self.q_norm)
-            if self.k_norm is not None:
-                k = rms_norm(k, self.k_norm)
-            pos = jnp.arange(S)
-            q = apply_rope(q, cos, sin, pos)
-            k = apply_rope(k, cos, sin, pos)
-            o = flash_decode(q, k.transpose(0, 2, 1, 3),
-                             v.transpose(0, 2, 1, 3), jnp.int32(S))
-            return o
+            q, k, v = self._unpack_norm_rope(
+                qkv_loc, B, S, hq_loc, hkv_loc, hd, self.q_norm,
+                self.k_norm, cos, sin)
+            return flash_decode(q, k, v, jnp.int32(S))
 
         o = attend(qkv)                      # [B, S, Hq, d] head-sharded
         o = ulysses_combine(o, mesh=self.mesh, axis=axis)
+        o = o.reshape(B, S, self.n_heads * hd)
+        return _local_oproj(o, self.w_o, self.mesh, axis)
+
+    @staticmethod
+    def _unpack_norm_rope(qkv_loc, B, S, hq_loc, hkv_loc, hd,
+                          q_norm, k_norm, cos, sin):
+        """Shared per-rank QKV unpack + QK-norm + RoPE for prefill AND
+        fwd_train: q [B, S, hq_loc, hd]; k, v in the cache layout
+        [B, hkv_loc, S, hd]."""
+        q = qkv_loc[..., :hq_loc * hd].reshape(B, S, hq_loc, hd)
+        k = (qkv_loc[..., hq_loc * hd:(hq_loc + hkv_loc) * hd]
+             .reshape(B, S, hkv_loc, hd))
+        v = (qkv_loc[..., (hq_loc + hkv_loc) * hd:]
+             .reshape(B, S, hkv_loc, hd))
+        if q_norm is not None:
+            q = rms_norm(q, q_norm)
+        if k_norm is not None:
+            k = rms_norm(k, k_norm)
+        pos = jnp.arange(S)
+        q = apply_rope(q, cos, sin, pos)
+        k = apply_rope(k, cos, sin, pos)
+        return q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+    def fwd_train(self, x, cos, sin):
+        """Differentiable Ulysses SP attention (training): local QKV
+        GEMM -> custom-VJP dispatch a2a (adjoint = the combine kernel)
+        -> differentiable Pallas flash attention on this chip's heads
+        over the full sequence -> custom-VJP combine a2a -> local O
+        projection. x: [B, S, D] sequence-sharded -> same sharding.
+        Reference analog: training through the Ulysses SP dispatch under
+        autograd (ulysses_sp_dispatch.py:39 + torch.autograd)."""
+        from triton_dist_tpu.kernels.flash_attn_train import flash_attention
+        from triton_dist_tpu.kernels.grad import (ulysses_combine_grad,
+                                                  ulysses_dispatch_grad)
+        B, S, D = x.shape
+        n = self.mesh.shape[self.axis]
+        hq_loc = self.n_heads // n
+        hkv_loc = self.n_kv_heads // n
+        hd = self.head_dim
+        axis = self.axis
+        C = (hq_loc + 2 * hkv_loc) * hd
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, axis, None), P(None, None)),
+                           out_specs=P(None, axis, None), check_vma=False)
+        def proj(x_loc, w):
+            return x_loc @ w
+
+        qkv_seq = proj(x, self.w_qkv)       # [B, S, n*C] seq-sharded
+        qkv = ulysses_dispatch_grad(self.mesh, axis)(
+            qkv_seq.reshape(B, S, n, C)).reshape(B, S, n * C)
+
+        norms = [a for a in (self.q_norm, self.k_norm) if a is not None]
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, None, axis), P(None, None), P(None, None))
+                     + (P(None),) * len(norms),
+            out_specs=P(None, None, axis, None), check_vma=False)
+        def attend(qkv_loc, cos, sin, *norms):
+            # norms as shard_map ARGS (not closures): Explicit-sharded
+            # cotangents must come back psum-replicated
+            ni = iter(norms)
+            qn = next(ni) if self.q_norm is not None else None
+            kn = next(ni) if self.k_norm is not None else None
+            q, k, v = self._unpack_norm_rope(
+                qkv_loc, B, S, hq_loc, hkv_loc, hd, qn, kn, cos, sin)
+            return flash_attention(q, k, v)
+
+        o = attend(qkv, cos, sin, *norms)    # [B, S, Hq, d] head-sharded
+        o = ulysses_combine_grad(self.mesh, axis)(o)
         o = o.reshape(B, S, self.n_heads * hd)
         return _local_oproj(o, self.w_o, self.mesh, axis)
 
