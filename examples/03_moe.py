@@ -1,0 +1,65 @@
+"""Qwen3-MoE with BOTH parallel compositions (reference: e2e_moe +
+the EP a2a path):
+
+  TP-MoE — experts replicated, intermediate sharded; forward =
+           AG-GroupGEMM + MoE-reduce-RS fused ring kernels.
+  EP-MoE — experts sharded, tokens routed to their experts' owners by
+           one-sided a2a dispatch/combine kernels.
+
+Both also TRAIN through their kernels (custom VJPs, kernels/grad.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.config import tiny_qwen3_moe
+from triton_dist_tpu.models.qwen_moe import Qwen3MoE
+from triton_dist_tpu.runtime import initialize_distributed
+
+
+def main():
+    ctx = initialize_distributed()
+    n = ctx.tp_size()
+    cfg = tiny_qwen3_moe(n, num_layers=1)   # 1 layer: quick on any host
+    rng = np.random.RandomState(0)
+    B, S = 1, 2 * n
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    for impl, mode in (("tp", "fused"), ("ep", "ep")):
+        model = Qwen3MoE.random_init(cfg, ctx.mesh, moe_impl=impl)
+        cache = model.make_cache(B, 4 * n)
+        # oracle vs kernel path
+        logits_x, _ = jax.jit(
+            lambda i, c, m=model: m.forward_tokens(i, c, "xla"))(ids, cache)
+        cache = model.make_cache(B, 4 * n)
+        logits_k, _ = jax.jit(
+            lambda i, c, m=model, mo=mode: m.forward_tokens(i, c, mo))(
+                ids, cache)
+        err = float(jnp.max(jnp.abs(logits_k - logits_x)))
+        print(f"moe_impl={impl}: kernel path vs oracle max err {err:.2e}")
+
+        # one training step through the kernels
+        labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)),
+                             jnp.int32)
+
+        def loss_fn(m, ids, labels):
+            logits = m.forward_train(ids, mode="train")
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+        loss, _ = jax.jit(jax.value_and_grad(loss_fn))(model, ids, labels)
+        print(f"moe_impl={impl}: train-mode loss {float(loss):.4f}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Sequence parallelism for long context (reference: the SP AG-attention
+prefill, distributed flash-decode and Ulysses mechanisms):
+
+  - ring-attention prefill: KV chunks stream around the ICI ring while
+    each chip's queries consume them (kernels/sp_attention.py).
+  - seq-sharded decode: each chip holds a slice of the KV cache,
+    produces split-KV partials, and an inter-chip LSE combine merges
+    them (kernels/sp_flash_decode.py).
+  - Ulysses: a2a head-reshard so attention is local over the full
+    sequence (layers/sp_attn.py::UlyssesAttn, trainable).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.layers.common import precompute_rope
+from triton_dist_tpu.layers.sp_attn import SPAttn, UlyssesAttn
+from triton_dist_tpu.runtime import initialize_distributed
+
+
+def main():
+    ctx = initialize_distributed({"sp": len(jax.devices())})
+    n = ctx.mesh.shape["sp"]
+    B, D, hd = 1, 128, 64
+    Hq = Hkv = n                     # one q + one kv head per chip
+    S = 16 * n                       # the "long" sequence, sharded
+    rng = np.random.RandomState(0)
+    sc = 0.5 / np.sqrt(D)
+    mk = lambda *s: (rng.randn(*s) * sc).astype(np.float32)
+    cos, sin = precompute_rope(hd, 4 * S)
+    x = jnp.asarray(rng.randn(B, S, D) * 0.3, jnp.float32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    xs = jax.device_put(x, NamedSharding(ctx.mesh, P(None, "sp", None)))
+
+    # --- ring attention prefill + seq-sharded decode
+    sp = SPAttn.init(mk(D, Hq * hd), mk(D, Hkv * hd), mk(D, Hkv * hd),
+                     mk(Hq * hd, D), mesh=ctx.mesh, n_heads=Hq,
+                     n_kv_heads=Hkv, head_dim=hd)
+    ck, cv = sp.alloc_cache(B, 2 * S, dtype=jnp.float32)
+    out, ck, cv, kv_len = jax.jit(sp.prefill)(xs, cos, sin, ck, cv)
+    print("ring prefill out:", out.shape)
+    x1 = jnp.asarray(rng.randn(B, 1, D) * 0.3, jnp.float32)
+    out1, ck, cv, kv_len = jax.jit(sp.decode)(x1, cos, sin, ck, cv, kv_len)
+    print("seq-sharded flash-decode out:", out1.shape,
+          "cache len:", int(kv_len))
+
+    # --- Ulysses (fused GEMM+a2a prefill; also trainable via fwd_train)
+    ul = UlyssesAttn.init(mk(D, Hq * hd), mk(D, Hkv * hd), mk(D, Hkv * hd),
+                          mk(Hq * hd, D), mesh=ctx.mesh, n_heads=Hq,
+                          n_kv_heads=Hkv, head_dim=hd)
+    out_u = jax.jit(lambda x: ul.prefill(x, cos, sin, mode="fused"))(xs)
+    print("ulysses fused prefill out:", out_u.shape)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Shared example bootstrap. Call `bootstrap()` BEFORE importing jax.
+
+On the virtual CPU mesh substrate (JAX_PLATFORMS=cpu +
+--xla_force_host_platform_device_count=N), the Pallas TPU interpreter
+issues blocking per-device waits; on hosts with few cores the XLA CPU
+client sizes its thread pool from nproc and the interpreted ring
+kernels starve. tests/conftest.py and __graft_entry__ widen the pool
+with the tools/fakecpus.c LD_PRELOAD shim — this does the same for the
+examples by re-exec'ing with the shim loaded. No-op on real TPUs and
+on well-provisioned hosts."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bootstrap():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    marker = "--xla_force_host_platform_device_count="
+    if marker not in flags:
+        return
+    n = int(flags.split(marker)[1].split()[0])
+    if ((os.cpu_count() or 1) >= 4 * n
+            or "fakecpus" in os.environ.get("LD_PRELOAD", "")
+            or os.environ.get("TDTPU_NO_FAKECPUS") == "1"):
+        return
+    shim_src = os.path.join(_REPO, "tools", "fakecpus.c")
+    shim = os.path.join(_REPO, "tools", "fakecpus.so")
+    if not os.path.exists(shim) and os.path.exists(shim_src):
+        subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", shim,
+                        shim_src], check=False)
+    if not os.path.exists(shim):
+        return
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = (shim + " " + env.get("LD_PRELOAD", "")).strip()
+    env.setdefault("FAKE_NPROC", str(max(32, 4 * n)))
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)

@@ -1,0 +1,25 @@
+"""Examples smoke: the kernel-library example must run end-to-end as a
+real subprocess on the virtual mesh (the same way a user would run it).
+One example suffices for CI time; all six are exercised manually and
+share the same _common.bootstrap substrate."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_kernels_example_runs():
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "05_kernels.py")],
+        env=env, capture_output=True, text=True, timeout=1200)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout, out.stdout

@@ -131,7 +131,7 @@ class SPAttn:
             q, k_s, v_s, mesh=self.mesh, axis=axis, causal=True,
             mode=mode, out_dtype=x.dtype)
         out = out.reshape(B, S, self.n_heads * self.head_dim)
-        o = _local_oproj(out, self.w_o, self.mesh, axis)
+        o = _local_proj(out, self.w_o, self.mesh, axis)
         return o, cache_k, cache_v, jnp.int32(S)
 
     def decode(self, x, cos, sin, cache_k, cache_v, kv_len, *,
@@ -181,16 +181,17 @@ def _write_token(cache, kv_new, pos, mesh, axis):
     return _f(cache, kv_new, jnp.asarray(pos, jnp.int32))
 
 
-def _local_oproj(x, w_o, mesh, axis):
-    """O projection on seq-sharded tokens: replicated weight, zero
-    collectives (the SP payoff: the reduction dim is intact)."""
+def _local_proj(x, w, mesh, axis):
+    """Seq-sharded local GEMM (replicated weight, zero collectives —
+    the SP payoff: the reduction dim is intact). Used for both the QKV
+    and O projections."""
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(P(None, axis, None), P(None, None)),
                        out_specs=P(None, axis, None), check_vma=False)
     def _f(x_loc, w):
         return x_loc @ w
 
-    return _f(x, w_o)
+    return _f(x, w)
 
 
 @jax.tree_util.register_dataclass
@@ -250,15 +251,8 @@ class UlyssesAttn:
         if mode == "fused":
             qkv = qkv_gemm_a2a(x, self.w_qkv, mesh=self.mesh, axis=axis)
         else:
-            @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, axis, None),
-                                         P(None, None)),
-                               out_specs=P(None, axis, None),
-                               check_vma=False)
-            def proj(x_loc, w):
-                return x_loc @ w
-
-            qkv_seq = proj(x, self.w_qkv)   # [B, S, n*C] seq-sharded
+            qkv_seq = _local_proj(x, self.w_qkv, self.mesh,
+                                  axis)     # [B, S, n*C] seq-sharded
             # dispatch on a head-like trailing dim: n chunks ("heads")
             # of width C, keeping a full C-wide lane dim for the DMAs
             qkv = ulysses_dispatch(
@@ -279,7 +273,7 @@ class UlyssesAttn:
         o = attend(qkv)                      # [B, S, Hq, d] head-sharded
         o = ulysses_combine(o, mesh=self.mesh, axis=axis)
         o = o.reshape(B, S, self.n_heads * hd)
-        return _local_oproj(o, self.w_o, self.mesh, axis)
+        return _local_proj(o, self.w_o, self.mesh, axis)
 
     @staticmethod
     def _unpack_norm_rope(qkv_loc, B, S, hq_loc, hkv_loc, hd,
@@ -320,13 +314,8 @@ class UlyssesAttn:
         axis = self.axis
         C = (hq_loc + 2 * hkv_loc) * hd
 
-        @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, axis, None), P(None, None)),
-                           out_specs=P(None, axis, None), check_vma=False)
-        def proj(x_loc, w):
-            return x_loc @ w
-
-        qkv_seq = proj(x, self.w_qkv)       # [B, S, n*C] seq-sharded
+        qkv_seq = _local_proj(x, self.w_qkv, self.mesh,
+                              axis)         # [B, S, n*C] seq-sharded
         qkv = ulysses_dispatch_grad(self.mesh, axis)(
             qkv_seq.reshape(B, S, n, C)).reshape(B, S, n * C)
 
@@ -350,7 +339,7 @@ class UlyssesAttn:
         o = attend(qkv, cos, sin, *norms)    # [B, S, Hq, d] head-sharded
         o = ulysses_combine_grad(self.mesh, axis)(o)
         o = o.reshape(B, S, self.n_heads * hd)
-        return _local_oproj(o, self.w_o, self.mesh, axis)
+        return _local_proj(o, self.w_o, self.mesh, axis)
 
     def _oracle(self, x, cos, sin):
         """Replicated jnp oracle with identical weight unpacking."""
