@@ -61,14 +61,19 @@ def _zero_pad_cols(a_ref, T, start, bt):
     return a
 
 
-def _mask(rep, q_off, T, r0, start, br, bt):
+def _mask(rep, q_off, lim, r0, start, br, bt):
     row = jax.lax.broadcasted_iota(jnp.int32, (br, bt), 0) + r0
     col = jax.lax.broadcasted_iota(jnp.int32, (br, bt), 1) + start
-    return (col <= (row // rep + q_off)) & (col < T)
+    return (col <= (row // rep + q_off)) & (col < lim)
 
 
-def _dq_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
-               d_ref, dq_ref, acc_scr):
+def _dq_kernel(scale, rep, T, len_ref, q_ref, k_ref, v_ref, do_ref,
+               lse_ref, d_ref, dq_ref, acc_scr):
+    """len_ref (scalar prefetch): [valid_len, q_off] — traced so ring
+    backward steps can reuse ONE compiled kernel for every (q-chip,
+    kv-block) pair, including fully-masked future pairs."""
+    valid_len = len_ref[0]
+    q_off = len_ref[1]
     t = pl.program_id(2)
     nt = pl.num_programs(2)
     br = q_ref.shape[1]
@@ -81,8 +86,9 @@ def _dq_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # the whole tile is masked iff its first col is past the last row's
-    # causal frontier
-    @pl.when(start <= q_off + (r0 + br - 1) // rep)
+    # causal frontier (or past the valid columns)
+    @pl.when((start <= q_off + (r0 + br - 1) // rep)
+             & (start < valid_len))
     def _compute():
         q = q_ref[...]
         k = _zero_pad_cols(k_ref, T, start, bt)
@@ -90,7 +96,8 @@ def _dq_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale   # [bx, br, bt]
-        mask = _mask(rep, q_off, T, r0, start, br, bt)
+        mask = _mask(rep, q_off, jnp.minimum(valid_len, T), r0, start,
+                     br, bt)
         p = jnp.where(mask[None], jnp.exp(s - lse_ref[...][..., None]), 0.0)
         dp = jax.lax.dot_general(
             do_ref[...], v, (((2,), (2,)), ((0,), (0,))),
@@ -105,8 +112,10 @@ def _dq_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[...] = acc_scr[...].astype(dq_ref.dtype)
 
 
-def _dkdv_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                 d_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+def _dkdv_kernel(scale, rep, T, len_ref, q_ref, k_ref, v_ref, do_ref,
+                 lse_ref, d_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+    valid_len = len_ref[0]
+    q_off = len_ref[1]
     r = pl.program_id(2)
     nr = pl.num_programs(2)
     br = q_ref.shape[1]
@@ -119,7 +128,8 @@ def _dkdv_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(start <= q_off + (r0 + br - 1) // rep)
+    @pl.when((start <= q_off + (r0 + br - 1) // rep)
+             & (start < valid_len))
     def _compute():
         q = q_ref[...]
         k = _zero_pad_cols(k_ref, T, start, bt)
@@ -127,7 +137,8 @@ def _dkdv_kernel(scale, rep, T, q_off, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale   # [bx, br, bt]
-        mask = _mask(rep, q_off, T, r0, start, br, bt)
+        mask = _mask(rep, q_off, jnp.minimum(valid_len, T), r0, start,
+                     br, bt)
         p = jnp.where(mask[None], jnp.exp(s - lse_ref[...][..., None]), 0.0)
         do = do_ref[...]
         dp = jax.lax.dot_general(
@@ -234,6 +245,61 @@ def _flash_attention_fwd(q, k, v, scale, block_r, block_t):
     return o, (qx, kx, vx, of32, lse)
 
 
+def _flash_bwd_call(qx, kx, vx, dox, lse, dvec, valid_len, q_off, *,
+                    scale, rep, block_r, block_t):
+    """Per-pair flash backward in the folded layout: (dq, dk, dv) for
+    one (query block, KV block) pair. valid_len/q_off are TRACED
+    (scalar prefetch) so ring-backward steps reuse one compiled kernel
+    for every pair, including fully-masked future ones."""
+    X, R, d = qx.shape
+    T = kx.shape[1]
+    br = _pick_block(R, block_r)
+    bt = min(block_t, T)
+    bx = _pick_bx_bwd(X, br, bt, d, jnp.dtype(qx.dtype).itemsize)
+    nr, nt = R // br, pl.cdiv(T, bt)
+    scalars = jnp.stack([jnp.asarray(valid_len, jnp.int32),
+                         jnp.asarray(q_off, jnp.int32)])
+
+    qspec = pl.BlockSpec((bx, br, d), lambda x, r, t, s: (x, r, 0))
+    kspec = pl.BlockSpec((bx, bt, d), lambda x, r, t, s: (x, t, 0))
+    rowspec = pl.BlockSpec((bx, br), lambda x, r, t, s: (x, r))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale, rep, T),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(X // bx, nr, nt),
+            in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+            out_specs=pl.BlockSpec((bx, br, d),
+                                   lambda x, r, t, s: (x, r, 0)),
+            scratch_shapes=[pltpu.VMEM((bx, br, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((X, R, d), qx.dtype),
+        interpret=interpret_mode(),
+    )(scalars, qx, kx, vx, dox, lse, dvec)
+
+    qspec2 = pl.BlockSpec((bx, br, d), lambda x, t, r, s: (x, r, 0))
+    kspec2 = pl.BlockSpec((bx, bt, d), lambda x, t, r, s: (x, t, 0))
+    rowspec2 = pl.BlockSpec((bx, br), lambda x, t, r, s: (x, r))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale, rep, T),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(X // bx, nt, nr),
+            in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+            out_specs=(pl.BlockSpec((bx, bt, d),
+                                    lambda x, t, r, s: (x, t, 0)),
+                       pl.BlockSpec((bx, bt, d),
+                                    lambda x, t, r, s: (x, t, 0))),
+            scratch_shapes=[pltpu.VMEM((bx, bt, d), jnp.float32),
+                            pltpu.VMEM((bx, bt, d), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct((X, T, d), kx.dtype),
+                   jax.ShapeDtypeStruct((X, T, d), vx.dtype)),
+        interpret=interpret_mode(),
+    )(scalars, qx, kx, vx, dox, lse, dvec)
+    return dq, dk, dv
+
+
 def _flash_attention_bwd(scale, block_r, block_t, res, do):
     qx, kx, vx, of32, lse = res
     X, R, d = qx.shape
@@ -244,42 +310,10 @@ def _flash_attention_bwd(scale, block_r, block_t, res, do):
     rep = Hq // Hkv
     dox = _fold_q(do, B, S, Hkv, rep, d)
     dvec = jnp.sum(dox.astype(jnp.float32) * of32, axis=-1)   # [X, R]
-    q_off = T - S
 
-    br = _pick_block(R, block_r)
-    bt = min(block_t, T)
-    bx = _pick_bx_bwd(X, br, bt, d, jnp.dtype(qx.dtype).itemsize)
-    nr, nt = R // br, pl.cdiv(T, bt)
-
-    qspec = pl.BlockSpec((bx, br, d), lambda x, r, t: (x, r, 0))
-    kspec = pl.BlockSpec((bx, bt, d), lambda x, r, t: (x, t, 0))
-    rowspec = pl.BlockSpec((bx, br), lambda x, r, t: (x, r))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale, rep, T, q_off),
-        grid=(X // bx, nr, nt),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
-        out_specs=pl.BlockSpec((bx, br, d), lambda x, r, t: (x, r, 0)),
-        out_shape=jax.ShapeDtypeStruct((X, R, d), qx.dtype),
-        scratch_shapes=[pltpu.VMEM((bx, br, d), jnp.float32)],
-        interpret=interpret_mode(),
-    )(qx, kx, vx, dox, lse, dvec)
-
-    qspec2 = pl.BlockSpec((bx, br, d), lambda x, t, r: (x, r, 0))
-    kspec2 = pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0))
-    rowspec2 = pl.BlockSpec((bx, br), lambda x, t, r: (x, r))
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, scale, rep, T, q_off),
-        grid=(X // bx, nt, nr),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
-        out_specs=(pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0)),
-                   pl.BlockSpec((bx, bt, d), lambda x, t, r: (x, t, 0))),
-        out_shape=(jax.ShapeDtypeStruct((X, T, d), kx.dtype),
-                   jax.ShapeDtypeStruct((X, T, d), vx.dtype)),
-        scratch_shapes=[pltpu.VMEM((bx, bt, d), jnp.float32),
-                        pltpu.VMEM((bx, bt, d), jnp.float32)],
-        interpret=interpret_mode(),
-    )(qx, kx, vx, dox, lse, dvec)
-
+    dq, dk, dv = _flash_bwd_call(
+        qx, kx, vx, dox, lse, dvec, T, T - S, scale=scale, rep=rep,
+        block_r=block_r, block_t=block_t)
     dq = _unfold_q(dq, B, S, Hkv, rep, d)
     dk = dk.reshape(B, Hkv, T, d)
     dv = dv.reshape(B, Hkv, T, d)

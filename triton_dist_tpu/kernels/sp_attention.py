@@ -127,38 +127,158 @@ def sp_ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                        in_specs=(q_spec, kv_spec, kv_spec),
                        out_specs=q_spec, check_vma=False)
     def _f(q_loc, k_loc, v_loc):
-        me = jax.lax.axis_index(axis)
-        rows = (B, s_loc, Hq)
-        acc = jnp.zeros(rows + (d,), jnp.float32)
-        m = jnp.full(rows, -1e30, jnp.float32)
-        l = jnp.zeros(rows, jnp.float32)
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        kb, vb = k_loc, v_loc
-        for r in range(n):
-            src = jax.lax.rem(me - r + n, jnp.int32(n))
-            if causal:
-                # future blocks: kv_len=0 — the kernel still launches
-                # (uniform across devices, required by the interpreter's
-                # lockstep and cheap on hardware) but its pl.when gate
-                # skips every tile, so the causal half costs no FLOPs
-                # (the reference skips by rank order the same way,
-                # sp_ag_attention_intra_node.py:257).
-                local_len = jnp.where(src <= me, s_loc, 0).astype(jnp.int32)
-                q_off = (me - src) * s_loc
-            else:
-                local_len = jnp.int32(s_loc)
-                q_off = jnp.int32(s_loc - 1)
-            part = flash_decode_partial(
-                q_loc, kb, vb, local_len, q_off, scale=scale,
-                block_x=block_x, block_t=block_t)
-            acc, m, l = _lse_accumulate((acc, m, l), part)
-            if r != n - 1:
-                kb = jax.lax.ppermute(kb, axis, perm)
-                vb = jax.lax.ppermute(vb, axis, perm)
+        acc, m, l = _ring_loop(q_loc, k_loc, v_loc, n=n, axis=axis,
+                               s_loc=s_loc, causal=causal, scale=scale,
+                               block_x=block_x, block_t=block_t)
         out = acc / jnp.maximum(l, 1e-30)[..., None]
         return out.astype(out_dtype)
 
     return _f(q, k, v)
+
+
+def _ring_loop(q_loc, k_loc, v_loc, *, n, axis, s_loc, causal, scale,
+               block_x, block_t):
+    """The shared per-chip ring of flash partials (used by inference
+    AND the training forward): returns the raw (acc, m, l) stats."""
+    me = jax.lax.axis_index(axis)
+    B, _, Hq, d = q_loc.shape
+    rows = (B, s_loc, Hq)
+    acc = jnp.zeros(rows + (d,), jnp.float32)
+    m = jnp.full(rows, -1e30, jnp.float32)
+    l = jnp.zeros(rows, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    kb, vb = k_loc, v_loc
+    for r in range(n):
+        src = jax.lax.rem(me - r + n, jnp.int32(n))
+        if causal:
+            # future blocks: kv_len=0 — the kernel still launches
+            # (uniform across devices, required by the interpreter's
+            # lockstep and cheap on hardware) but its pl.when gate
+            # skips every tile, so the causal half costs no FLOPs
+            # (the reference skips by rank order the same way,
+            # sp_ag_attention_intra_node.py:257).
+            local_len = jnp.where(src <= me, s_loc, 0).astype(jnp.int32)
+            q_off = (me - src) * s_loc
+        else:
+            local_len = jnp.int32(s_loc)
+            q_off = jnp.int32(s_loc - 1)
+        part = flash_decode_partial(
+            q_loc, kb, vb, local_len, q_off, scale=scale,
+            block_x=block_x, block_t=block_t)
+        acc, m, l = _lse_accumulate((acc, m, l), part)
+        if r != n - 1:
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+    return acc, m, l
+
+
+def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
+                            scale: Optional[float] = None,
+                            block_x: int = 64, block_t: int = 256):
+    """Differentiable causal ring attention (context-parallel TRAINING;
+    the reference's SP mechanisms are inference-only — this goes
+    beyond). Same contract as sp_ring_attention(mode="ring").
+
+    Forward: the ring loop of flash partials, additionally saving the
+    global LSE. Backward: a second ring in which (k, v, dk, dv) rotate
+    together — each chip folds its queries' contribution into the
+    passing block with the per-pair Pallas backward kernels
+    (flash_attn_train._flash_bwd_call, traced valid_len/q_off so future
+    pairs cost one skipped launch); after n rotations every dk/dv block
+    arrives home with all chips' contributions, and dq never leaves."""
+    from triton_dist_tpu.kernels.flash_attn_train import (_flash_bwd_call,
+                                                          _fold_q,
+                                                          _unfold_q)
+    n = mesh.shape[axis]
+    B, S, Hq, d = q.shape
+    Hkv = k.shape[1]
+    rep = Hq // Hkv
+    s_loc = S // n
+    assert S % n == 0, (S, n)
+    if scale is None:
+        scale = d ** -0.5
+    scale = float(scale)
+    q_spec = P(None, axis, None, None)
+    kv_spec = P(None, None, axis, None)
+    lse_spec = P(None, axis, None)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.custom_vjp
+    def op(q, k, v):
+        o, _ = _fwd_pair(q, k, v)
+        return o
+
+    def _fwd_pair(q, k, v):
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(q_spec, kv_spec, kv_spec),
+                           out_specs=(q_spec, lse_spec),
+                           check_vma=False)
+        def _f(q_loc, k_loc, v_loc):
+            acc, m, l = _ring_loop(q_loc, k_loc, v_loc, n=n, axis=axis,
+                                   s_loc=s_loc, causal=True, scale=scale,
+                                   block_x=block_x, block_t=block_t)
+            l_safe = jnp.maximum(l, 1e-30)
+            out = (acc / l_safe[..., None]).astype(q_loc.dtype)
+            return out, m + jnp.log(l_safe)
+
+        return _f(q, k, v)
+
+    def fwd(q, k, v):
+        o, lse = _fwd_pair(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        q, k, v, o, lse = res
+
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=(q_spec, kv_spec, kv_spec, q_spec,
+                                     lse_spec, q_spec),
+                           out_specs=(q_spec, kv_spec, kv_spec),
+                           check_vma=False)
+        def _b(q_loc, k_loc, v_loc, o_loc, lse_loc, do_loc):
+            me = jax.lax.axis_index(axis)
+            f32 = jnp.float32
+            X = B * Hkv
+            qx = _fold_q(q_loc.astype(f32), B, s_loc, Hkv, rep, d)
+            dox = _fold_q(do_loc.astype(f32), B, s_loc, Hkv, rep, d)
+            ox = _fold_q(o_loc.astype(f32), B, s_loc, Hkv, rep, d)
+            # fold [B, s_loc, Hq] rows the same way via a trailing dim
+            lse_f = _fold_q(lse_loc[..., None].astype(f32), B, s_loc,
+                            Hkv, rep, 1)[..., 0]
+            dvec = jnp.sum(dox * ox, axis=-1)            # [X, R]
+            kb = k_loc.reshape(X, s_loc, d).astype(f32)
+            vb = v_loc.reshape(X, s_loc, d).astype(f32)
+            dkb = jnp.zeros_like(kb)
+            dvb = jnp.zeros_like(vb)
+            dq = jnp.zeros_like(qx)
+            for r in range(n):
+                src = jax.lax.rem(me - r + n, jnp.int32(n))
+                valid = jnp.where(src <= me, s_loc, 0).astype(jnp.int32)
+                q_off = (me - src) * s_loc
+                dq_p, dk_p, dv_p = _flash_bwd_call(
+                    qx, kb, vb, dox, lse_f, dvec, valid, q_off,
+                    scale=scale, rep=rep, block_r=block_t,
+                    block_t=block_t)
+                dq = dq + dq_p
+                dkb = dkb + dk_p
+                dvb = dvb + dv_p
+                # the grads travel WITH their block; after n rotations
+                # each dk/dv block is home with every chip's term (the
+                # k/v blocks themselves are dead after the last step)
+                if r != n - 1:
+                    kb = jax.lax.ppermute(kb, axis, perm)
+                    vb = jax.lax.ppermute(vb, axis, perm)
+                dkb = jax.lax.ppermute(dkb, axis, perm)
+                dvb = jax.lax.ppermute(dvb, axis, perm)
+            dq_out = _unfold_q(dq, B, s_loc, Hkv, rep, d)
+            return (dq_out.astype(q_loc.dtype),
+                    dkb.reshape(B, Hkv, s_loc, d).astype(k_loc.dtype),
+                    dvb.reshape(B, Hkv, s_loc, d).astype(v_loc.dtype))
+
+        return _b(q, k, v, o, lse, do)
+
+    op.defvjp(fwd, bwd)
+    return op(q, k, v)
 
 
 def sp_ring_attention_ref(q, k, v, *, scale: Optional[float] = None,

@@ -76,16 +76,22 @@ class SPAttn:
             mesh=mesh, axis=axis, n_heads=n_heads,
             n_kv_heads=n_kv_heads, head_dim=head_dim)
 
-    def _split_qkv(self, qkv, B, S):
-        hq, hkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+    @staticmethod
+    def _split_norm(qkv, B, S, hq, hkv, hd, q_norm, k_norm):
+        """Shared QKV unpack + QK-norm (norms as explicit ARGS so the
+        training path's cotangents come back psum-replicated)."""
         q = qkv[..., :hq * hd].reshape(B, S, hq, hd)
         k = qkv[..., hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
         v = qkv[..., (hq + hkv) * hd:].reshape(B, S, hkv, hd)
-        if self.q_norm is not None:
-            q = rms_norm(q, self.q_norm)
-        if self.k_norm is not None:
-            k = rms_norm(k, self.k_norm)
+        if q_norm is not None:
+            q = rms_norm(q, q_norm)
+        if k_norm is not None:
+            k = rms_norm(k, k_norm)
         return q, k, v
+
+    def _split_qkv(self, qkv, B, S):
+        return self._split_norm(qkv, B, S, self.n_heads, self.n_kv_heads,
+                                self.head_dim, self.q_norm, self.k_norm)
 
     def alloc_cache(self, B: int, T: int, dtype=jnp.bfloat16):
         """Sequence-sharded KV cache: [B, Hkv, T, d], T over `axis`
@@ -133,6 +139,49 @@ class SPAttn:
         out = out.reshape(B, S, self.n_heads * self.head_dim)
         o = _local_proj(out, self.w_o, self.mesh, axis)
         return o, cache_k, cache_v, jnp.int32(S)
+
+    def fwd_train(self, x, cos, sin):
+        """Differentiable context-parallel attention (training, no
+        cache): local QKV GEMM + RoPE -> causal ring attention with the
+        custom-VJP ring backward (kernels/sp_attention.py::
+        sp_ring_attention_train — (k, v, dk, dv) rotate together) ->
+        local O projection. x: [B, S, D] sequence-sharded -> same.
+        The reference's SP mechanisms are inference-only; this extends
+        them to training."""
+        from triton_dist_tpu.kernels.sp_attention import (
+            sp_ring_attention_train)
+        B, S, D = x.shape
+        n = self.mesh.shape[self.axis]
+        s_loc = S // n
+        axis = self.axis
+        hq, hkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        norms = [a for a in (self.q_norm, self.k_norm) if a is not None]
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, axis, None), P(None, None), P(None, None),
+                      P(None, None)) + (P(None),) * len(norms),
+            out_specs=(P(None, axis, None, None),
+                       P(None, None, axis, None),
+                       P(None, None, axis, None)),
+            check_vma=False)
+        def project(x_loc, w, cos, sin, *norms):
+            ni = iter(norms)
+            me = jax.lax.axis_index(axis)
+            qn = next(ni) if self.q_norm is not None else None
+            kn = next(ni) if self.k_norm is not None else None
+            q, k, v = self._split_norm(x_loc @ w, B, s_loc, hq, hkv, hd,
+                                       qn, kn)
+            pos = me * s_loc + jnp.arange(s_loc)
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            return (q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+
+        q, k_s, v_s = project(x, self.w_qkv, cos, sin, *norms)
+        out = sp_ring_attention_train(q, k_s, v_s, mesh=self.mesh,
+                                      axis=axis)
+        out = out.reshape(B, S, hq * hd)
+        return _local_proj(out, self.w_o, self.mesh, axis)
 
     def decode(self, x, cos, sin, cache_k, cache_v, kv_len, *,
                combine="dist"):
