@@ -101,6 +101,9 @@ def _prefill_fn(model, ids, cache, *, mode):
 
 
 def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):
+    # NOTE: the logits carry is deliberate — a tok-only carry measured
+    # ~3% SLOWER on-chip (XLA schedules the argmax off the critical
+    # path this way)
     def step(carry, _):
         logits, cache = carry
         tok = jnp.argmax(logits, axis=-1)           # greedy [B]
@@ -187,8 +190,7 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
         out_specs=(P(), P(), P()), check_vma=False)
 
     def step(carry, _):
-        logits, pos, ks, vs = carry
-        tok = jnp.argmax(logits, axis=-1)           # greedy [B]
+        tok, pos, ks, vs = carry
         x = model.embed[tok].astype(jnp.float32)    # [B, D]
         crow = model.cos[pos][None]
         srow = model.sin[pos][None]
@@ -202,8 +204,10 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
                       cfg.rms_norm_eps)
         logits = jnp.dot(xf.astype(model.lm_head.dtype), model.lm_head,
                          preferred_element_type=jnp.float32)
-        return (logits, pos + 1, tuple(new_ks), tuple(new_vs)), tok
+        return (jnp.argmax(logits, axis=-1), pos + 1,
+                tuple(new_ks), tuple(new_vs)), tok
 
-    (logits, _, ks, vs), toks = jax.lax.scan(
-        step, (logits0, cache.offset, ks, vs), None, length=gen_len)
-    return toks.T, logits, None                      # [B, gen_len]
+    (tok, _, ks, vs), toks = jax.lax.scan(
+        step, (jnp.argmax(logits0, axis=-1), cache.offset, ks, vs),
+        None, length=gen_len)
+    return toks.T, tok, None                         # [B, gen_len]
