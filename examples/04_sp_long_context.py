@@ -58,6 +58,17 @@ def main():
                           n_kv_heads=Hkv, head_dim=hd)
     out_u = jax.jit(lambda x: ul.prefill(x, cos, sin, mode="fused"))(xs)
     print("ulysses fused prefill out:", out_u.shape)
+
+    # --- context-parallel TRAINING: gradients through the ring
+    # (sp_ring_attention_train custom VJP: (k, v, dk, dv) rotate
+    # together in the backward) — beyond the reference's inference-only SP
+    def loss(l, x):
+        return jnp.sum(l.fwd_train(x, cos, sin).astype(jnp.float32) ** 2)
+
+    lval, grads = jax.jit(jax.value_and_grad(loss))(sp, xs)
+    jax.block_until_ready(lval)
+    print("ring-attention train loss:", float(lval),
+          "| dw_qkv norm:", float(jnp.linalg.norm(grads.w_qkv)))
     print("OK")
 
 
