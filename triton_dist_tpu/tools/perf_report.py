@@ -186,6 +186,51 @@ def run_report(write_json=None):
         lambda u: flash_decode(u, k, v, jnp.int32(T)), q,
         kv_bytes / (spec.hbm_gbps * 1e9) * 1e6)
 
+    # MoE ring kernels (resident-B path at these sizes)
+    from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
+    from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
+    E, capT, Dm, Nm = (8, 512, 1024, 1024) if on_tpu else (2, 8 * n, 64,
+                                                           64 * n)
+    xe = jax.device_put(jnp.asarray(rng.randn(E, capT, Dm), dt) * 0.1,
+                        NamedSharding(mesh, P(None, "tp", None)))
+    we = jax.device_put(jnp.asarray(rng.randn(E, Dm, Nm), dt) * 0.1,
+                        NamedSharding(mesh, P(None, None, "tp")))
+    add("ag_group_gemm",
+        lambda v: ag_group_gemm(v[:, :, :Dm], we, mesh=mesh)[:, :, :Dm],
+        xe,
+        gemm_sol_us(E * capT, Dm, Nm // n, itemsize=isz, spec=spec)
+        + collective_sol_us("ag", E * capT * Dm * isz, n, spec=spec))
+    he = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
+                        NamedSharding(mesh, P(None, None, "tp")))
+    w2 = jax.device_put(jnp.asarray(rng.randn(E, Nm, Dm), dt) * 0.1,
+                        NamedSharding(mesh, P(None, "tp", None)))
+    add("moe_reduce_rs",
+        lambda v: jnp.concatenate([moe_reduce_rs(v, w2, mesh=mesh)] * (
+            Nm // Dm), axis=2) if Nm != Dm else moe_reduce_rs(
+                v, w2, mesh=mesh),
+        he,
+        gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
+        + collective_sol_us("rs", E * capT * Dm * isz, n, spec=spec))
+
+    # GDN chunkwise UT transform (roofline: qkv/g/beta/o traffic vs the
+    # chunk matmul FLOPs)
+    from triton_dist_tpu.kernels.gdn import gdn_fwd
+    Bg, Hg, Tg, dk_, dv_ = (8, 16, 2048, 128, 128) if on_tpu else \
+                           (2, 2, 256, 32, 32)
+    C = 64
+    qg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
+    kg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
+    vg = jnp.asarray(rng.randn(Bg, Hg, Tg, dv_), dt) * 0.3
+    gg = jnp.asarray(-np.abs(rng.rand(Bg, Hg, Tg)) * 0.1, jnp.float32)
+    bg = jnp.asarray(rng.rand(Bg, Hg, Tg), jnp.float32)
+    gdn_bytes = Bg * Hg * Tg * (2 * dk_ + 2 * dv_) * isz
+    gdn_flops = 2 * Bg * Hg * Tg * (2 * C * dk_ + 2 * C * dv_
+                                    + 2 * dk_ * dv_)
+    gdn_sol = max(gdn_bytes / (spec.hbm_gbps * 1e9),
+                  gdn_flops / (spec.bf16_tflops * 1e12)) * 1e6
+    add("gdn_fwd(ut)",
+        lambda u: gdn_fwd(u, kg, vg, gg, bg, chunk=C)[0], qg, gdn_sol)
+
     header = {"backend": jax.default_backend(), "ndev": ndev,
               "chip": spec.name, "interpreted": not on_tpu}
     out = {"env": header, "ops": rows}
