@@ -1,0 +1,124 @@
+"""Standalone drivers for the ring-attention training differential
+cases. Run as a SUBPROCESS by test_sp_attention/test_sp_layers (via
+tests/_isolation.py): the ring backward is the heaviest interpreted
+program in the suite (per-pair Pallas backward kernels x 2n ring steps
+under grad), and the upstream TPU-interpret substrate very occasionally
+aborts the whole process under starvation — isolation + one retry keeps
+that flake from killing the suite. Not collected by pytest (no test_
+prefix)."""
+
+import sys
+
+
+def case_kernel():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.kernels.sp_attention import (
+        sp_ring_attention_ref, sp_ring_attention_train)
+
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("sp",))
+    B, Hq, Hkv, S, d = 1, 2 * n, n, 8 * n, 32
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32) * 0.5
+    k = jnp.asarray(rng.randn(B, Hkv, S, d), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(B, Hkv, S, d), jnp.float32) * 0.5
+    ct = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32)
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, "sp", None, None)))
+    ks = jax.device_put(k, NamedSharding(mesh, P(None, None, "sp", None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P(None, None, "sp", None)))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * ct)
+
+    with jax.default_matmul_precision("highest"):
+        out = jax.jit(lambda q, k, v: sp_ring_attention_train(
+            q, k, v, mesh=mesh))(qs, ks, vs)
+        jax.block_until_ready(out)
+        g = jax.jit(jax.grad(loss(
+            lambda q, k, v: sp_ring_attention_train(q, k, v, mesh=mesh)),
+            argnums=(0, 1, 2)))(qs, ks, vs)
+        jax.block_until_ready(g)
+        ref = sp_ring_attention_ref(q, k, v, causal=True)
+        gr = jax.grad(loss(
+            lambda q, k, v: sp_ring_attention_ref(q, k, v, causal=True)),
+            argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=1e-5)
+    for name, a, b in zip("qkv", g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def case_layer():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.kernels.sp_attention import sp_ring_attention_ref
+    from triton_dist_tpu.layers.common import (apply_rope, precompute_rope,
+                                               rms_norm)
+    from triton_dist_tpu.layers.sp_attn import SPAttn
+
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("sp",))
+    B, D, hd = 1, 64, 32
+    Hq, Hkv = 2 * n, n
+    S = 8 * n
+    rng = np.random.RandomState(13)
+    sc = 0.5 / np.sqrt(D)
+    wq = rng.randn(D, Hq * hd) * sc
+    wk = rng.randn(D, Hkv * hd) * sc
+    wv = rng.randn(D, Hkv * hd) * sc
+    wo = rng.randn(Hq * hd, D) * sc
+    layer = SPAttn.init(wq, wk, wv, wo, mesh=mesh, n_heads=Hq,
+                        n_kv_heads=Hkv, head_dim=hd,
+                        q_norm=np.ones(hd, np.float32),
+                        k_norm=np.ones(hd, np.float32))
+    cos, sin = precompute_rope(hd, S)
+    rng2 = np.random.RandomState(17)
+    x = jnp.asarray(rng2.randn(B, S, D), jnp.float32) * 0.3
+    ct = jnp.asarray(rng2.randn(B, S, D), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "sp", None)))
+
+    def oracle(l, x):
+        qkv = x @ l.w_qkv
+        q = qkv[..., :Hq * hd].reshape(B, S, Hq, hd)
+        k = qkv[..., Hq * hd:(Hq + Hkv) * hd].reshape(B, S, Hkv, hd)
+        v = qkv[..., (Hq + Hkv) * hd:].reshape(B, S, Hkv, hd)
+        q = rms_norm(q, l.q_norm)
+        k = rms_norm(k, l.k_norm)
+        pos = jnp.arange(S)
+        q = apply_rope(q, cos, sin, pos)
+        k = apply_rope(k, cos, sin, pos)
+        o = sp_ring_attention_ref(q, k.transpose(0, 2, 1, 3),
+                                  v.transpose(0, 2, 1, 3), causal=True)
+        return o.reshape(B, S, Hq * hd) @ l.w_o
+
+    def loss(fwd):
+        return lambda l, x: jnp.sum(fwd(l, x).astype(jnp.float32) * ct)
+
+    with jax.default_matmul_precision("highest"):
+        lt, gt = jax.jit(jax.value_and_grad(
+            loss(lambda l, x: l.fwd_train(x, cos, sin)),
+            argnums=(0, 1)))(layer, xs)
+        jax.block_until_ready((lt, gt))
+        xr = jax.device_put(x, NamedSharding(mesh, P(None, None, None)))
+        lx, gx = jax.jit(jax.value_and_grad(loss(oracle),
+                                            argnums=(0, 1)))(layer, xr)
+    np.testing.assert_allclose(float(lt), float(lx), rtol=1e-5)
+    for name in ("w_qkv", "w_o", "q_norm", "k_norm"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(gt[0], name)),
+            np.asarray(getattr(gx[0], name)),
+            atol=5e-4, rtol=5e-4, err_msg=name)
+    np.testing.assert_allclose(np.asarray(gt[1]), np.asarray(gx[1]),
+                               atol=5e-4, rtol=5e-4, err_msg="dx")
+
+
+if __name__ == "__main__":
+    {"kernel": case_kernel, "layer": case_layer}[sys.argv[1]]()
+    print("CASE_OK")

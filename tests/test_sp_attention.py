@@ -96,38 +96,9 @@ def test_gemm_all_to_all_vs_xla():
 def test_sp_ring_attention_train_grads_vs_oracle():
     """Context-parallel TRAINING: value and q/k/v gradients of the ring
     custom-VJP (per-pair Pallas backward kernels riding a reverse ring
-    of (k, v, dk, dv)) vs jax.grad of the full-tensor oracle."""
-    from triton_dist_tpu.kernels.sp_attention import sp_ring_attention_train
-
-    n = mesh.shape["sp"]
-    B, Hq, Hkv, S, d = 1, 2 * n, n, 8 * n, 32
-    rng = np.random.RandomState(3)
-    q = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32) * 0.5
-    k = jnp.asarray(rng.randn(B, Hkv, S, d), jnp.float32) * 0.5
-    v = jnp.asarray(rng.randn(B, Hkv, S, d), jnp.float32) * 0.5
-    ct = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32)
-    qs = _shard(q, P(None, "sp", None, None))
-    ks = _shard(k, P(None, None, "sp", None))
-    vs = _shard(v, P(None, None, "sp", None))
-
-    def loss(fn):
-        return lambda q, k, v: jnp.sum(fn(q, k, v) * ct)
-
-    with jax.default_matmul_precision("highest"):
-        out = jax.jit(lambda q, k, v: sp_ring_attention_train(
-            q, k, v, mesh=mesh))(qs, ks, vs)
-        jax.block_until_ready(out)
-        g = jax.jit(jax.grad(loss(
-            lambda q, k, v: sp_ring_attention_train(q, k, v, mesh=mesh)),
-            argnums=(0, 1, 2)))(qs, ks, vs)
-        jax.block_until_ready(g)
-        ref = sp_ring_attention_ref(q, k, v, causal=True)
-        gr = jax.grad(loss(
-            lambda q, k, v: sp_ring_attention_ref(q, k, v, causal=True)),
-            argnums=(0, 1, 2))(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               atol=5e-5, rtol=1e-5)
-    for name, a, b in zip("qkv", g, gr):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   atol=5e-4, rtol=1e-4,
-                                   err_msg=f"d{name}")
+    of (k, v, dk, dv)) vs jax.grad of the full-tensor oracle. Runs in
+    an isolated subprocess (tests/_ring_train_cases.py): the heaviest
+    interpreted program in the suite, isolated against the substrate's
+    rare host-starvation abort."""
+    from _isolation import run_isolated
+    run_isolated("_ring_train_cases.py", "kernel")

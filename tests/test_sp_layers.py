@@ -153,56 +153,6 @@ def test_ulysses_train_grads_vs_oracle():
 def test_sp_attn_ring_train_grads_vs_oracle():
     """Context-parallel training through SPAttn: weight and input
     gradients of fwd_train (ring custom VJP) vs the replicated jnp
-    oracle of the same math."""
-    from triton_dist_tpu.kernels.sp_attention import sp_ring_attention_ref
-    from triton_dist_tpu.layers.common import apply_rope, rms_norm
-
-    n = mesh.shape["sp"]
-    B, D, hd = 1, 64, 32
-    Hq, Hkv = 2 * n, n
-    S = 8 * n
-    wq, wk, wv, wo = _weights(D, Hq, Hkv, hd, seed=13)
-    layer = SPAttn.init(wq, wk, wv, wo, mesh=mesh, n_heads=Hq,
-                        n_kv_heads=Hkv, head_dim=hd,
-                        q_norm=np.ones(hd, np.float32),
-                        k_norm=np.ones(hd, np.float32))
-    cos, sin = precompute_rope(hd, S)
-    rng = np.random.RandomState(17)
-    x = jnp.asarray(rng.randn(B, S, D), jnp.float32) * 0.3
-    ct = jnp.asarray(rng.randn(B, S, D), jnp.float32)
-    xs = jax.device_put(x, NamedSharding(mesh, P(None, "sp", None)))
-
-    def oracle(l, x):
-        hq, hkv = Hq, Hkv
-        qkv = x @ l.w_qkv
-        q = qkv[..., :hq * hd].reshape(B, S, hq, hd)
-        k = qkv[..., hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
-        v = qkv[..., (hq + hkv) * hd:].reshape(B, S, hkv, hd)
-        q = rms_norm(q, l.q_norm)
-        k = rms_norm(k, l.k_norm)
-        pos = jnp.arange(S)
-        q = apply_rope(q, cos, sin, pos)
-        k = apply_rope(k, cos, sin, pos)
-        o = sp_ring_attention_ref(q, k.transpose(0, 2, 1, 3),
-                                  v.transpose(0, 2, 1, 3), causal=True)
-        return o.reshape(B, S, hq * hd) @ l.w_o
-
-    def loss(fwd):
-        return lambda l, x: jnp.sum(fwd(l, x).astype(jnp.float32) * ct)
-
-    with jax.default_matmul_precision("highest"):
-        lt, gt = jax.jit(jax.value_and_grad(
-            loss(lambda l, x: l.fwd_train(x, cos, sin)),
-            argnums=(0, 1)))(layer, xs)
-        jax.block_until_ready((lt, gt))
-        xr = jax.device_put(x, NamedSharding(mesh, P(None, None, None)))
-        lx, gx = jax.jit(jax.value_and_grad(loss(oracle),
-                                            argnums=(0, 1)))(layer, xr)
-    np.testing.assert_allclose(float(lt), float(lx), rtol=1e-5)
-    for name in ("w_qkv", "w_o", "q_norm", "k_norm"):
-        np.testing.assert_allclose(
-            np.asarray(getattr(gt[0], name)),
-            np.asarray(getattr(gx[0], name)),
-            atol=5e-4, rtol=5e-4, err_msg=name)
-    np.testing.assert_allclose(np.asarray(gt[1]), np.asarray(gx[1]),
-                               atol=5e-4, rtol=5e-4, err_msg="dx")
+    oracle. Subprocess-isolated (see test_sp_attention's twin)."""
+    from _isolation import run_isolated
+    run_isolated("_ring_train_cases.py", "layer")
