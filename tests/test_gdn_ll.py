@@ -30,6 +30,32 @@ def test_gdn_fwd_vs_recurrent_oracle(B, H, T, dk, dv, chunk, mode):
     np.testing.assert_allclose(np.asarray(S), rS, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("B,H,T", [
+    (1, 2, 128),    # X=2
+    (2, 8, 200),    # X=16, ragged T (pad path), BH=16
+])
+def test_gdn_pallas_vs_oracle(B, H, T):
+    """The Pallas kernel (VMEM-resident state, MXU doubling solve) vs
+    the recurrent oracle; dk/dv=128 (the kernel's tile-aligned regime;
+    other widths fall back to mode='ut', covered above)."""
+    dk = dv = 128
+    rng = np.random.RandomState(T)
+    kn = rng.randn(B, H, T, dk)
+    kn /= np.linalg.norm(kn, axis=-1, keepdims=True)
+    q = jnp.asarray(rng.randn(B, H, T, dk), jnp.float32) * 0.3
+    k = jnp.asarray(kn, jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, dv), jnp.float32) * 0.3
+    g = jnp.asarray(-np.abs(rng.rand(B, H, T)) * 0.1, jnp.float32)
+    beta = jnp.asarray(rng.rand(B, H, T), jnp.float32)
+    S0 = jnp.asarray(rng.randn(B, H, dk, dv), jnp.float32) * 0.05
+    with jax.default_matmul_precision("highest"):
+        o, S = jax.jit(lambda *a: gdn_fwd(*a, S0=S0, chunk=64,
+                                          mode="pallas"))(q, k, v, g, beta)
+    ro, rS = gdn_fwd_ref(q, k, v, g, beta, S0=S0)
+    np.testing.assert_allclose(np.asarray(o), ro, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(S), rS, atol=2e-4, rtol=2e-4)
+
+
 def test_gdn_state_carry():
     """Chunk-carried state == one long pass split at a boundary."""
     B, H, T, d = 1, 2, 64, 16

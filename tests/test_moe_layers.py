@@ -114,3 +114,27 @@ def test_tp_moe_fused_vs_xla(ctx8, k):
         out = moe(x, mode="fused")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_tp_moe_fused_ar_vs_xla(ctx8, k):
+    """The decode path (grouped GEMM + fused moe_reduce_ar epilogue)
+    must match the dense oracle; output replicated. Real-devices mode
+    needs lane-aligned per-device dims (the kernel's TPU guard):
+    2I/n and D become 128 there."""
+    import os
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    real = os.environ.get("TDTPU_REAL_DEVICES") == "1"
+    E, D, I = 4, (128 if real else 32), (64 * n if real else 4 * n)
+    M = 4 * n
+    rng = np.random.RandomState(20 + k)
+    router, wg, wu, wd = _make_weights(rng, E, D, I)
+    moe = TP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp", top_k=k,
+                      capacity_factor=float(E))
+    x = jnp.asarray(rng.randn(M, D), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = moe.fwd_xla(x)
+        out = moe(x, mode="fused_ar")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -102,3 +102,22 @@ def test_sp_ring_attention_train_grads_vs_oracle():
     rare host-starvation abort."""
     from _isolation import run_isolated
     run_isolated("_ring_train_cases.py", "kernel")
+
+
+def test_o_a2a_gemm_vs_xla():
+    """Fused combine-a2a + O-proj (reference
+    sp_ulysess_o_all2all_gemm.py:147) vs the plain matmul oracle:
+    head-sharded input, sequence-sharded output."""
+    from triton_dist_tpu.kernels.sp_attention import o_a2a_gemm
+    n = mesh.shape["sp"]
+    B, S, Nc, D = 2, 8 * n, 128, 128
+    N = Nc * n
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(B, S, N), jnp.float32) * 0.3
+    w = jnp.asarray(rng.randn(N, D), jnp.float32) * 0.3
+    x_s = _shard(x, P(None, None, "sp"))
+    with jax.default_matmul_precision("highest"):
+        out = jax.jit(lambda a, b: o_a2a_gemm(a, b, mesh=mesh))(x_s, w)
+        ref = x.reshape(B * S, N) @ w
+    np.testing.assert_allclose(np.asarray(out).reshape(B * S, D),
+                               np.asarray(ref), atol=1e-4, rtol=1e-5)

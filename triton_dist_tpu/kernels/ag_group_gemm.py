@@ -46,33 +46,59 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                           resident_b: bool,
                           x_ref, w_ref, ag_ref, o_ref,
                           a_vmem, b_vmem, o_vmem,
-                          copy_sem, send_sem, o_sem, b_sem, recv_sems):
+                          copy_sem, a_sem, b_sems, o_sems, send_sem,
+                          recv_sems):
     """Ring AG of capacity chunks + per-expert GEMM consumption.
     x_ref: [E, c_loc, D]; w_ref: [E, D, n_loc]; ag_ref: [E, capT, D];
     o_ref: [E, capT, n_loc].
 
     resident_b: all experts' panels fit VMEM (b_vmem is [E, D, n_loc]):
     load B exactly once before the ring loop instead of once per ring
-    step per tile (n x the B bandwidth otherwise)."""
+    step per tile (n x the B bandwidth otherwise).
+
+    Software-pipelined over the flattened (step, expert, tile) space:
+    expert chunks and (non-resident) B tiles double-buffer under the
+    dots, and output tiles stage through two slots waited two tiles
+    later — the MXU never idles on a same-iteration DMA."""
     me = dl.my_pe(axis)
     _, c_loc, D = x_ref.shape
     n_loc = w_ref.shape[2]
     nt = 1 if resident_b else pl.cdiv(n_loc, block_n)
+    bn = n_loc if resident_b else block_n
+    EQ = E * nt
+    G = n * EQ
+
+    def src_of(s):
+        return jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+
+    def b_src(e, j):
+        return w_ref.at[e, :, pl.ds(j * block_n, block_n)]
+
+    def o_dst(g):
+        s, q = divmod(g, EQ)
+        e, j = divmod(q, nt)
+        return o_ref.at[e, pl.ds(src_of(s) * c_loc, c_loc),
+                        pl.ds(j * bn, bn)]
+
+    def a_src(s_idx, e):
+        return ag_ref.at[e, pl.ds(src_of(s_idx) * c_loc, c_loc), :]
 
     # stage own chunk into the gathered buffer
     cp = pltpu.make_async_copy(
         x_ref, ag_ref.at[:, pl.ds(me * c_loc, c_loc), :], copy_sem)
     cp.start()
-    cp.wait()
     if resident_b:
-        cp = pltpu.make_async_copy(w_ref, b_vmem, b_sem)
-        cp.start()
-        cp.wait()
+        pltpu.make_async_copy(w_ref, b_vmem, b_sems.at[0]).start()
+    else:
+        pltpu.make_async_copy(b_src(0, 0), b_vmem.at[0],
+                              b_sems.at[0]).start()
+    cp.wait()
+    pltpu.make_async_copy(a_src(0, 0), a_vmem.at[0], a_sem).start()
     dl.barrier_all(axis)
 
     _, right = dl.ring_neighbors(axis)
     for s in range(n):
-        src = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+        src = src_of(s)
         if s < n - 1:
             # forward the chunk we are about to consume (per-chunk recv
             # semaphores: arrivals may complete out of order)
@@ -80,35 +106,47 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                           ag_ref.at[:, pl.ds(src * c_loc, c_loc), :],
                           send_sem, recv_sems.at[src], right, axis)
         for e in range(E):
-            cp = pltpu.make_async_copy(
-                ag_ref.at[e, pl.ds(src * c_loc, c_loc), :], a_vmem,
-                copy_sem)
-            cp.start()
-            cp.wait()
+            et = s * E + e
+            pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
+                                  a_sem).wait()
+            if e + 1 < E:
+                pltpu.make_async_copy(a_src(s, e + 1),
+                                      a_vmem.at[(et + 1) % 2],
+                                      a_sem).start()
             for j in range(nt):
+                g = et * nt + j
+                if not resident_b and g + 1 < G:
+                    q1 = (g + 1) % EQ
+                    pltpu.make_async_copy(b_src(q1 // nt, q1 % nt),
+                                          b_vmem.at[(g + 1) % 2],
+                                          b_sems.at[(g + 1) % 2]).start()
                 if resident_b:
+                    if g == 0:
+                        pltpu.make_async_copy(w_ref, b_vmem,
+                                              b_sems.at[0]).wait()
                     b_tile = b_vmem[e]
                 else:
-                    cp = pltpu.make_async_copy(
-                        w_ref.at[e, :, pl.ds(j * block_n, block_n)],
-                        b_vmem, b_sem)
-                    cp.start()
-                    cp.wait()
-                    b_tile = b_vmem[...]
-                acc = jnp.dot(a_vmem[...], b_tile,
+                    pltpu.make_async_copy(b_src(e, j), b_vmem.at[g % 2],
+                                          b_sems.at[g % 2]).wait()
+                    b_tile = b_vmem[g % 2]
+                if g >= 2:
+                    pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g - 2),
+                                          o_sems.at[g % 2]).wait()
+                acc = jnp.dot(a_vmem[et % 2], b_tile,
                               preferred_element_type=jnp.float32)
-                o_vmem[...] = acc.astype(o_vmem.dtype)
-                cp = pltpu.make_async_copy(
-                    o_vmem,
-                    o_ref.at[e, pl.ds(src * c_loc, c_loc),
-                             pl.ds(j * block_n,
-                                   n_loc if resident_b else block_n)],
-                    o_sem)
-                cp.start()
-                cp.wait()
+                o_vmem[g % 2] = acc.astype(o_ref.dtype)
+                pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
+                                      o_sems.at[g % 2]).start()
         if s < n - 1:
             nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
             pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
+            # next step's first expert chunk: start now, wait at its dot
+            pltpu.make_async_copy(a_src(s + 1, 0),
+                                  a_vmem.at[((s + 1) * E) % 2],
+                                  a_sem).start()
+    for g in range(max(G - 2, 0), G):
+        pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
+                              o_sems.at[g % 2]).wait()
     dl.quiet(send_sem, x_ref, n - 1)
 
 
@@ -159,13 +197,14 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
             scratch_shapes=[
-                pltpu.VMEM((c_loc, D), x_loc.dtype),
-                pltpu.VMEM((E, D, n_loc) if resident else (D, bn),
+                pltpu.VMEM((2, c_loc, D), x_loc.dtype),
+                pltpu.VMEM((E, D, n_loc) if resident else (2, D, bn),
                            w_loc.dtype),
-                pltpu.VMEM((c_loc, bn), x_loc.dtype),
+                pltpu.VMEM((2, c_loc, bn), x_loc.dtype),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA((n,)),
             ],

@@ -93,28 +93,51 @@ def create_ag_gemm_context(mesh: Mesh, axis: str = "tp", *,
 def _ag_gemm_kernel(n: int, axis: str, block_n: int,
                     a_ref, b_ref, ag_ref, o_ref,
                     a_vmem, b_vmem, o_vmem,
-                    copy_sem, b_sem, o_sem, send_sem, recv_sems):
+                    copy_sem, a_sem, b_sems, o_sems, send_sem, recv_sems):
     """Fused ring-AG + GEMM (consumer analog: kernel_consumer_gemm_persistent,
     allgather_gemm.py:199; producer analog: cp_engine_producer_all_gather,
-    allgather.py:202 — both folded into one kernel here)."""
+    allgather.py:202 — both folded into one kernel here).
+
+    Software pipeline (the TPU analog of the reference's persistent
+    consumer keeping the tensor cores saturated, allgather_gemm.py:199):
+    every DMA is started ahead of its use and waited at the last moment,
+    so HBM traffic rides under the MXU instead of alternating with it —
+      * B tiles double-buffer across the flattened (ring step, tile)
+        iteration space (tile t+1 streams into slot (t+1)%2 while tile t
+        multiplies; tile index wraps so the prefetch crosses step
+        boundaries);
+      * output tiles stage through two slots whose writeback is waited
+        two tiles later, never on the critical path;
+      * the ring chunk for step s+1 is copied into the alternate A
+        buffer as soon as its recv semaphore fires, and waited only
+        before step s+1's first dot.
+    """
     me = dl.my_pe(axis)
     m_loc, K = a_ref.shape
     n_loc = b_ref.shape[1]
     nt = cdiv(n_loc, block_n)
+    resident = nt == 1
+    nsteps = n * nt
 
-    # Stage the local shard: into the gathered output and into VMEM slot 0.
+    def b_src(j):
+        return b_ref if resident else b_ref.at[:, pl.ds(j * block_n,
+                                                        block_n)]
+
+    def o_dst(t):
+        s, j = divmod(t, nt)
+        src_s = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+        return o_ref.at[pl.ds(src_s * m_loc, m_loc),
+                        pl.ds(j * block_n, block_n)]
+
+    # Stage the local shard: into the gathered output and into VMEM
+    # slot 0; kick the first B tile load alongside.
     cp_ag = pltpu.make_async_copy(
         a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
     cp_ag.start()
-    cp_a = pltpu.make_async_copy(a_ref, a_vmem.at[0], copy_sem)
+    cp_a = pltpu.make_async_copy(a_ref, a_vmem.at[0], a_sem)
     cp_a.start()
-    if nt == 1:
-        # B panel fits VMEM: resident for the whole kernel.
-        cp_b = pltpu.make_async_copy(b_ref, b_vmem, b_sem)
-        cp_b.start()
-        cp_b.wait()
+    pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
     cp_ag.wait()
-    cp_a.wait()
     dl.barrier_all(axis)
 
     _, right = dl.ring_neighbors(axis)
@@ -122,40 +145,48 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
         cur, nxt = s % 2, (s + 1) % 2
         src = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
         if s < n - 1:
-            # Producer: forward the chunk we just computed-from to the
-            # right neighbor while the MXU works (the overlap). One recv
-            # semaphore per chunk: arrivals may complete out of order, so
-            # a shared semaphore could unblock on the wrong chunk (same
-            # role as the reference's per-rank barrier flags).
+            # Producer: forward the chunk we are about to compute-from to
+            # the right neighbor while the MXU works (the overlap). One
+            # recv semaphore per chunk: arrivals may complete out of
+            # order, so a shared semaphore could unblock on the wrong
+            # chunk (same role as the reference's per-rank barrier flags).
             dl.putmem_nbi(ag_ref.at[pl.ds(src * m_loc, m_loc)],
                           ag_ref.at[pl.ds(src * m_loc, m_loc)],
                           send_sem, recv_sems.at[src], right, axis)
+        # this step's A chunk (started at the end of step s-1 / prologue)
+        pltpu.make_async_copy(ag_ref.at[pl.ds(src * m_loc, m_loc)],
+                              a_vmem.at[cur], a_sem).wait()
         for j in range(nt):
-            if nt > 1:
-                cp_b = pltpu.make_async_copy(
-                    b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem, b_sem)
-                cp_b.start()
-                cp_b.wait()
-            acc = jnp.dot(a_vmem[cur], b_vmem[...],
+            t = s * nt + j
+            slot = 0 if resident else t % 2
+            if not resident and t + 1 < nsteps:
+                pltpu.make_async_copy(b_src((j + 1) % nt),
+                                      b_vmem.at[(t + 1) % 2],
+                                      b_sems.at[(t + 1) % 2]).start()
+            if not resident or t == 0:
+                pltpu.make_async_copy(b_src(j), b_vmem.at[slot],
+                                      b_sems.at[slot]).wait()
+            if t >= 2:
+                # the writeback issued two tiles ago reuses this slot
+                pltpu.make_async_copy(o_vmem.at[t % 2], o_dst(t - 2),
+                                      o_sems.at[t % 2]).wait()
+            acc = jnp.dot(a_vmem[cur], b_vmem[slot],
                           preferred_element_type=jnp.float32)
-            o_vmem[...] = acc.astype(o_vmem.dtype)
-            cp_o = pltpu.make_async_copy(
-                o_vmem,
-                o_ref.at[pl.ds(src * m_loc, m_loc),
-                         pl.ds(j * block_n, block_n)],
-                o_sem)
-            cp_o.start()
-            cp_o.wait()
+            o_vmem[t % 2] = acc.astype(o_ref.dtype)
+            pltpu.make_async_copy(o_vmem.at[t % 2], o_dst(t),
+                                  o_sems.at[t % 2]).start()
         if s < n - 1:
             # Consumer wait (analog of dl.wait on the rank barrier,
-            # allgather_gemm.py:209): next chunk landed from the left.
+            # allgather_gemm.py:209): next chunk landed from the left;
+            # start its VMEM stage now, wait at the top of step s+1.
             nxt_src = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
             pltpu.make_async_copy(a_ref, a_ref, recv_sems.at[nxt_src]).wait()
-            cp_a = pltpu.make_async_copy(
+            pltpu.make_async_copy(
                 ag_ref.at[pl.ds(nxt_src * m_loc, m_loc)], a_vmem.at[nxt],
-                copy_sem)
-            cp_a.start()
-            cp_a.wait()
+                a_sem).start()
+    for t in range(max(nsteps - 2, 0), nsteps):
+        pltpu.make_async_copy(o_vmem.at[t % 2], o_dst(t),
+                              o_sems.at[t % 2]).wait()
     dl.quiet(send_sem, a_ref, n - 1)
 
 
@@ -181,11 +212,13 @@ def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext):
                    pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
             pltpu.VMEM((2, m_loc, K), a_shard.dtype),
-            pltpu.VMEM((K, block_n), b_shard.dtype),
-            pltpu.VMEM((m_loc, block_n), a_shard.dtype),
+            pltpu.VMEM((1 if block_n >= n_loc else 2, K, block_n),
+                       b_shard.dtype),
+            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((n,)),
         ],

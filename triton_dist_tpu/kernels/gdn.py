@@ -9,8 +9,13 @@ recurrence per head (state S [dk, dv]):
     o_t = S_t^T q_t
 
 The reference parallelizes within chunks via Triton's UT transform;
-``gdn_fwd`` does the same closed form TPU-style (mode="ut", default):
-within a chunk of C tokens the delta-rule corrections form a unit
+``gdn_fwd`` does the same closed form TPU-style. The default
+(mode="pallas", _gdn_kernel) runs it as ONE Pallas kernel — state
+VMEM-resident across a sequential chunk grid, every chunk op on the
+MXU including the triangular solve (a doubling-product inverse).
+mode="ut" is the identical math as plain XLA ops (lax.scan +
+triangular_solve) — the oracle and the fallback for unaligned shapes.
+Within a chunk of C tokens the delta-rule corrections form a unit
 lower-triangular system
 
     (I + diag(beta) L) U = diag(beta) (V - diag(A) K S_0),
@@ -27,25 +32,164 @@ is a batched outer product) as the slow-but-transparent oracle path.
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+
+
+def _gdn_kernel(C: int, nc: int, last_sq: int,
+                q_ref, k_ref, v_ref, g_ref, b_ref, s0_ref,
+                o_ref, sT_ref, S_scr):
+    """One grid step = one chunk for a block of X heads; the state
+    S [X, dk, dv] lives in VMEM scratch across the sequential chunk
+    dimension (the TPU analog of the reference keeping per-head state in
+    registers/SMEM across its chunk loop, gdn.py:123-746).
+
+    The unit-lower-triangular correction system (I + N)U = rhs is solved
+    entirely on the MXU by the doubling product
+        (I + N)^{-1} = (I - N)(I + N^2)(I + N^4)...  (N^C = 0),
+    accumulated as Minv <- Minv + Minv @ P, P <- P @ P — log2(C) [C,C]
+    matmuls instead of a C-step scalar forward substitution (which would
+    crawl on the VPU). Everything else is batched [C,C]/[C,d] matmuls."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        S_scr[...] = s0_ref[...].astype(jnp.float32)
+
+    f32 = jnp.float32
+    S = S_scr[...]
+    qf = q_ref[...].astype(f32)                      # [X, C, dk]
+    kf = k_ref[...].astype(f32)
+    vf = v_ref[...].astype(f32)                      # [X, C, dv]
+    # g/beta arrive pre-chunked as [1, X, C] blocks of a [nc, BH, C]
+    # array (chunk axis major: a [X, C] block with C < 128 lanes, or a
+    # dynamic c*C lane offset, would both break Mosaic's tiling rules)
+    gf = g_ref[0].astype(f32)                        # [X, C]
+    bf = b_ref[0].astype(f32)
+
+    def bmm(x, y):                                   # [X,a,b]@[X,b,c]
+        return jax.lax.dot_general(x, y, (((2,), (1,)), ((0,), (0,))),
+                                   preferred_element_type=f32)
+
+    def bmmT(x, y):                                  # [X,a,d]@[X,c,d]^T
+        return jax.lax.dot_general(x, y, (((2,), (2,)), ((0,), (0,))),
+                                   preferred_element_type=f32)
+
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    colj = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    # inclusive cumsum as a [C,C] matmul (Mosaic has no cumsum prim;
+    # this is one MXU op instead of a VPU log-step scan)
+    cum = jnp.dot(gf, (rowi <= colj).astype(f32),
+                  preferred_element_type=f32)        # [X, C]
+    A = jnp.exp(cum)
+    decay = cum[:, :, None] - cum[:, None, :]        # cum_i - cum_j
+    # mask exponents BEFORE exp: unmasked upper-triangle entries are
+    # positive and overflow
+    ldec = jnp.exp(jnp.where((rowi > colj)[None], decay, -1e30))
+    idec = jnp.exp(jnp.where((rowi >= colj)[None], decay, -1e30))
+    N = bf[..., None] * (ldec * bmmT(kf, kf))        # strictly lower
+    eye = jnp.eye(C, dtype=f32)[None]
+    Minv = eye - N
+    P = bmm(N, N)
+    for i in range(last_sq):
+        Minv = Minv + bmm(Minv, P)
+        if i < last_sq - 1:
+            P = bmm(P, P)
+    rhs = bf[..., None] * (vf - A[..., None] * bmm(kf, S))
+    U = bmm(Minv, rhs)                               # [X, C, dv]
+    O = A[..., None] * bmm(qf, S) + bmm(idec * bmmT(qf, kf), U)
+    cum_last = jax.lax.slice_in_dim(cum, C - 1, C, axis=1)   # [X, 1]
+    w = jnp.exp(cum_last - cum)[..., None] * kf      # [X, C, dk]
+    S_new = (jnp.exp(cum_last)[..., None] * S
+             + jax.lax.dot_general(w, U, (((1,), (1,)), ((0,), (0,))),
+                                   preferred_element_type=f32))
+    o_ref[...] = O.astype(o_ref.dtype)
+    S_scr[...] = S_new
+
+    @pl.when(c == nc - 1)
+    def _fin():
+        sT_ref[...] = S_new
+
+
+def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None):
+    """Pallas chunkwise GDN: grid (head blocks, chunks), state carried in
+    VMEM, chunk blocks streamed by the grid pipeline."""
+    B, H, T, dk = q.shape
+    dv = v.shape[-1]
+    BH = B * H
+    nc = T // chunk
+    if X is None:
+        # head-block size: batches the [C,C] work so VPU ops and grid
+        # overhead amortize over X heads per step. 16 measured fastest
+        # on v5e at C=64/d=128 (268us vs 914us at X=8 for
+        # B8/H16/T2048); cap by a per-head VMEM footprint model so
+        # larger head dims scale X down instead of failing Mosaic
+        # compilation (double-buffered chunk blocks + f32 state + f32
+        # solve intermediates; 32 at d=128 already breaches ~16MB)
+        per_head = (dk * dv * 8                    # S scratch + sT block
+                    + chunk * (dk + dv) * 16       # q/k/v/o dbuf + f32 tmp
+                    + chunk * chunk * 16)          # solve intermediates
+        X = next(x for x in (16, 8, 4, 2, 1)
+                 if BH % x == 0 and x * per_head <= (8 << 20))
+    fold = lambda a: a.reshape(BH, *a.shape[2:])
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    gf = (g.reshape(BH, nc, chunk).transpose(1, 0, 2)
+          .astype(jnp.float32))                      # [nc, BH, C]
+    bf = (beta.reshape(BH, nc, chunk).transpose(1, 0, 2)
+          .astype(jnp.float32))
+    s0 = fold(S0).astype(jnp.float32)
+    last_sq = max(int(math.ceil(math.log2(max(chunk, 2)))) - 1, 1)
+
+    hblk = lambda d: pl.BlockSpec((X, chunk, d), lambda i, c: (i, c, 0))
+    o, sT = pl.pallas_call(
+        functools.partial(_gdn_kernel, chunk, nc, last_sq),
+        grid=(BH // X, nc),
+        in_specs=[hblk(dk), hblk(dk), hblk(dv),
+                  pl.BlockSpec((1, X, chunk), lambda i, c: (c, i, 0)),
+                  pl.BlockSpec((1, X, chunk), lambda i, c: (c, i, 0)),
+                  pl.BlockSpec((X, dk, dv), lambda i, c: (i, 0, 0))],
+        out_specs=(hblk(dv),
+                   pl.BlockSpec((X, dk, dv), lambda i, c: (i, 0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((BH, T, dv), q.dtype),
+                   jax.ShapeDtypeStruct((BH, dk, dv), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((X, dk, dv), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret_mode(),
+    )(qf, kf, vf, gf, bf, s0)
+    return (o.reshape(B, H, T, dv), sT.reshape(B, H, dk, dv))
 
 
 def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
-            chunk: int = 64, mode: str = "ut") -> Tuple[jax.Array, jax.Array]:
+            chunk: int = 64, mode: str = "pallas") -> Tuple[jax.Array, jax.Array]:
     """q, k: [B, H, T, dk]; v: [B, H, T, dv]; g (log decay, <= 0) and
     beta (write strength, in [0, 1]): [B, H, T]. Returns (o [B,H,T,dv],
     S_T [B,H,dk,dv]).
 
-    mode="ut": closed-form chunkwise UT transform (module docstring) —
-    the MXU path, exact (no chunk approximation). mode="scan": per-token
-    recurrence. Reference: gdn.py's chunked forward."""
+    mode="pallas" (default): the Pallas kernel — VMEM-resident state,
+    MXU-only chunk math including the triangular solve (_gdn_kernel).
+    mode="ut": the same closed form as pure XLA ops (lax.scan of chunk
+    steps + lax.linalg.triangular_solve) — the oracle for the kernel and
+    the fallback for shapes the kernel does not tile. mode="scan":
+    per-token recurrence. Reference: gdn.py's chunked forward."""
     B, H, T, dk = q.shape
     dv = v.shape[-1]
     if S0 is None:
         S0 = jnp.zeros((B, H, dk, dv), jnp.float32)
+    if mode == "pallas" and (dk % 128 or dv % 128 or chunk % 8
+                             # even X=1 must fit the VMEM footprint
+                             # model of _gdn_pallas's picker
+                             or (dk * dv * 8 + chunk * (dk + dv) * 16
+                                 + chunk * chunk * 16) > (8 << 20)):
+        mode = "ut"   # lane/sublane-aligned tiles only; oracle otherwise
     pad = (-T) % chunk
     if pad:
         zf = lambda a: jnp.pad(a, [(0, 0)] * 2 + [(0, pad)]
@@ -55,6 +199,11 @@ def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
         beta = jnp.pad(beta, [(0, 0), (0, 0), (0, pad)])
     Tp = T + pad
     nc = Tp // chunk
+    if mode == "pallas":
+        # beta=0 on pad tokens leaves the state untouched, so S_T from
+        # the padded run IS the state at T
+        o, S_T = _gdn_pallas(q, k, v, g, beta, S0, chunk)
+        return o[:, :, :T].astype(q.dtype), S_T
 
     def to_chunks(a):
         return (a.reshape(B, H, nc, chunk, *a.shape[3:])
@@ -122,7 +271,7 @@ def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
 
     if mode not in ("ut", "scan"):
         raise ValueError(f"gdn_fwd: unknown mode {mode!r} "
-                         "(expected 'ut' or 'scan')")
+                         "(expected 'pallas', 'ut' or 'scan')")
     body = chunk_ut if mode == "ut" else chunk_step
     S_T, oc = jax.lax.scan(body, S0, (qc, kc, vc, gc, bc))
     o = (oc.transpose(1, 2, 0, 3, 4)

@@ -58,63 +58,100 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 def _gemm_ar_kernel(n: int, axis: str, block_n: int,
                     a_ref, b_ref, o_ref, land_ref, send_buf,
-                    a_vmem, b_vmem, p_vmem, tmp_vmem,
-                    copy_sem, send_sem, recv_sem):
+                    a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
+                    a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem):
     """GEMM -> one-shot push -> VPU reduce (ref: fused GEMM+AR kernel,
-    gemm_allreduce.py:566). The pushes of tile j overlap the dots of
-    tile j+1."""
+    gemm_allreduce.py:566), software-pipelined:
+      * B tiles double-buffer under the dots;
+      * each finished tile stages to the send buffer asynchronously and
+        its n-way push is issued ONE TILE BEHIND the compute (the stage
+        of tile j rides under the dot of tile j+1; the pushes of tile j
+        ride under everything after it);
+      * the reduce prefetches the next landed tile while the VPU adds
+        the current one, and stages its output writebacks two behind.
+    """
     me = dl.my_pe(axis)
     M, N = o_ref.shape
     nt = cdiv(N, block_n)
+    resident = nt == 1
+
+    def b_src(j):
+        return b_ref if resident else b_ref.at[:, pl.ds(j * block_n,
+                                                        block_n)]
+
+    def tile(ref, j):
+        return ref.at[:, pl.ds(j * block_n, block_n)]
+
+    pltpu.make_async_copy(a_ref, a_vmem, a_sem).start()
+    pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
     dl.barrier_all(axis)
-    cp = pltpu.make_async_copy(a_ref, a_vmem, copy_sem)
-    cp.start()
-    cp.wait()
-    for j in range(nt):
-        cp = pltpu.make_async_copy(
-            b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem, copy_sem)
-        cp.start()
-        cp.wait()
-        p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
-                              preferred_element_type=jnp.float32)
-        tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-        cp = pltpu.make_async_copy(
-            tmp_vmem, send_buf.at[:, pl.ds(j * block_n, block_n)], copy_sem)
-        cp.start()
-        cp.wait()
-        # push this finished tile to every peer while later tiles compute
+    pltpu.make_async_copy(a_ref, a_vmem, a_sem).wait()
+
+    def push(j):
+        """n-way push of staged tile j (already waited)."""
         for p in range(n):
-            dl.putmem_nbi(
-                land_ref.at[me, :, pl.ds(j * block_n, block_n)],
-                send_buf.at[:, pl.ds(j * block_n, block_n)],
-                send_sem, recv_sem, jnp.int32(p), axis)
-    # n peers x nt tiles landed here
-    for _ in range(n * nt):
-        pltpu.make_async_copy(send_buf.at[:, pl.ds(0, block_n)],
-                              send_buf.at[:, pl.ds(0, block_n)],
-                              recv_sem).wait()
+            dl.putmem_nbi(tile(land_ref.at[me], j), tile(send_buf, j),
+                          send_sem, recv_sem, jnp.int32(p), axis)
+
     for j in range(nt):
-        cp = pltpu.make_async_copy(
-            land_ref.at[0, :, pl.ds(j * block_n, block_n)], tmp_vmem,
-            copy_sem)
-        cp.start()
-        cp.wait()
-        p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
-        for i in range(1, n):
-            cp = pltpu.make_async_copy(
-                land_ref.at[i, :, pl.ds(j * block_n, block_n)], tmp_vmem,
-                copy_sem)
-            cp.start()
-            cp.wait()
-            p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(jnp.float32)
-        tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-        cp = pltpu.make_async_copy(
-            tmp_vmem, o_ref.at[:, pl.ds(j * block_n, block_n)], copy_sem)
-        cp.start()
-        cp.wait()
+        ts = j % 2
+        if not resident and j + 1 < nt:
+            pltpu.make_async_copy(b_src(j + 1), b_vmem.at[(j + 1) % 2],
+                                  b_sems.at[(j + 1) % 2]).start()
+        if not resident or j == 0:
+            pltpu.make_async_copy(b_src(j), b_vmem.at[0 if resident
+                                                      else ts],
+                                  b_sems.at[0 if resident else ts]).wait()
+        t_vmem[ts] = jnp.dot(a_vmem[...], b_vmem[0 if resident else ts],
+                             preferred_element_type=jnp.float32
+                             ).astype(t_vmem.dtype)
+        pltpu.make_async_copy(t_vmem.at[ts], tile(send_buf, j),
+                              t_sems.at[ts]).start()
+        if j >= 1:
+            # push the PREVIOUS tile: its staging has had a full dot to
+            # complete, so the wait below is free and the n puts overlap
+            # the next tile's compute
+            pltpu.make_async_copy(t_vmem.at[(j - 1) % 2],
+                                  tile(send_buf, j - 1),
+                                  t_sems.at[(j - 1) % 2]).wait()
+            push(j - 1)
+    pltpu.make_async_copy(t_vmem.at[(nt - 1) % 2], tile(send_buf, nt - 1),
+                          t_sems.at[(nt - 1) % 2]).wait()
+    push(nt - 1)
+
+    # n peers x nt tiles land here
     for _ in range(n * nt):
-        pltpu.make_async_copy(send_buf.at[:, pl.ds(0, block_n)],
-                              send_buf.at[:, pl.ds(0, block_n)],
+        pltpu.make_async_copy(tile(send_buf, 0), tile(send_buf, 0),
+                              recv_sem).wait()
+    # pipelined reduce over the flattened (tile, peer) iteration space
+    pltpu.make_async_copy(tile(land_ref.at[0], 0), l_vmem.at[0],
+                          l_sems.at[0]).start()
+    for j in range(nt):
+        for i in range(n):
+            r = j * n + i
+            if r + 1 < nt * n:
+                jn, in_ = divmod(r + 1, n)
+                pltpu.make_async_copy(tile(land_ref.at[in_], jn),
+                                      l_vmem.at[(r + 1) % 2],
+                                      l_sems.at[(r + 1) % 2]).start()
+            pltpu.make_async_copy(tile(land_ref.at[i], j),
+                                  l_vmem.at[r % 2], l_sems.at[r % 2]).wait()
+            if i == 0:
+                p_vmem[...] = l_vmem[r % 2].astype(jnp.float32)
+            else:
+                p_vmem[...] = p_vmem[...] + l_vmem[r % 2].astype(
+                    jnp.float32)
+        if j >= 2:
+            pltpu.make_async_copy(t_vmem.at[j % 2], tile(o_ref, j - 2),
+                                  t_sems.at[j % 2]).wait()
+        t_vmem[j % 2] = p_vmem[...].astype(t_vmem.dtype)
+        pltpu.make_async_copy(t_vmem.at[j % 2], tile(o_ref, j),
+                              t_sems.at[j % 2]).start()
+    for j in range(max(nt - 2, 0), nt):
+        pltpu.make_async_copy(t_vmem.at[j % 2], tile(o_ref, j),
+                              t_sems.at[j % 2]).wait()
+    for _ in range(n * nt):
+        pltpu.make_async_copy(tile(send_buf, 0), tile(send_buf, 0),
                               send_sem).wait()
 
 
@@ -137,10 +174,15 @@ def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
                         for _ in range(3)),
         scratch_shapes=[
             pltpu.VMEM((M, k_loc), a_shard.dtype),
-            pltpu.VMEM((k_loc, block_n), b_shard.dtype),
+            pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
+                       b_shard.dtype),
+            pltpu.VMEM((2, M, block_n), a_shard.dtype),
+            pltpu.VMEM((2, M, block_n), a_shard.dtype),
             pltpu.VMEM((M, block_n), jnp.float32),
-            pltpu.VMEM((M, block_n), a_shard.dtype),
             pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],

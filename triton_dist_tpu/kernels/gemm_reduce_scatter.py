@@ -70,74 +70,137 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 def _gemm_rs_kernel(n: int, axis: str, block_n: int,
                     a_ref, b_ref, o_ref,
                     land_ref, send_buf,
-                    a_vmem, b_vmem, p_vmem, tmp_vmem,
-                    copy_sem, send_sems, recv_sems, credit_sem):
+                    a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
+                    a_sem, b_sems, t_sems, d_sems, l_sems,
+                    send_sems, recv_sems, credit_sem):
+    """Software-pipelined producer + fold (the TPU analog of the
+    reference's per-tile-notify producer GEMM, gemm_reduce_scatter.py:
+    125-333, which never stalls the tensor cores on memory):
+      * A chunks and B tiles double-buffer — the next tile's loads are
+        in flight under the current tile's dot;
+      * producer output tiles stage through two slots whose HBM
+        writeback is waited two tiles later;
+      * the fold (dest += slab from left) prefetches both operand tiles
+        of j+1 while the VPU adds tile j, and stages its writebacks the
+        same way.
+    """
     me = dl.my_pe(axis)
     m_loc, N = o_ref.shape
     k_loc = a_ref.shape[1]
     nt = cdiv(N, block_n)
+    resident = nt == 1
     left, right = dl.ring_neighbors(axis)
-    dl.barrier_all(axis)
 
-    if nt == 1:
-        cp = pltpu.make_async_copy(b_ref, b_vmem, copy_sem)
-        cp.start()
-        cp.wait()
+    def chunk_of(s):
+        return jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
+
+    def b_src(j):
+        return b_ref if resident else b_ref.at[:, pl.ds(j * block_n,
+                                                        block_n)]
+
+    def dest_of(s):
+        return o_ref if s == n - 1 else send_buf.at[s % 2]
+
+    # prologue: step-0 A chunk and B tile 0 stream in under the barrier
+    pltpu.make_async_copy(a_ref.at[pl.ds(chunk_of(0) * m_loc, m_loc)],
+                          a_vmem.at[0], a_sem).start()
+    pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
+    dl.barrier_all(axis)
 
     for s in range(n):
         slot = s % 2
         last = s == n - 1
-        chunk = jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
-        dest = o_ref if last else send_buf.at[slot]
+        chunk = chunk_of(s)
+        dest = dest_of(s)
         if s >= 2 and not last:
             # this slot's previous RDMA must finish reading send_buf
             dl.quiet(send_sems.at[slot], send_buf.at[slot], 1)
         # --- producer GEMM for this chunk (ref: per-tile notify GEMM,
         # gemm_reduce_scatter.py:125-333); the RDMA from step s-1 is in
         # flight under these dots -> the overlap.
-        cp = pltpu.make_async_copy(
-            a_ref.at[pl.ds(chunk * m_loc, m_loc)], a_vmem, copy_sem)
-        cp.start()
-        cp.wait()
+        pltpu.make_async_copy(a_ref.at[pl.ds(chunk * m_loc, m_loc)],
+                              a_vmem.at[slot], a_sem).wait()
+        if not last:
+            pltpu.make_async_copy(
+                a_ref.at[pl.ds(chunk_of(s + 1) * m_loc, m_loc)],
+                a_vmem.at[(s + 1) % 2], a_sem).start()
         for j in range(nt):
-            if nt > 1:
-                cpb = pltpu.make_async_copy(
-                    b_ref.at[:, pl.ds(j * block_n, block_n)], b_vmem,
-                    copy_sem)
-                cpb.start()
-                cpb.wait()
-            p_vmem[...] = jnp.dot(a_vmem[...], b_vmem[...],
-                                  preferred_element_type=jnp.float32)
-            tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-            cp = pltpu.make_async_copy(
-                tmp_vmem, dest.at[:, pl.ds(j * block_n, block_n)], copy_sem)
-            cp.start()
-            cp.wait()
+            t = s * nt + j
+            bslot = 0 if resident else t % 2
+            ts = j % 2
+            if not resident and t + 1 < n * nt:
+                pltpu.make_async_copy(b_src((j + 1) % nt),
+                                      b_vmem.at[(t + 1) % 2],
+                                      b_sems.at[(t + 1) % 2]).start()
+            if not resident or t == 0:
+                pltpu.make_async_copy(b_src(j), b_vmem.at[bslot],
+                                      b_sems.at[bslot]).wait()
+            if j >= 2:
+                # the writeback issued two tiles ago reuses this slot
+                # (per-step slots: each step drains its own writebacks
+                # below, so cross-step waits would double-consume)
+                pltpu.make_async_copy(
+                    t_vmem.at[ts],
+                    dest.at[:, pl.ds((j - 2) * block_n, block_n)],
+                    t_sems.at[ts]).wait()
+            t_vmem[ts] = jnp.dot(a_vmem[slot], b_vmem[bslot],
+                                 preferred_element_type=jnp.float32
+                                 ).astype(t_vmem.dtype)
+            pltpu.make_async_copy(
+                t_vmem.at[ts], dest.at[:, pl.ds(j * block_n, block_n)],
+                t_sems.at[ts]).start()
+        # drain producer writebacks: the fold (or the RDMA) reads dest
+        for j in range(max(nt - 2, 0), nt):
+            pltpu.make_async_copy(
+                t_vmem.at[j % 2],
+                dest.at[:, pl.ds(j * block_n, block_n)],
+                t_sems.at[j % 2]).wait()
         if s >= 1:
             # consumer: add the accumulated chunk from the left (per-slot
             # recv semaphore against out-of-order arrival)
             pltpu.make_async_copy(o_ref, o_ref,
                                   recv_sems.at[(s - 1) % 2]).wait()
             prev_slot = (s - 1) % 2
+
+            def land_src(j):
+                return land_ref.at[prev_slot, :,
+                                   pl.ds(j * block_n, block_n)]
+
+            pltpu.make_async_copy(dest.at[:, pl.ds(0, block_n)],
+                                  d_vmem.at[0], d_sems.at[0]).start()
+            pltpu.make_async_copy(land_src(0), l_vmem.at[0],
+                                  l_sems.at[0]).start()
             for j in range(nt):
-                cp = pltpu.make_async_copy(
-                    dest.at[:, pl.ds(j * block_n, block_n)], tmp_vmem,
-                    copy_sem)
-                cp.start()
-                cp.wait()
-                p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
-                cp = pltpu.make_async_copy(
-                    land_ref.at[prev_slot, :, pl.ds(j * block_n, block_n)],
-                    tmp_vmem, copy_sem)
-                cp.start()
-                cp.wait()
-                p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(jnp.float32)
-                tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-                cp = pltpu.make_async_copy(
-                    tmp_vmem, dest.at[:, pl.ds(j * block_n, block_n)],
-                    copy_sem)
-                cp.start()
-                cp.wait()
+                fs = j % 2
+                if j + 1 < nt:
+                    pltpu.make_async_copy(
+                        dest.at[:, pl.ds((j + 1) * block_n, block_n)],
+                        d_vmem.at[(j + 1) % 2],
+                        d_sems.at[(j + 1) % 2]).start()
+                    pltpu.make_async_copy(land_src(j + 1),
+                                          l_vmem.at[(j + 1) % 2],
+                                          l_sems.at[(j + 1) % 2]).start()
+                pltpu.make_async_copy(
+                    dest.at[:, pl.ds(j * block_n, block_n)],
+                    d_vmem.at[fs], d_sems.at[fs]).wait()
+                pltpu.make_async_copy(land_src(j), l_vmem.at[fs],
+                                      l_sems.at[fs]).wait()
+                if j >= 2:
+                    pltpu.make_async_copy(
+                        t_vmem.at[fs],
+                        dest.at[:, pl.ds((j - 2) * block_n, block_n)],
+                        t_sems.at[fs]).wait()
+                t_vmem[fs] = (d_vmem[fs].astype(jnp.float32)
+                              + l_vmem[fs].astype(jnp.float32)
+                              ).astype(t_vmem.dtype)
+                pltpu.make_async_copy(
+                    t_vmem.at[fs], dest.at[:, pl.ds(j * block_n, block_n)],
+                    t_sems.at[fs]).start()
+            for j in range(max(nt - 2, 0), nt):
+                pltpu.make_async_copy(
+                    t_vmem.at[j % 2],
+                    dest.at[:, pl.ds(j * block_n, block_n)],
+                    t_sems.at[j % 2]).wait()
             dl.signal_op(credit_sem, 1, left, axis)
         if not last:
             if s >= 2:
@@ -177,11 +240,17 @@ def _gemm_rs_call(a_shard, b_shard,
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                         for _ in range(3)),
         scratch_shapes=[
-            pltpu.VMEM((m_loc, k_loc), a_shard.dtype),
-            pltpu.VMEM((k_loc, block_n), b_shard.dtype),
-            pltpu.VMEM((m_loc, block_n), jnp.float32),
-            pltpu.VMEM((m_loc, block_n), a_shard.dtype),
+            pltpu.VMEM((2, m_loc, k_loc), a_shard.dtype),
+            pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
+                       b_shard.dtype),
+            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
             pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,

@@ -1,0 +1,205 @@
+"""Fused GroupGEMM + AllReduce: the MoE TP *decode* epilogue.
+
+TPU-native re-design of the reference MoE-reduce-AR
+(`python/triton_dist/kernels/nvidia/moe_reduce_ar.py:323-645` — the
+grouped down-proj GEMM whose epilogue feeds a fused one-shot AllReduce
+instead of a reduce-scatter, used in the small-M latency-bound decode
+regime where every rank needs the full combined output).
+
+Protocol = this repo's dense gemm_allreduce (push-all one-shot AR, the
+small-batch TP decode path) with the per-step payload widened to
+moe_reduce_rs's expert SLAB: each expert's [capT, D] partial travels as
+one message, pushes issued one expert behind the MXU so the n-way puts
+of expert e ride under the dot of expert e+1.
+
+Contract (row-parallel expert weights, replicated output):
+  h  [E, capT, F]  expert activations, F sharded over `axis`
+  w2 [E, F, D]     down-proj weights, F (rows) sharded
+  -> y [E, capT, D] REPLICATED = sum over ranks of h_loc @ w2_loc
+
+The topk combine stays in the layer (same split as the RS path): the
+reference folds its gather/scale into the GEMM via A_scale + gather
+indices, which on TPU is XLA's job (dynamic gathers fuse there; the MXU
+kernel keeps static shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import language as dl
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
+
+
+def _moe_ar_kernel(n: int, axis: str, E: int, resident_b: bool,
+                   a_ref, b_ref, o_ref, land_ref, send_buf,
+                   a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
+                   a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem):
+    """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
+    o_ref: [E, capT, D]; land_ref: [n, E, capT, D]; send_buf like o.
+
+    Same software pipeline as the dense _gemm_ar_kernel: double-buffered
+    operand loads, staged sends one expert behind the compute, and a
+    prefetching reduce over the flattened (expert, peer) space."""
+    me = dl.my_pe(axis)
+
+    if resident_b:
+        pltpu.make_async_copy(b_ref, b_vmem, b_sems.at[0]).start()
+    else:
+        pltpu.make_async_copy(b_ref.at[0], b_vmem.at[0],
+                              b_sems.at[0]).start()
+    pltpu.make_async_copy(a_ref.at[0], a_vmem.at[0], a_sem).start()
+    dl.barrier_all(axis)
+
+    def push(e):
+        """n-way push of the staged expert-e slab (already waited)."""
+        for p in range(n):
+            dl.putmem_nbi(land_ref.at[me, e], send_buf.at[e],
+                          send_sem, recv_sem, jnp.int32(p), axis)
+
+    for e in range(E):
+        pltpu.make_async_copy(a_ref.at[e], a_vmem.at[e % 2], a_sem).wait()
+        if e + 1 < E:
+            pltpu.make_async_copy(a_ref.at[e + 1], a_vmem.at[(e + 1) % 2],
+                                  a_sem).start()
+        if resident_b:
+            if e == 0:
+                pltpu.make_async_copy(b_ref, b_vmem, b_sems.at[0]).wait()
+            b_tile = b_vmem[e]
+        else:
+            pltpu.make_async_copy(b_ref.at[e], b_vmem.at[e % 2],
+                                  b_sems.at[e % 2]).wait()
+            if e + 1 < E:
+                pltpu.make_async_copy(b_ref.at[e + 1],
+                                      b_vmem.at[(e + 1) % 2],
+                                      b_sems.at[(e + 1) % 2]).start()
+            b_tile = b_vmem[e % 2]
+        t_vmem[e % 2] = jnp.dot(a_vmem[e % 2], b_tile,
+                                preferred_element_type=jnp.float32
+                                ).astype(t_vmem.dtype)
+        pltpu.make_async_copy(t_vmem.at[e % 2], send_buf.at[e],
+                              t_sems.at[e % 2]).start()
+        if e >= 1:
+            pltpu.make_async_copy(t_vmem.at[(e - 1) % 2],
+                                  send_buf.at[e - 1],
+                                  t_sems.at[(e - 1) % 2]).wait()
+            push(e - 1)
+    pltpu.make_async_copy(t_vmem.at[(E - 1) % 2], send_buf.at[E - 1],
+                          t_sems.at[(E - 1) % 2]).wait()
+    push(E - 1)
+
+    # n peers x E slabs land here
+    for _ in range(n * E):
+        pltpu.make_async_copy(send_buf.at[0], send_buf.at[0],
+                              recv_sem).wait()
+    # pipelined reduce over the flattened (expert, peer) space
+    pltpu.make_async_copy(land_ref.at[0, 0], l_vmem.at[0],
+                          l_sems.at[0]).start()
+    for e in range(E):
+        for i in range(n):
+            r = e * n + i
+            if r + 1 < E * n:
+                en, in_ = divmod(r + 1, n)
+                pltpu.make_async_copy(land_ref.at[in_, en],
+                                      l_vmem.at[(r + 1) % 2],
+                                      l_sems.at[(r + 1) % 2]).start()
+            pltpu.make_async_copy(land_ref.at[i, e], l_vmem.at[r % 2],
+                                  l_sems.at[r % 2]).wait()
+            if i == 0:
+                p_vmem[...] = l_vmem[r % 2].astype(jnp.float32)
+            else:
+                p_vmem[...] = p_vmem[...] + l_vmem[r % 2].astype(
+                    jnp.float32)
+        if e >= 2:
+            pltpu.make_async_copy(t_vmem.at[e % 2], o_ref.at[e - 2],
+                                  t_sems.at[e % 2]).wait()
+        t_vmem[e % 2] = p_vmem[...].astype(t_vmem.dtype)
+        pltpu.make_async_copy(t_vmem.at[e % 2], o_ref.at[e],
+                              t_sems.at[e % 2]).start()
+    for e in range(max(E - 2, 0), E):
+        pltpu.make_async_copy(t_vmem.at[e % 2], o_ref.at[e],
+                              t_sems.at[e % 2]).wait()
+    for _ in range(n * E):
+        pltpu.make_async_copy(send_buf.at[0], send_buf.at[0],
+                              send_sem).wait()
+
+
+def moe_reduce_ar(h, w2, *, mesh: Mesh, axis: str = "tp",
+                  collective_id: Optional[int] = None,
+                  resident_b: Optional[bool] = None):
+    """y = allreduce(sum over F of h @ w2) per expert, fused in one
+    kernel (reference: moe_reduce_ar.py:323-645). h: [E, capT, F]
+    F-sharded; w2: [E, F, D] F-row-sharded. Returns [E, capT, D]
+    replicated over `axis` — the MoE TP decode epilogue."""
+    n = mesh.shape[axis]
+    E, capT, F = h.shape
+    D = w2.shape[2]
+    from triton_dist_tpu.runtime import on_tpu
+    if on_tpu() and ((F // n) % 128 or D % 128):
+        # compiled Mosaic rejects expert-sliced DMAs whose minor dim is
+        # not lane-aligned (the interpreter does not enforce this)
+        raise ValueError(
+            f"moe_reduce_ar on TPU needs F/n ({F}/{n}) and D ({D}) to be "
+            "multiples of 128 (pad the intermediate dim)")
+    if collective_id is None:
+        collective_id = next_collective_id()
+    isz = jnp.dtype(h.dtype).itemsize
+    wsz = jnp.dtype(w2.dtype).itemsize
+    f_l = F // n
+    if resident_b is None:   # hold all expert panels across the op
+        resident_b = (E * f_l * D * wsz + 2 * capT * f_l * isz
+                      + capT * D * (4 + 3 * isz)) <= (10 << 20)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis, None)),
+        out_specs=P(None, None, None), check_vma=False)
+    def _f(h_loc, w_loc):
+        f_loc = h_loc.shape[2]
+        kernel = functools.partial(_moe_ar_kernel, n, axis, E, resident_b)
+        out, _, _ = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((E, capT, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((n, E, capT, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((E, capT, D), h_loc.dtype),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                            for _ in range(3)),
+            scratch_shapes=[
+                pltpu.VMEM((2, capT, f_loc), h_loc.dtype),
+                pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
+                           w_loc.dtype),
+                pltpu.VMEM((2, capT, D), h_loc.dtype),
+                pltpu.VMEM((2, capT, D), h_loc.dtype),
+                pltpu.VMEM((capT, D), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            compiler_params=shmem_compiler_params(collective_id, n=n),
+            interpret=interpret_mode(),
+        )(h_loc, w_loc)
+        return out
+
+    return _f(h, w2)
+
+
+def moe_reduce_ar_ref(h, w2):
+    """jnp oracle: full grouped GEMM (the reduce over F happens in the
+    unsharded contraction; output replicated)."""
+    return jnp.einsum("ecf,efd->ecd", h.astype(jnp.float32),
+                      w2.astype(jnp.float32)).astype(h.dtype)

@@ -37,70 +37,118 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
                    a_ref, b_ref, o_ref, land_ref, send_buf,
-                   a_vmem, b_vmem, p_vmem, tmp_vmem,
-                   copy_sem, send_sems, recv_sems, credit_sem):
+                   a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
+                   a_sem, b_sems, t_sems, d_sems, l_sems,
+                   send_sems, recv_sems, credit_sem):
     """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
     o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D].
 
     resident_b: all experts' down-proj panels fit VMEM (b_vmem is
-    [E, F_loc, D]): B is loaded once, not once per expert per step."""
+    [E, F_loc, D]): B is loaded once, not once per expert per step.
+
+    Software-pipelined like the dense gemm_rs: expert activation chunks
+    and (non-resident) B panels double-buffer under the dots, producer
+    slabs stage through two deferred-writeback slots (drained before
+    the fold reads them), and the fold prefetches the next expert's
+    operand pair while the VPU adds the current one."""
     me = dl.my_pe(axis)
     _, c_loc, D = o_ref.shape
     left, right = dl.ring_neighbors(axis)
+
+    def chunk_of(s):
+        return jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
+
+    def a_src(s, e):
+        return a_ref.at[e, pl.ds(chunk_of(s) * c_loc, c_loc), :]
+
     if resident_b:
-        cp = pltpu.make_async_copy(b_ref, b_vmem, copy_sem)
-        cp.start()
-        cp.wait()
+        pltpu.make_async_copy(b_ref, b_vmem, b_sems.at[0]).start()
+    else:
+        pltpu.make_async_copy(b_ref.at[0], b_vmem.at[0],
+                              b_sems.at[0]).start()
+    pltpu.make_async_copy(a_src(0, 0), a_vmem.at[0], a_sem).start()
     dl.barrier_all(axis)
 
     for s in range(n):
         slot = s % 2
         last = s == n - 1
-        chunk = jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
         dest = o_ref if last else send_buf.at[slot]
         if s >= 2 and not last:
             dl.quiet(send_sems.at[slot], send_buf.at[slot], 1)
         # --- producer: E grouped dots for this chunk; the slab RDMA of
         # step s-1 is in flight under them
         for e in range(E):
-            cp = pltpu.make_async_copy(
-                a_ref.at[e, pl.ds(chunk * c_loc, c_loc), :], a_vmem,
-                copy_sem)
-            cp.start()
-            cp.wait()
+            et = s * E + e
+            pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
+                                  a_sem).wait()
+            if e + 1 < E:
+                pltpu.make_async_copy(a_src(s, e + 1),
+                                      a_vmem.at[(et + 1) % 2],
+                                      a_sem).start()
+            elif not last:
+                pltpu.make_async_copy(a_src(s + 1, 0),
+                                      a_vmem.at[(et + 1) % 2],
+                                      a_sem).start()
             if resident_b:
+                if et == 0:
+                    pltpu.make_async_copy(b_ref, b_vmem,
+                                          b_sems.at[0]).wait()
                 b_tile = b_vmem[e]
             else:
-                cp = pltpu.make_async_copy(b_ref.at[e], b_vmem, copy_sem)
-                cp.start()
-                cp.wait()
-                b_tile = b_vmem[...]
-            p_vmem[...] = jnp.dot(a_vmem[...], b_tile,
-                                  preferred_element_type=jnp.float32)
-            tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-            cp = pltpu.make_async_copy(tmp_vmem, dest.at[e], copy_sem)
-            cp.start()
-            cp.wait()
+                pltpu.make_async_copy(b_ref.at[e], b_vmem.at[et % 2],
+                                      b_sems.at[et % 2]).wait()
+                if et + 1 < n * E:
+                    pltpu.make_async_copy(b_ref.at[(e + 1) % E],
+                                          b_vmem.at[(et + 1) % 2],
+                                          b_sems.at[(et + 1) % 2]).start()
+                b_tile = b_vmem[et % 2]
+            if e >= 2:
+                # the slab writeback issued two experts ago reuses this
+                # slot (per-step slots: drained below before the fold)
+                pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e - 2],
+                                      t_sems.at[e % 2]).wait()
+            t_vmem[e % 2] = jnp.dot(a_vmem[et % 2], b_tile,
+                                    preferred_element_type=jnp.float32
+                                    ).astype(t_vmem.dtype)
+            pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
+                                  t_sems.at[e % 2]).start()
+        # drain producer writebacks: the fold (or the RDMA) reads dest
+        for e in range(max(E - 2, 0), E):
+            pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
+                                  t_sems.at[e % 2]).wait()
         if s >= 1:
             # consumer: fold the accumulated slab from the left
             pltpu.make_async_copy(o_ref, o_ref,
                                   recv_sems.at[(s - 1) % 2]).wait()
             prev = (s - 1) % 2
+            pltpu.make_async_copy(dest.at[0], d_vmem.at[0],
+                                  d_sems.at[0]).start()
+            pltpu.make_async_copy(land_ref.at[prev, 0], l_vmem.at[0],
+                                  l_sems.at[0]).start()
             for e in range(E):
-                cp = pltpu.make_async_copy(dest.at[e], tmp_vmem, copy_sem)
-                cp.start()
-                cp.wait()
-                p_vmem[...] = tmp_vmem[...].astype(jnp.float32)
-                cp = pltpu.make_async_copy(land_ref.at[prev, e], tmp_vmem,
-                                           copy_sem)
-                cp.start()
-                cp.wait()
-                p_vmem[...] = p_vmem[...] + tmp_vmem[...].astype(
-                    jnp.float32)
-                tmp_vmem[...] = p_vmem[...].astype(tmp_vmem.dtype)
-                cp = pltpu.make_async_copy(tmp_vmem, dest.at[e], copy_sem)
-                cp.start()
-                cp.wait()
+                fs = e % 2
+                if e + 1 < E:
+                    pltpu.make_async_copy(dest.at[e + 1],
+                                          d_vmem.at[(e + 1) % 2],
+                                          d_sems.at[(e + 1) % 2]).start()
+                    pltpu.make_async_copy(land_ref.at[prev, e + 1],
+                                          l_vmem.at[(e + 1) % 2],
+                                          l_sems.at[(e + 1) % 2]).start()
+                pltpu.make_async_copy(dest.at[e], d_vmem.at[fs],
+                                      d_sems.at[fs]).wait()
+                pltpu.make_async_copy(land_ref.at[prev, e], l_vmem.at[fs],
+                                      l_sems.at[fs]).wait()
+                if e >= 2:
+                    pltpu.make_async_copy(t_vmem.at[fs], dest.at[e - 2],
+                                          t_sems.at[fs]).wait()
+                t_vmem[fs] = (d_vmem[fs].astype(jnp.float32)
+                              + l_vmem[fs].astype(jnp.float32)
+                              ).astype(t_vmem.dtype)
+                pltpu.make_async_copy(t_vmem.at[fs], dest.at[e],
+                                      t_sems.at[fs]).start()
+            for e in range(max(E - 2, 0), E):
+                pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
+                                      t_sems.at[e % 2]).wait()
             dl.signal_op(credit_sem, 1, left, axis)
         if not last:
             if s >= 2:
@@ -154,12 +202,17 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
             out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                             for _ in range(3)),
             scratch_shapes=[
-                pltpu.VMEM((c_loc, f_loc), h_loc.dtype),
-                pltpu.VMEM((E, f_loc, D) if resident_b else (f_loc, D),
+                pltpu.VMEM((2, c_loc, f_loc), h_loc.dtype),
+                pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
                            w_loc.dtype),
-                pltpu.VMEM((c_loc, D), jnp.float32),
-                pltpu.VMEM((c_loc, D), h_loc.dtype),
+                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
                 pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR,

@@ -320,6 +320,14 @@ class UlyssesAttn:
             return flash_decode(q, k, v, jnp.int32(S))
 
         o = attend(qkv)                      # [B, S, Hq, d] head-sharded
+        if mode == "fused":
+            # combine-direction fusion: the O projection consumes each
+            # peer's seq-block tile as it lands (o_a2a_gemm; reference
+            # sp_ulysess_o_all2all_gemm.py:147) — both a2a directions
+            # are now fused with their adjacent GEMMs
+            from triton_dist_tpu.kernels.sp_attention import o_a2a_gemm
+            o = o.reshape(B, S, hq_loc * hd * n)   # head-sharded dim 2
+            return o_a2a_gemm(o, self.w_o, mesh=self.mesh, axis=axis)
         o = ulysses_combine(o, mesh=self.mesh, axis=axis)
         o = o.reshape(B, S, self.n_heads * hd)
         return _local_proj(o, self.w_o, self.mesh, axis)

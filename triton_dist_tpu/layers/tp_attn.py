@@ -160,10 +160,16 @@ class TP_Attn:
             return impl
         from triton_dist_tpu.kernels.flash_attn import _pick_bx
         from triton_dist_tpu.kernels.flash_attn_train import (
-            DEFAULT_BLOCK_R, DEFAULT_BLOCK_T, query_chunk)
+            DEFAULT_BLOCK_R, DEFAULT_BLOCK_T, _pick_bx_bwd, query_chunk)
         try:
             _pick_bx(1, query_chunk(S, rep, DEFAULT_BLOCK_R) * rep, hd,
                      min(DEFAULT_BLOCK_T, S), jnp.dtype(dtype).itemsize, 1)
+            # the backward allocates its own (larger) footprint: probe it
+            # with the same default blocks so jax.grad falls back to the
+            # ref path instead of raising at trace time
+            _pick_bx_bwd(1, min(DEFAULT_BLOCK_R, S * rep),
+                         min(DEFAULT_BLOCK_T, S), hd,
+                         jnp.dtype(dtype).itemsize)
             return "flash"
         except ValueError:
             return "ref"

@@ -214,6 +214,36 @@ class TP_MoE:
 
         return combine(y_e, inv_slot, token, topk_w)
 
+    def fwd_fused_ar(self, x):
+        """Decode path: fused grouped-GEMM + AllReduce epilogue
+        (reference: moe_reduce_ar.py:323-645, the small-M latency-bound
+        regime). x REPLICATED [M, D] -> replicated [M, D]: routing and
+        grouping are replicated (every rank computes the same plan),
+        GEMM1 consumes only local weight columns, and the down-proj's
+        partial sums are combined by the one-shot push-all AR inside
+        moe_reduce_ar — no separate collective, the decode analog of
+        TP_MLP's gemm_ar mode."""
+        from triton_dist_tpu.kernels.moe_reduce_ar import moe_reduce_ar
+        E, k = self.num_experts, self.top_k
+        M = x.shape[0]
+        cap = self._cap(M)
+        topk_w, topk_idx = route(x @ self.w_router, k)
+        x_e, inv_slot, token = group_tokens_by_expert(x, topk_idx, E, cap)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, None, None), P(None, None, self.axis)),
+            out_specs=P(None, None, self.axis), check_vma=False)
+        def up(x_e, wgu_loc):
+            h = grouped_gemm(x_e, wgu_loc.astype(x_e.dtype))
+            return swiglu_ref(h)
+
+        h2 = up(x_e, self.w_gate_up)
+        y_e = moe_reduce_ar(h2, self.w_down.astype(x.dtype),
+                            mesh=self.mesh, axis=self.axis)
+        return scatter_weighted(y_e, inv_slot, token, topk_w,
+                                M).astype(x.dtype)
+
     def fwd_local(self, x):
         """Single-chip framework path: route + grouped-GEMM kernels with
         everything resident (the MoE analog of TP_MLP.fwd_flash)."""
@@ -267,6 +297,8 @@ class TP_MoE:
             return self.fwd_train(x)
         if mode == "fused":
             return self.fwd_fused(x)
+        if mode == "fused_ar":
+            return self.fwd_fused_ar(x)
         if mode in ("dist",):
             return self.fwd_dist(x)
         if mode in ("flash", "ar", "gemm_ar"):

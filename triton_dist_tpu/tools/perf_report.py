@@ -49,11 +49,16 @@ def _repeat(step, x0, k):
     return functools.partial(prog, x0)
 
 
-def _time(step, x0, *, k1=64, k2=1024, reps=3):
+def _time(step, x0, *, k1=64, k2=1024, reps=3, slopes=3):
     """Two-point amortized timing: per-op time is the slope between a
     k1-iteration and a k2-iteration loop program, cancelling the
     (large, on tunneled backends) constant dispatch/readback overhead.
-    `step(x) -> x_like` must thread a data dependence."""
+    `step(x) -> x_like` must thread a data dependence.
+
+    The tunneled chip shows +-30% run-to-run noise (shared host, clock
+    drift), so take the MIN over `slopes` interleaved slope estimates —
+    the best pair is the least-contended measurement of the same
+    program."""
     f1, f2 = _repeat(step, x0, k1), _repeat(step, x0, k2)
     # float() forces a host readback: block_until_ready does not
     # reliably block on tunneled backends (same workaround as bench.py)
@@ -68,8 +73,14 @@ def _time(step, x0, *, k1=64, k2=1024, reps=3):
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    t1, t2 = best(f1), best(f2)
-    return max((t2 - t1) / (k2 - k1), 1e-9) * 1e6   # us
+    t1s, t2s = [], []
+    for _ in range(slopes):
+        t1s.append(best(f1))
+        t2s.append(best(f2))
+    # ONE slope from the pooled minima: min over per-round slope
+    # DIFFERENCES would be biased low (it picks the round whose t1 was
+    # contention-inflated relative to t2)
+    return max((min(t2s) - min(t1s)) / (k2 - k1), 1e-9) * 1e6   # us
 
 
 # below this slope the chain was elided (an op that is the identity at
@@ -160,18 +171,32 @@ def run_report(write_json=None):
     ag_ctx = create_ag_gemm_context(mesh)
     rs_ctx = create_gemm_rs_context(mesh)
     ar_ctx = create_gemm_ar_context(mesh)
+
+    def chain(op):
+        """Thread a serial data dependence WITHOUT changing the carry's
+        sharding: fold the op's output into a negligible scalar
+        perturbation of the input (f32 accumulation so the bf16 sum
+        cannot overflow to inf and poison the carry). Feeding the output
+        back directly would insert a cross-device reshard inside the
+        timed loop for ops whose output sharding differs from their
+        input's, inflating the measured per-op time."""
+        def step(v):
+            eps = jnp.sum(op(v), dtype=jnp.float32) * 1e-30
+            return v + eps.astype(v.dtype)
+        return step
+
     # GEMM SOL terms use PER-CHIP dims: ag_gemm computes [M, K]@[K, N/n]
     # per chip, gemm_rs/gemm_ar compute [M, K/n]@[K/n, N]
     add("ag_gemm",
-        lambda v: ag_gemm(v, b_cols, ag_ctx)[:, :K], a_rows,
+        chain(lambda v: ag_gemm(v, b_cols, ag_ctx)), a_rows,
         gemm_sol_us(M, K, N // n, itemsize=isz, spec=spec)
         + collective_sol_us("ag", M * K * isz, n, spec=spec))
     add("gemm_rs",
-        lambda v: gemm_rs(v, b_rows, rs_ctx)[:, :K], a_cols,
+        chain(lambda v: gemm_rs(v, b_rows, rs_ctx)), a_cols,
         gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
         + collective_sol_us("rs", M * N * isz, n, spec=spec))
     add("gemm_allreduce",
-        lambda v: gemm_allreduce(v, b_rows, ar_ctx)[:, :K], a_cols,
+        chain(lambda v: gemm_allreduce(v, b_rows, ar_ctx)), a_cols,
         gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
         + collective_sol_us("ar", M * N * isz, n, spec=spec))
 
@@ -196,8 +221,7 @@ def run_report(write_json=None):
     we = jax.device_put(jnp.asarray(rng.randn(E, Dm, Nm), dt) * 0.1,
                         NamedSharding(mesh, P(None, None, "tp")))
     add("ag_group_gemm",
-        lambda v: ag_group_gemm(v[:, :, :Dm], we, mesh=mesh)[:, :, :Dm],
-        xe,
+        chain(lambda v: ag_group_gemm(v, we, mesh=mesh)), xe,
         gemm_sol_us(E * capT, Dm, Nm // n, itemsize=isz, spec=spec)
         + collective_sol_us("ag", E * capT * Dm * isz, n, spec=spec))
     he = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
@@ -205,15 +229,12 @@ def run_report(write_json=None):
     w2 = jax.device_put(jnp.asarray(rng.randn(E, Nm, Dm), dt) * 0.1,
                         NamedSharding(mesh, P(None, "tp", None)))
     add("moe_reduce_rs",
-        lambda v: jnp.concatenate([moe_reduce_rs(v, w2, mesh=mesh)] * (
-            Nm // Dm), axis=2) if Nm != Dm else moe_reduce_rs(
-                v, w2, mesh=mesh),
-        he,
+        chain(lambda v: moe_reduce_rs(v, w2, mesh=mesh)), he,
         gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
         + collective_sol_us("rs", E * capT * Dm * isz, n, spec=spec))
 
-    # GDN chunkwise UT transform (roofline: qkv/g/beta/o traffic vs the
-    # chunk matmul FLOPs)
+    # GDN chunkwise forward, Pallas kernel (gdn_fwd default; roofline:
+    # qkv/g/beta/o traffic vs the chunk matmul FLOPs)
     from triton_dist_tpu.kernels.gdn import gdn_fwd
     Bg, Hg, Tg, dk_, dv_ = (8, 16, 2048, 128, 128) if on_tpu else \
                            (2, 2, 256, 32, 32)
@@ -228,7 +249,7 @@ def run_report(write_json=None):
                                     + 2 * dk_ * dv_)
     gdn_sol = max(gdn_bytes / (spec.hbm_gbps * 1e9),
                   gdn_flops / (spec.bf16_tflops * 1e12)) * 1e6
-    add("gdn_fwd(ut)",
+    add("gdn_fwd(pallas)",
         lambda u: gdn_fwd(u, kg, vg, gg, bg, chunk=C)[0], qg, gdn_sol)
 
     header = {"backend": jax.default_backend(), "ndev": ndev,
