@@ -97,3 +97,64 @@ def test_swiglu_kernel_vs_ref():
         np.testing.assert_allclose(np.asarray(swiglu(x)),
                                    np.asarray(swiglu_ref(x)),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_flash_decode_int8_kv_vs_dequant_oracle():
+    """int8 KV cache path: the kernel's in-place dequant (scales folded
+    into logits / P) vs the jnp oracle on explicitly dequantized KV."""
+    B, S, Hq, Hkv, T, d = 2, 1, 4, 2, 64, 128
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32) * 0.3
+    kf = rng.randn(B, Hkv, T, d) * 0.5
+    vf = rng.randn(B, Hkv, T, d) * 0.5
+    ks = np.abs(kf).max(-1) / 127.0 + 1e-9
+    vs = np.abs(vf).max(-1) / 127.0 + 1e-9
+    k8 = jnp.asarray(np.round(kf / ks[..., None]), jnp.int8)
+    v8 = jnp.asarray(np.round(vf / vs[..., None]), jnp.int8)
+    kv_len = jnp.int32(40)
+    out = jax.jit(lambda *a: flash_decode(
+        a[0], a[1], a[2], kv_len, k_scale=a[3], v_scale=a[4]))(
+            q, k8, v8, jnp.asarray(ks, jnp.float32),
+            jnp.asarray(vs, jnp.float32))
+    ref = attention_cached_ref(
+        q, jnp.asarray(k8, jnp.float32) * ks[..., None],
+        jnp.asarray(v8, jnp.float32) * vs[..., None], kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_kv_update_inplace():
+    """Aliased tile-aligned cache insert == dynamic_update_slice."""
+    from triton_dist_tpu.kernels.flash_attn import kv_update
+    rng = np.random.RandomState(1)
+    c = jnp.asarray(rng.randn(2, 2, 32, 128), jnp.float32)
+    u = jnp.asarray(rng.randn(2, 2, 8, 128), jnp.float32)
+    got = jax.jit(kv_update)(c, u, jnp.int32(2))
+    ref = np.asarray(c).copy()
+    ref[:, :, 16:24] = np.asarray(u)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_engine_int8_decode_close_to_bf16(ctx8):
+    """The full int8 decode configuration (quantize_int8 weights + int8
+    KV cache) must produce prefill logits close to the bf16 engine's —
+    the bandwidth configuration bench.py runs on chip."""
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+    mesh = ctx8.mesh
+    cfg = tiny_qwen3(mesh.shape["tp"])
+    model = AutoLLM.from_config(cfg, mesh)
+    mq = model.quantize_int8()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    engb = Engine(model, max_seq=16, backend="flash")
+    engq = Engine(mq, max_seq=16, backend="flash", kv_dtype=jnp.int8)
+    lb, _ = engb.prefill(ids)
+    lq, cq = engq.prefill(ids)
+    lb = np.asarray(lb, np.float64)
+    lq = np.asarray(lq, np.float64)
+    rel = np.abs(lb - lq).max() / max(np.abs(lb).max(), 1e-9)
+    assert rel < 0.05, rel
+    # and the quantized decode runs end-to-end
+    toks = np.asarray(engq.decode(lq, cq, 4))
+    assert toks.shape == (2, 4)

@@ -33,14 +33,25 @@ from triton_dist_tpu.runtime import interpret_mode
 
 
 def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
-                         partial: bool, len_ref, q_ref, k_ref, v_ref,
-                         *rest):
+                         partial: bool, quant: bool, len_ref, q_ref,
+                         k_ref, v_ref, *rest):
     """Grid (X/bx, T/bt); X = B*Hkv. Online softmax over KV tiles.
 
     partial=False: rest = (o_ref, m_scr, l_scr, acc_scr); writes the
     normalized output. partial=True: rest = (o_ref, m_ref, l_ref,
     m_scr, l_scr, acc_scr); writes UNNORMALIZED f32 acc + (m, l) for an
-    inter-chip LSE combine (reference: flash_decode.py:482)."""
+    inter-chip LSE combine (reference: flash_decode.py:482).
+
+    quant=True: k/v are int8 and rest is prefixed by per-position f32
+    scale refs (ks, vs) [bx, bt]. Dequant is EXACT and costs no extra
+    matmuls: K's scale multiplies the logits column-wise, V's scale
+    folds into p before the PV contraction — the int8->bf16 convert
+    happens in VMEM, so KV HBM traffic is halved (the decode regime is
+    KV-bandwidth-bound at long context)."""
+    if quant:
+        ks_ref, vs_ref, *rest = rest
+    else:
+        ks_ref = vs_ref = None
     if partial:
         o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -66,9 +77,13 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
     def _compute():
         q = q_ref[...]
         k = k_ref[...]
+        if quant:
+            k = k.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale  # [bx, rows, bt]
+        if quant:
+            s = s * ks_ref[...][:, None, :]
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
         # col < T guards the last block's padding when a caller shifts
@@ -82,6 +97,19 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
         p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1)
         vt = v_ref[...]
+        if quant:
+            vt = vt.astype(q.dtype)
+            sv = vs_ref[...]
+            if T % bt:
+                # the trailing partial block's scale pad may be NaN and
+                # p is already zero there — but 0 * NaN = NaN
+                scol = jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1) + start
+                sv = jnp.where(scol < T, sv, 0)
+            # V's per-position scale folds into p (diag(sv) V == V rows
+            # scaled), so the PV dot runs on the raw int8 values. (K's
+            # scale pad needs no guard: a NaN-scaled logit column is
+            # masked by `mask` before it reaches p.)
+            p = p * sv[:, None, :]
         if T % bt:
             # the trailing partial block is PADDED beyond T; the pad may
             # be NaN (the interpreter pads with NaN deliberately) and
@@ -106,15 +134,23 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
 
 
 def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
-             target: int, budget: int = 10 << 20) -> int:
+             target: int, budget: int = 12 << 20,
+             kv_itemsize: Optional[int] = None) -> int:
     """Largest divisor of X under `target` whose pipelined VMEM footprint
-    (double-buffered q/k/v/out blocks + f32 accumulators) fits."""
+    fits: double-buffered q and out blocks (weighted 2x beyond the
+    double-buffering — Mosaic's real allocation at large `rows` exceeds
+    the naive model, observed 17.2M vs a 10M estimate for rows=1280 at
+    bx=4, a compile-time OOM on chip), double-buffered k/v blocks
+    (which may be int8 — kv_itemsize), and the f32 accumulators."""
+    if kv_itemsize is None:
+        kv_itemsize = itemsize
     for bx in range(min(target, X), 0, -1):
         if X % bx:
             continue
-        blocks = 2 * bx * d * (rows * itemsize * 2 + 2 * bt * itemsize)
+        q_out = 2 * 2 * 2 * bx * rows * d * itemsize   # q + out, dbuf, 2x
+        kv = 2 * 2 * bx * bt * d * kv_itemsize         # k + v, dbuf
         scratch = bx * rows * (8 + 4 * d)
-        if blocks + scratch <= budget:
+        if q_out + kv + scratch <= budget:
             return bx
     raise ValueError(
         f"flash_decode: no batch block fits VMEM (rows={rows}, d={d}, "
@@ -124,13 +160,18 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
 
 
 def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
-                 block_x: int = 64, block_t: int = 256):
+                 block_x: int = 64, block_t: int = 256,
+                 k_scale=None, v_scale=None):
     """Cached GQA attention (decode and prefill-into-cache).
 
     q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] (T = static cache capacity);
     kv_len: traced scalar — number of valid KV positions INCLUDING the S
     query positions (query s sits at kv_len - S + s). Returns
     [B, S, Hq, d].
+
+    k_scale/v_scale: per-position dequant scales [B, Hkv, T] f32 for an
+    int8 KV cache (k/v int8); dequant folds into the logits / the P
+    matrix inside the kernel (exact), halving KV HBM traffic.
 
     Reference: flash_decode.py:130 (split-KV GQA kernel) + :308
     (combine); here split-KV partial results live in VMEM scratch and
@@ -149,9 +190,11 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
            .reshape(X, rows, d))
     kx = k.reshape(X, T, d)
     vx = v.reshape(X, T, d)
+    ks = None if k_scale is None else k_scale.reshape(X, T)
+    vs = None if v_scale is None else v_scale.reshape(X, T)
     out = _flash_call(qx, kx, vx, kv_len, kv_len - S, scale=float(scale),
                       rep=rep, S=S, T=T, partial=False, block_x=block_x,
-                      block_t=block_t)
+                      block_t=block_t, ks=ks, vs=vs)
     return (out.reshape(B, Hkv, S, rep, d)
                .transpose(0, 2, 1, 3, 4)
                .reshape(B, S, Hq, d))
@@ -208,12 +251,15 @@ def lse_combine(accs, ms, ls, dtype=None):
 
 
 def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
-                S: int, T: int, partial: bool, block_x: int, block_t: int):
+                S: int, T: int, partial: bool, block_x: int, block_t: int,
+                ks=None, vs=None):
     X, rows, d = qx.shape
+    quant = ks is not None
     bt = min(block_t, T)
-    bx = _pick_bx(X, rows, d, bt, jnp.dtype(qx.dtype).itemsize, block_x)
+    bx = _pick_bx(X, rows, d, bt, jnp.dtype(qx.dtype).itemsize, block_x,
+                  kv_itemsize=jnp.dtype(kx.dtype).itemsize)
     kernel = functools.partial(_flash_decode_kernel, scale, rep, S, T,
-                               partial)
+                               partial, quant)
 
     # KV-tile index map clamps t to the last block containing valid keys:
     # grid steps past kv_len re-request the same block, and the Pallas
@@ -225,8 +271,23 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
         last = jnp.maximum((len_ref[0] + bt - 1) // bt - 1, 0)
         return (x, jnp.minimum(t, last), 0)
 
+    def kvs_map(x, t, len_ref):
+        last = jnp.maximum((len_ref[0] + bt - 1) // bt - 1, 0)
+        return (x, jnp.minimum(t, last))
+
     def q_map(x, t, len_ref):
         return (x, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((bx, rows, d), q_map),
+        pl.BlockSpec((bx, bt, d), kv_map),
+        pl.BlockSpec((bx, bt, d), kv_map),
+    ]
+    args = [qx, kx, vx]
+    if quant:
+        in_specs += [pl.BlockSpec((bx, bt), kvs_map),
+                     pl.BlockSpec((bx, bt), kvs_map)]
+        args += [ks, vs]
 
     if partial:
         out_shape = (jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
@@ -246,11 +307,7 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(X // bx, pl.cdiv(T, bt)),
-            in_specs=[
-                pl.BlockSpec((bx, rows, d), q_map),
-                pl.BlockSpec((bx, bt, d), kv_map),
-                pl.BlockSpec((bx, bt, d), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((bx, rows), jnp.float32),
@@ -260,7 +317,47 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
         ),
         out_shape=out_shape,
         interpret=interpret_mode(),
-    )(scalars, qx, kx, vx)
+    )(scalars, *args)
+
+
+def kv_update(cache, new, tile_pos):
+    """In-place KV-cache row insert at row 8*tile_pos:
+    cache[:, :, 8*tile_pos : 8*tile_pos + S, :] = new, as ONE strided
+    DMA on an ALIASED buffer.
+
+    XLA's dynamic_update_slice on a multi-GB cache carried through the
+    decode scan costs ~30us per 131KB slice (sub-tile scatter +
+    copy-on-write); the aliased Pallas op writes just the rows. The
+    position is passed as a TILE index and multiplied by 8 inside the
+    kernel — Mosaic must statically prove the sublane start is
+    8-aligned, which `t8 * 8` is and a raw traced `pos` is not. S must
+    be a multiple of 8 (whole sublane tiles).
+
+    cache: [B, H, T, d] (any dtype); new: [B, H, S, d]."""
+    S = new.shape[2]
+    assert S % 8 == 0, f"kv_update writes whole 8-row tiles (S={S})"
+
+    def kern(t8_ref, u_ref, c_in_ref, o_ref, sem):
+        del c_in_ref   # the same buffer as o_ref (aliased)
+        cp = pltpu.make_async_copy(
+            u_ref, o_ref.at[:, :, pl.ds(t8_ref[0] * 8, S), :], sem)
+        cp.start()
+        cp.wait()
+
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        ),
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        input_output_aliases={2: 0},
+        interpret=interpret_mode(),
+    )(jnp.asarray(tile_pos, jnp.int32).reshape(1), new, cache)
 
 
 def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):

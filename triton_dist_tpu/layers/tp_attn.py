@@ -141,12 +141,17 @@ class TP_Attn:
         return self._down_psum(o)
 
     def _down_psum(self, o):
-        """Partial O-projection + psum epilogue (the oracle down-proj)."""
+        """Partial O-projection + psum epilogue (the oracle down-proj;
+        w_o may be int8-quantized — the flash decode path)."""
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, self.axis), P(self.axis, None)),
+                           in_specs=(P(None, self.axis),
+                                     qspec(self.w_o, P(self.axis, None),
+                                           P(None))),
                            out_specs=P(None, None), check_vma=False)
         def down(o_loc, wo_loc):
-            return jax.lax.psum(o_loc @ wo_loc, self.axis)
+            return jax.lax.psum(qmm(o_loc, wo_loc), self.axis)
 
         return down(o, self.w_o)
 
@@ -297,30 +302,37 @@ class TP_Attn:
     # models/dense.py:101 + kv_cache.py:29)
     # ------------------------------------------------------------------
 
-    def _attend_cached(self, qkv, cos, sin, batch: int, ck, cv, kv_start,
+    def _attend_cached(self, qkv, cos, sin, batch: int, kv, kv_start,
                        impl: str = "flash"):
         """Split a rank's packed [q|k|v] slice, write K/V into this rank's
         cache shard at kv_start, attend against the cache.
 
         qkv: [B*S, qkv_cols] sharded P(None, tp);
-        ck/cv: [B, Hkv, T, hd] sharded on the head axis;
+        kv: (ck, cv) with ck/cv [B, Hkv, T, hd] sharded on the head axis
+            — or (ck, cv, ks, vs) for an int8 cache with per-position
+            f32 scales [B, Hkv, T] (kv_cache.py kv_dtype=int8; halves
+            the decode step's dominant HBM read);
         kv_start: traced scalar (0 for prefill, pos for decode);
         impl: "flash" (Pallas flash-decode kernel) or "ref" (jnp oracle).
-        Returns (o [B*S, hq_loc*hd] P(None, tp), updated ck, cv).
+        Returns (o [B*S, hq_loc*hd] P(None, tp), updated kv).
         """
         from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
                                                         flash_decode)
         hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
         scale = hd ** -0.5
+        quant = len(kv) == 4
+        cache_spec = P(None, self.axis, None, None)
+        scale_spec = P(None, self.axis, None)
+        kv_specs = ((cache_spec, cache_spec, scale_spec, scale_spec)
+                    if quant else (cache_spec, cache_spec))
 
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
-            in_specs=(P(None, self.axis), P(None, self.axis, None, None),
-                      P(None, self.axis, None, None), P()),
-            out_specs=(P(None, self.axis), P(None, self.axis, None, None),
-                       P(None, self.axis, None, None)),
+            in_specs=(P(None, self.axis),) + kv_specs + (P(),),
+            out_specs=((P(None, self.axis),) + kv_specs),
             check_vma=False)
-        def f(qkv_loc, ck_loc, cv_loc, kv_start):
+        def f(qkv_loc, ck_loc, cv_loc, *rest):
+            *scales, kv_start = rest
             M = qkv_loc.shape[0]
             S = M // batch
             q = qkv_loc[:, :hq * hd].reshape(batch, S, hq, hd)
@@ -335,12 +347,60 @@ class TP_Attn:
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
             # cache layout is head-major [B, Hkv, T, hd]
-            ck_loc = jax.lax.dynamic_update_slice(
-                ck_loc, k.transpose(0, 2, 1, 3).astype(ck_loc.dtype),
-                (0, 0, kv_start, 0))
-            cv_loc = jax.lax.dynamic_update_slice(
-                cv_loc, v.transpose(0, 2, 1, 3).astype(cv_loc.dtype),
-                (0, 0, kv_start, 0))
+            kT = k.transpose(0, 2, 1, 3)
+            vT = v.transpose(0, 2, 1, 3)
+
+            def dus(c, u, idx):
+                return jax.lax.dynamic_update_slice(c, u, idx)
+
+            def insert(c, u, pos):
+                """KV-row insert. Whole-tile writes (S % 8 == 0, e.g.
+                prefill — which starts at offset 0, so pos is 8-aligned)
+                go through the aliased one-DMA kv_update; XLA's DUS on
+                the multi-GB carried buffer costs ~30us per slice.
+                Single-row decode writes stay DUS (sub-tile)."""
+                from triton_dist_tpu.kernels.flash_attn import kv_update
+                if u.shape[2] % 8 == 0:
+                    return kv_update(c, u, pos // 8)
+                return dus(c, u, (0, 0, pos, 0))
+
+            if quant:
+                ks_loc, vs_loc = scales
+
+                def q8(x):   # per-(b, head, position) symmetric int8
+                    xf = x.astype(jnp.float32)
+                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
+                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
+                            s)
+
+                k8, k_s = q8(kT)
+                v8, v_s = q8(vT)
+                ck_loc = insert(ck_loc, k8, kv_start)
+                cv_loc = insert(cv_loc, v8, kv_start)
+                ks_loc = dus(ks_loc, k_s, (0, 0, kv_start))
+                vs_loc = dus(vs_loc, v_s, (0, 0, kv_start))
+                if impl == "flash":
+                    # decode (S==1): one KV tile per x-block — the walk
+                    # is grid-step-latency-bound at small tiles (~2.5us
+                    # fixed cost/step vs ~1us of int8 KV traffic).
+                    # Capped so _pick_bx's double-buffered KV term still
+                    # fits VMEM for long caches (falls back to walking).
+                    bt = min(ck_loc.shape[2], 2048) if S == 1 else 256
+                    o = flash_decode(q.astype(jnp.bfloat16), ck_loc,
+                                     cv_loc, kv_start + S, scale=scale,
+                                     k_scale=ks_loc, v_scale=vs_loc,
+                                     block_t=bt)
+                else:
+                    o = attention_cached_ref(
+                        q.astype(jnp.float32),
+                        ck_loc.astype(jnp.float32) * ks_loc[..., None],
+                        cv_loc.astype(jnp.float32) * vs_loc[..., None],
+                        kv_start + S, scale=scale)
+                return (o.reshape(M, hq * hd).astype(qkv_loc.dtype),
+                        ck_loc, cv_loc, ks_loc, vs_loc)
+
+            ck_loc = insert(ck_loc, kT.astype(ck_loc.dtype), kv_start)
+            cv_loc = insert(cv_loc, vT.astype(cv_loc.dtype), kv_start)
             attend = flash_decode if impl == "flash" else attention_cached_ref
             # cast the [S]-sized query side to the cache dtype — NEVER
             # the [T]-sized cache to the query dtype (a full-cache
@@ -349,13 +409,16 @@ class TP_Attn:
                        kv_start + S, scale=scale)
             return o.reshape(M, hq * hd), ck_loc, cv_loc
 
-        return f(qkv, ck, cv, jnp.asarray(kv_start, jnp.int32))
+        out = f(qkv, *kv, jnp.asarray(kv_start, jnp.int32))
+        return out[0], tuple(out[1:])
 
-    def fwd_cached(self, x, cos, sin, batch: int, ck, cv, kv_start,
+    def fwd_cached(self, x, cos, sin, batch: int, kv, kv_start,
                    mode: str = "dist"):
         """Full attention block with KV cache: QKV proj -> cached attend
         -> O proj, per forward mode. x: [B*S, D] (row-sharded for "dist",
-        replicated otherwise). Returns (y, ck, cv).
+        replicated otherwise). kv: the per-layer cache tuple from
+        KVCache.layer() — (ck, cv) bf16 or (ck, cv, ks, vs) int8.
+        Returns (y, kv).
 
         Modes: "xla" (jnp oracle attention + psum), "flash" (Pallas
         flash-decode attention + psum — the single-chip framework path),
@@ -367,16 +430,20 @@ class TP_Attn:
             ag_ctx = create_ag_gemm_context(self.mesh, axis)
             qkv = ag_gemm(x, self.w_qkv, ag_ctx)
         else:
+            from triton_dist_tpu.kernels.quant import qmm, qspec
+
             @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, None), P(None, axis)),
+                               in_specs=(P(None, None),
+                                         qspec(self.w_qkv, P(None, axis),
+                                               P(axis))),
                                out_specs=P(None, axis), check_vma=False)
             def qkv_local(x_r, w_loc):
-                return x_r @ w_loc
+                return qmm(x_r, w_loc)
 
             qkv = qkv_local(x, self.w_qkv)
 
-        o, ck, cv = self._attend_cached(qkv, cos, sin, batch, ck, cv,
-                                        kv_start, impl)
+        o, kv = self._attend_cached(qkv, cos, sin, batch, kv,
+                                    kv_start, impl)
 
         if mode == "dist":
             rs_ctx = create_gemm_rs_context(self.mesh, axis)
@@ -395,4 +462,4 @@ class TP_Attn:
             y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
         else:  # "xla" oracle and "flash": psum epilogue
             y = self._down_psum(o)
-        return y, ck, cv
+        return y, kv

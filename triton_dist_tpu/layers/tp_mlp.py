@@ -140,18 +140,23 @@ class TP_MLP:
     def fwd_flash(self, x):
         """Single-chip framework path: local GEMMs with the fused Pallas
         SwiGLU kernel between them + psum epilogue (the mode the 1-chip
-        bench runs; comm degenerates, the kernels don't)."""
+        bench runs; comm degenerates, the kernels don't). Weights may be
+        int8-quantized (kernels/quant.py) — the decode bandwidth path."""
+        from triton_dist_tpu.kernels.quant import qmm, qspec
         from triton_dist_tpu.kernels.swiglu import swiglu as swiglu_pallas
         axis = self.axis
 
         import functools
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, None), P(None, axis),
-                                     P(axis, None)),
+                           in_specs=(P(None, None),
+                                     qspec(self.w_gate_up, P(None, axis),
+                                           P(axis)),
+                                     qspec(self.w_down, P(axis, None),
+                                           P(None))),
                            out_specs=P(None, None), check_vma=False)
         def f(x_r, wgu_loc, wd_loc):
-            h = swiglu_pallas(x_r @ wgu_loc)
-            return jax.lax.psum(h @ wd_loc, axis)
+            h = swiglu_pallas(qmm(x_r, wgu_loc))
+            return jax.lax.psum(qmm(h, wd_loc), axis)
 
         return f(x, self.w_gate_up, self.w_down)
 

@@ -140,6 +140,27 @@ class DenseLLM:
                         lm_head=lm_head, cos=cos, sin=sin, config=cfg,
                         mesh=mesh, axis=axis)
 
+    def quantize_int8(self) -> "DenseLLM":
+        """Weight-only int8 copy for the bandwidth-bound decode regime
+        (kernels/quant.py): projection weights and the lm_head become
+        QuantW (int8 + per-column scale), halving the per-step weight
+        read. Valid for the "flash"/"xla" forward modes (qmm dequants
+        after each dot); the comm-kernel modes keep bf16 weights — their
+        Pallas GEMMs stream bf16 operands. Embed stays bf16 (it is a
+        gather, not a GEMM)."""
+        from triton_dist_tpu.kernels.quant import quantize_int8 as q8
+        layers = tuple(
+            dataclasses.replace(
+                ly,
+                attn=dataclasses.replace(ly.attn, w_qkv=q8(ly.attn.w_qkv),
+                                         w_o=q8(ly.attn.w_o)),
+                mlp=dataclasses.replace(ly.mlp,
+                                        w_gate_up=q8(ly.mlp.w_gate_up),
+                                        w_down=q8(ly.mlp.w_down)))
+            for ly in self.layers)
+        return dataclasses.replace(self, layers=layers,
+                                   lm_head=q8(self.lm_head))
+
     # ------------------------------------------------------------------
     # forward
     # ------------------------------------------------------------------
@@ -158,11 +179,11 @@ class DenseLLM:
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         kv_start = cache.offset
         for li, layer in enumerate(self.layers):
-            ck, cv = cache.layer(li)
+            kv = cache.layer(li)
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, ck, cv = layer.attn.fwd_cached(
-                h, self.cos, self.sin, B, ck, cv, kv_start, mode)
-            cache = cache.set_layer(li, ck, cv)
+            a, kv = layer.attn.fwd_cached(
+                h, self.cos, self.sin, B, kv, kv_start, mode)
+            cache = cache.set_layer(li, kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
             x = x + layer.mlp(h, mlp_mode)
@@ -173,9 +194,12 @@ class DenseLLM:
             x = self._gather_rows(x)
         last = x.reshape(B, S, -1)[:, -1]
         # bf16 x bf16 -> f32 on the MXU; casting the [D, V] weight to f32
-        # would materialize (and re-read) gigabytes per decode step
-        logits = jnp.dot(last, self.lm_head,
-                         preferred_element_type=jnp.float32)
+        # would materialize (and re-read) gigabytes per decode step.
+        # lm_head may be int8-quantized (the single biggest weight read
+        # of a decode step) — qmm dequants after the dot.
+        from triton_dist_tpu.kernels.quant import qmm
+        logits = qmm(last, self.lm_head,
+                     preferred_element_type=jnp.float32)
         return logits, cache
 
     def forward_train(self, ids, mode: str = "train"):

@@ -40,11 +40,21 @@ from triton_dist_tpu.models.kv_cache import KVCache
 
 class Engine:
     def __init__(self, model, *, max_seq: int = 256, backend: str = "gemm_ar",
-                 prefill_backend: Optional[str] = None):
+                 prefill_backend: Optional[str] = None,
+                 kv_dtype=None):
+        """kv_dtype=jnp.int8 stores the KV cache quantized (per-position
+        scales; kv_cache.py) — half the decode step's dominant HBM read.
+        Pair with model.quantize_int8() for the full bandwidth-bound
+        decode configuration."""
         self.model = model
         self.max_seq = max_seq
         self.backend = backend
+        self.kv_dtype = kv_dtype
         if backend == "mega":
+            if kv_dtype is not None:
+                raise ValueError(
+                    "backend='mega' reads the KV cache directly and has "
+                    "no dequant path; use the default bf16 cache")
             if model.mesh.size != 1:
                 raise ValueError(
                     "backend='mega' is the single-chip megakernel decode "
@@ -76,7 +86,8 @@ class Engine:
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
         input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
-        cache = self.model.make_cache(input_ids.shape[0], self.max_seq)
+        cache = self.model.make_cache(input_ids.shape[0], self.max_seq,
+                                      dtype=self.kv_dtype)
         return self._prefill(self.model, input_ids, cache)
 
     def decode(self, logits, cache, gen_len: int):

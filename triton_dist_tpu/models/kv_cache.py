@@ -33,28 +33,55 @@ class KVCache:
     k: Tuple[jax.Array, ...]   # L x [B, Hkv, T, hd]
     v: Tuple[jax.Array, ...]
     offset: jax.Array  # scalar int32: number of valid positions
+    # int8 cache only: per-position dequant scales, L x [B, Hkv, T] f32
+    # (empty tuples for the bf16 cache — a pytree-stable "absent")
+    ks: Tuple[jax.Array, ...] = ()
+    vs: Tuple[jax.Array, ...] = ()
 
     @staticmethod
     def create(num_layers: int, batch: int, max_seq: int, n_kv_heads: int,
                head_dim: int, *, mesh: Mesh, axis: str = "tp",
                dtype=jnp.bfloat16) -> "KVCache":
+        """dtype=jnp.int8 stores K/V quantized with per-position scales
+        — half the HBM read of the decode step's dominant traffic; the
+        flash kernel dequants exactly via logit/P scaling
+        (kernels/flash_attn.py)."""
         shape = (batch, n_kv_heads, max_seq, head_dim)
         sharding = NamedSharding(mesh, P(None, axis, None, None))
         k = tuple(jax.device_put(jnp.zeros(shape, dtype), sharding)
                   for _ in range(num_layers))
         v = tuple(jax.device_put(jnp.zeros(shape, dtype), sharding)
                   for _ in range(num_layers))
-        return KVCache(k=k, v=v, offset=jnp.int32(0))
+        ks = vs = ()
+        if jnp.dtype(dtype) == jnp.int8:
+            s_shd = NamedSharding(mesh, P(None, axis, None))
+            mk = lambda: tuple(
+                jax.device_put(jnp.zeros(shape[:3], jnp.float32), s_shd)
+                for _ in range(num_layers))
+            ks, vs = mk(), mk()
+        return KVCache(k=k, v=v, offset=jnp.int32(0), ks=ks, vs=vs)
+
+    @property
+    def quantized(self) -> bool:
+        return bool(self.ks)
 
     def layer(self, idx: int):
-        """Per-layer buffers passed into TP_Attn.fwd_cached."""
+        """Per-layer cache tuple passed into TP_Attn.fwd_cached:
+        (k, v) or (k, v, ks, vs) when int8."""
+        if self.quantized:
+            return (self.k[idx], self.v[idx], self.ks[idx], self.vs[idx])
         return self.k[idx], self.v[idx]
 
-    def set_layer(self, idx: int, ck, cv) -> "KVCache":
-        return dataclasses.replace(
-            self,
-            k=self.k[:idx] + (ck,) + self.k[idx + 1:],
-            v=self.v[:idx] + (cv,) + self.v[idx + 1:])
+    def set_layer(self, idx: int, kv) -> "KVCache":
+        def put(t, x):
+            return t[:idx] + (x,) + t[idx + 1:]
+
+        out = dataclasses.replace(
+            self, k=put(self.k, kv[0]), v=put(self.v, kv[1]))
+        if len(kv) == 4:
+            out = dataclasses.replace(out, ks=put(self.ks, kv[2]),
+                                      vs=put(self.vs, kv[3]))
+        return out
 
     def advance(self, n) -> "KVCache":
         return dataclasses.replace(self, offset=self.offset + n)

@@ -177,11 +177,11 @@ class Qwen3MoE:
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         kv_start = cache.offset
         for li, layer in enumerate(self.layers):
-            ck, cv = cache.layer(li)
+            kv = cache.layer(li)
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, ck, cv = layer.attn.fwd_cached(
-                h, self.cos, self.sin, B, ck, cv, kv_start, attn_mode)
-            cache = cache.set_layer(li, ck, cv)
+            a, kv = layer.attn.fwd_cached(
+                h, self.cos, self.sin, B, kv, kv_start, attn_mode)
+            cache = cache.set_layer(li, kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
             x = x + layer.moe(h, moe_mode).astype(x.dtype)
