@@ -138,3 +138,54 @@ def test_tp_moe_fused_ar_vs_xla(ctx8, k):
         out = moe(x, mode="fused_ar")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ep_moe_dropless_or_loud(ctx8):
+    """Adversarial routing that WOULD drop at default capacity: the
+    stats counter reports it (loud); capacity_factor='dropless' sizes
+    the worst-case buffers, drops nothing, and matches the dense
+    oracle exactly (reference semantics: the splits exchange never
+    drops, ep_a2a.py:382)."""
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, D, I, T = n, 16, 8, 4 * n
+    rng = np.random.RandomState(0)
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 10.0   # all tokens -> expert 0
+    _, wg, wu, wd = _make_weights(rng, E, D, I)
+    x = jnp.asarray(np.abs(rng.randn(T, D)) + 0.1, jnp.float32)
+
+    lossy = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp",
+                        top_k=1, capacity_factor=0.01)
+    y, stats = lossy.fwd_ep(x, return_stats=True, warn_drops=False)
+    assert int(stats["dropped"]) > 0   # the counter is LOUD about it
+
+    dropless = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp",
+                           top_k=1, capacity_factor="dropless")
+    with jax.default_matmul_precision("highest"):
+        y2, stats2 = dropless.fwd_ep(x, return_stats=True)
+        ref = dropless.fwd_xla(x)
+    assert int(stats2["dropped"]) == 0
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_tp_moe_dropless_capacity(ctx8):
+    """TP-MoE 'dropless' capacity: adversarial routing matches the
+    dense oracle (no silent drops at the capacity clamp)."""
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, D, I = 4, 16, 4 * n
+    M = 4 * n
+    rng = np.random.RandomState(3)
+    router = np.zeros((D, E), np.float32)
+    router[:, 1] = 10.0
+    _, wg, wu, wd = _make_weights(rng, E, D, I)
+    moe = TP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp", top_k=2,
+                      capacity_factor="dropless")
+    x = jnp.asarray(np.abs(rng.randn(M, D)) + 0.1, jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = moe.fwd_xla(x)
+        out = moe.fwd_dist(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -102,12 +102,35 @@ class DispatchPlan:
     valid: jax.Array    # [T*k] bool — False = dropped by capacity
     token: jax.Array    # [T*k] source token row
 
+    @property
+    def dropped(self) -> jax.Array:
+        """Per-step count of routed entries this rank dropped by
+        capacity — the loud half of dropless-or-loud. The reference
+        never drops (it sizes buffers from an exact splits exchange,
+        ep_a2a.py:382); the static-capacity redesign must therefore
+        either COUNT its drops or be run with dropless capacities
+        (EP_MoE capacity_factor='dropless')."""
+        return jnp.sum(~self.valid).astype(jnp.int32)
+
+
+def warn_on_drops(dropped, where: str):
+    """In-program loud warning when a capacity drop occurred (traced
+    scalar; prints only on the steps that actually drop)."""
+    def _warn(d):
+        jax.debug.print(
+            "WARNING {w}: {d} routed entries dropped by expert capacity "
+            "this step — raise capacity_factor or use 'dropless'",
+            w=where, d=d)
+
+    jax.lax.cond(dropped > 0, _warn, lambda d: None, dropped)
+
 
 def plan_dispatch(topk_idx, n: int, experts_per_rank: int, cap: int
                   ) -> DispatchPlan:
     """Assign each routed (token, k) entry a slot in the per-destination
     capacity layout. Entries beyond a destination's capacity are dropped
-    (their combine weight contribution becomes 0)."""
+    (their combine weight contribution becomes 0; plan.dropped counts
+    them — callers surface it via warn_on_drops / return_stats)."""
     T, k = topk_idx.shape
     flat_e = topk_idx.reshape(-1)
     dest = flat_e // experts_per_rank                       # [T*k]
@@ -182,7 +205,9 @@ def group_by_expert(recv_x, recv_meta, experts_per_rank: int,
     """Arrange received tokens into capacity-padded per-expert batches
     for the grouped GEMM. Returns (x_e [E_loc, expert_cap, D],
     inv_slot [n*cap] — where each recv slot's result lives in the
-    flattened [E_loc*expert_cap] expert layout, n*cap.. = dropped)."""
+    flattened [E_loc*expert_cap] expert layout, n*cap.. = dropped,
+    dropped — count of VALID arrivals that exceeded expert_cap, the
+    receiver-side analog of DispatchPlan.dropped)."""
     R, D = recv_x.shape
     e = jnp.where(recv_meta[:, 1] > 0, recv_meta[:, 0], experts_per_rank)
     order = jnp.argsort(e, stable=True)
@@ -200,7 +225,8 @@ def group_by_expert(recv_x, recv_meta, experts_per_rank: int,
             experts_per_rank, expert_cap, D)
     inv = jnp.argsort(order, stable=True)
     inv_slot = eslot_sorted[inv]
-    return x_e, inv_slot
+    dropped = jnp.sum((sorted_e < experts_per_rank) & ~ok).astype(jnp.int32)
+    return x_e, inv_slot, dropped
 
 
 def group_tokens_by_expert(x, topk_idx, num_experts: int, cap: int):
@@ -350,7 +376,10 @@ def ep_dispatch_combine(x, router_logits, k: int,
                                               epr, cap)
         recv_x, recv_meta = dispatch_a2a(send_x, send_meta, n=n, axis=axis,
                                          collective_id=cid)
-        x_e, inv_slot = group_by_expert(recv_x, recv_meta, epr, e_cap)
+        x_e, inv_slot, r_drop = group_by_expert(recv_x, recv_meta, epr,
+                                                e_cap)
+        # dropless-or-loud on the public entry point too
+        warn_on_drops(plan.dropped + r_drop, "ep_dispatch_combine")
         if expert_fn is not None:
             x_e = expert_fn(x_e)
         y_flat = x_e.reshape(epr * e_cap, -1)

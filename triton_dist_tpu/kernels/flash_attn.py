@@ -135,7 +135,8 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
 
 def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
              target: int, budget: int = 12 << 20,
-             kv_itemsize: Optional[int] = None) -> int:
+             kv_itemsize: Optional[int] = None,
+             partial: bool = False) -> int:
     """Largest divisor of X under `target` whose pipelined VMEM footprint
     fits: double-buffered q and out blocks (weighted 2x beyond the
     double-buffering — Mosaic's real allocation at large `rows` exceeds
@@ -146,6 +147,11 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
         kv_itemsize = itemsize
     for bx in range(min(target, X), 0, -1):
         if X % bx:
+            continue
+        if partial and X > 8 and bx % 8 and bx != X:
+            # partial mode writes (bx, rows) m/l blocks whose
+            # second-to-minor dim is bx: Mosaic needs it 8-aligned
+            # (or the full dim)
             continue
         q_out = 2 * 2 * 2 * bx * rows * d * itemsize   # q + out, dbuf, 2x
         kv = 2 * 2 * bx * bt * d * kv_itemsize         # k + v, dbuf
@@ -257,7 +263,8 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
     quant = ks is not None
     bt = min(block_t, T)
     bx = _pick_bx(X, rows, d, bt, jnp.dtype(qx.dtype).itemsize, block_x,
-                  kv_itemsize=jnp.dtype(kx.dtype).itemsize)
+                  kv_itemsize=jnp.dtype(kx.dtype).itemsize,
+                  partial=partial)
     kernel = functools.partial(_flash_decode_kernel, scale, rep, S, T,
                                partial, quant)
 

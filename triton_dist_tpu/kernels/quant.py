@@ -65,10 +65,3 @@ def qmm(x, w, *, preferred_element_type=None):
     if preferred_element_type is None:
         return x @ w
     return jnp.dot(x, w, preferred_element_type=preferred_element_type)
-
-
-def qslice_cols(w, lo: int, hi: int):
-    """Column-slice a maybe-quantized weight (both q and its scales)."""
-    if isinstance(w, QuantW):
-        return QuantW(q=w.q[:, lo:hi], s=w.s[lo:hi])
-    return w[:, lo:hi]

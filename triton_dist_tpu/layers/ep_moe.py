@@ -72,19 +72,40 @@ class EP_MoE:
 
     def _caps(self, t_loc: int):
         """(pair capacity, per-expert capacity): static shapes standing in
-        for the reference's splits exchange."""
+        for the reference's splits exchange.
+
+        capacity_factor='dropless' sizes both to their provable
+        worst-case bounds (every routed entry of a rank to one
+        destination / one expert), trading memory for the reference's
+        never-drop semantics (its exact splits exchange, ep_a2a.py:382)
+        under static shapes. Any float factor is the fast capacity trade
+        — then drops are COUNTED (DispatchPlan.dropped,
+        group_by_expert's third output) and warned in-program."""
         n = self.mesh.shape[self.axis]
         epr = self.num_experts // n
+        if self.capacity_factor == "dropless":
+            # all of a rank's entries to one destination / one expert;
+            # rounded up to whole 8-row sublane tiles — the a2a kernels
+            # slice send buffers at pl.ds(p * cap, cap), which Mosaic
+            # requires tile-aligned on real TPUs
+            pair = -(-t_loc * self.top_k // 8) * 8
+            return pair, n * pair
         pair = int(self.capacity_factor * self.top_k * t_loc / n) + 1
         pair = min(max(8, -(-pair // 8) * 8), t_loc * self.top_k)
         e_cap = int(self.capacity_factor * n * pair / epr) + 1
         e_cap = min(max(8, -(-e_cap // 8) * 8), n * pair)
         return pair, e_cap
 
-    def fwd_ep(self, x, disp=None, comb=None, gemm=None):
+    def fwd_ep(self, x, disp=None, comb=None, gemm=None,
+               return_stats: bool = False, warn_drops: bool = True):
         """x: [T, D] row-sharded over the ep axis -> same sharding.
         disp/comb/gemm swap the a2a and grouped-GEMM callables (the
-        train path passes the custom-VJP wrappers)."""
+        train path passes the custom-VJP wrappers).
+
+        return_stats=True additionally returns {"dropped": scalar} — the
+        global count of routed entries lost to capacity this step
+        (always 0 with capacity_factor='dropless'); warn_drops keeps an
+        in-program warning on the others (dropless-or-loud)."""
         n = self.mesh.shape[self.axis]
         axis = self.axis
         epr = self.num_experts // n
@@ -105,7 +126,7 @@ class EP_MoE:
             jax.shard_map, mesh=self.mesh,
             in_specs=(P(axis, None), P(None, None),
                       P(axis, None, None), P(axis, None, None)),
-            out_specs=P(axis, None), check_vma=False)
+            out_specs=(P(axis, None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             t_loc = x_loc.shape[0]
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
@@ -113,7 +134,8 @@ class EP_MoE:
             send_x, send_meta = fill_send_buffers(x_loc, topk_idx, plan,
                                                   n, epr, cap)
             recv_x, recv_meta = disp(send_x, send_meta)
-            x_e, inv_slot = group_by_expert(recv_x, recv_meta, epr, e_cap)
+            x_e, inv_slot, r_drop = group_by_expert(recv_x, recv_meta,
+                                                    epr, e_cap)
             h = gemm(x_e, wgu_loc.astype(x_e.dtype))
             h = swiglu_ref(h)
             y_e = gemm(h, wd_loc.astype(x_e.dtype))
@@ -125,9 +147,21 @@ class EP_MoE:
                 gathered.dtype)
             y_back = comb(y_slots)
             y = combine_from_slots(y_back, plan, topk_w, t_loc)
-            return y.astype(x_loc.dtype)
+            loud = (warn_drops and self.capacity_factor != "dropless")
+            if loud or return_stats:
+                dropped = jax.lax.psum(plan.dropped + r_drop, axis)
+                if loud:
+                    from triton_dist_tpu.kernels.ep_a2a import warn_on_drops
+                    warn_on_drops(dropped, "EP_MoE.fwd_ep")
+            else:
+                # no observer: skip the per-step cross-rank scalar psum
+                dropped = jnp.zeros((), jnp.int32)
+            return y.astype(x_loc.dtype), dropped[None]
 
-        return _f(x, self.w_router, self.w_gate_up, self.w_down)
+        y, dropped = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        if return_stats:
+            return y, {"dropped": dropped[0]}
+        return y
 
     def fwd_xla(self, x):
         """Oracle (x row-sharded): dense all-experts math with XLA

@@ -354,15 +354,22 @@ class TP_Attn:
                 return jax.lax.dynamic_update_slice(c, u, idx)
 
             def insert(c, u, pos):
-                """KV-row insert. Whole-tile writes (S % 8 == 0, e.g.
-                prefill — which starts at offset 0, so pos is 8-aligned)
+                """KV-row insert. Tile-aligned whole-tile writes
+                (S % 8 == 0 AND pos % 8 == 0 — e.g. prefill at offset 0)
                 go through the aliased one-DMA kv_update; XLA's DUS on
                 the multi-GB carried buffer costs ~30us per slice.
-                Single-row decode writes stay DUS (sub-tile)."""
+                pos is traced, so the alignment pick is a lax.cond —
+                an unaligned multi-row write (chunked prefill at an odd
+                offset) falls back to the correct DUS instead of
+                silently flooring to a tile boundary."""
                 from triton_dist_tpu.kernels.flash_attn import kv_update
-                if u.shape[2] % 8 == 0:
-                    return kv_update(c, u, pos // 8)
-                return dus(c, u, (0, 0, pos, 0))
+                if u.shape[2] % 8:
+                    return dus(c, u, (0, 0, pos, 0))
+                return jax.lax.cond(
+                    pos % 8 == 0,
+                    lambda c_, u_, p: kv_update(c_, u_, p // 8),
+                    lambda c_, u_, p: dus(c_, u_, (0, 0, p, 0)),
+                    c, u, pos)
 
             if quant:
                 ks_loc, vs_loc = scales

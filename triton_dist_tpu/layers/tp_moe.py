@@ -89,7 +89,13 @@ class TP_MoE:
 
     def _cap(self, M: int) -> int:
         """Static per-expert capacity (reference analog: the max_M-sized
-        symmetric workspaces)."""
+        symmetric workspaces). capacity_factor='dropless' uses the
+        provable worst case (all routed entries on one expert) — never
+        drops, at the memory price of the bound."""
+        if self.capacity_factor == "dropless":
+            # rounded up to whole 8-row tiles (kernel slab slices must
+            # stay sublane-aligned on real TPUs)
+            return -(-M * self.top_k // 8) * 8
         E = self.num_experts
         c = int(self.capacity_factor * self.top_k * M / E) + 1
         return min(max(8, -(-c // 8) * 8), M * self.top_k)

@@ -50,6 +50,13 @@ class Engine:
         self.max_seq = max_seq
         self.backend = backend
         self.kv_dtype = kv_dtype
+        from triton_dist_tpu.kernels.quant import QuantW
+        w0 = model.layers[0].attn.w_qkv if model.layers else None
+        if isinstance(w0, QuantW) and backend not in ("flash", "xla"):
+            raise ValueError(
+                f"backend={backend!r} runs the comm-kernel GEMMs, which "
+                "stream bf16 weight operands; int8-quantized models "
+                "(quantize_int8) support the 'flash'/'xla' backends only")
         if backend == "mega":
             if kv_dtype is not None:
                 raise ValueError(

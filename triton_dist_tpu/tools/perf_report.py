@@ -252,6 +252,30 @@ def run_report(write_json=None):
     add("gdn_fwd(pallas)",
         lambda u: gdn_fwd(u, kg, vg, gg, bg, chunk=C)[0], qg, gdn_sol)
 
+    # SP ring attention: fused one-kernel shmem ring vs the XLA-permute
+    # ring (at ndev=1 the ring degenerates to the local block — the row
+    # then times the fused kernel's tile engine, comm-free)
+    from triton_dist_tpu.kernels.sp_attention import sp_ring_attention
+    # rows kept small enough for BOTH modes' tilings (the XLA-permute
+    # partial path needs an 8-aligned batch block)
+    Bs, Hqs, Hkvs, Ss, ds = (2, 16, 16, 256, 128) if on_tpu else \
+                            (1, 2, 2, 8 * n, 32)
+    qr = jnp.asarray(rng.randn(Bs, Ss, Hqs, ds), dt) * 0.3
+    kr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
+    vr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
+    qr = jax.device_put(qr, NamedSharding(mesh, P(None, "tp", None, None)))
+    kr = jax.device_put(kr, NamedSharding(mesh, P(None, None, "tp", None)))
+    vr = jax.device_put(vr, NamedSharding(mesh, P(None, None, "tp", None)))
+    ring_flops = 2 * 2 * Bs * Hqs * Ss * Ss * ds / 2  # qk+pv, causal half
+    ring_sol = ring_flops / (spec.bf16_tflops * 1e12) * 1e6
+    for ring_mode in ("ring_shmem", "ring"):
+        add(f"sp_ring({ring_mode})",
+            (lambda mm: lambda u: u + 1e-30 * jnp.sum(
+                sp_ring_attention(u, kr, vr, mesh=mesh, axis="tp",
+                                  mode=mm), dtype=jnp.float32
+                ).astype(u.dtype))(ring_mode),
+            qr, ring_sol)
+
     header = {"backend": jax.default_backend(), "ndev": ndev,
               "chip": spec.name, "interpreted": not on_tpu}
     out = {"env": header, "ops": rows}
