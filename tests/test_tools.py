@@ -119,3 +119,76 @@ def test_perf_models_sanity():
     assert collective_sol_us("ag", 1 << 20, 1, spec=spec) == 0.0
     line = sol_report("ag_gemm", 100.0, 80.0)
     assert "80.0" in line and "%" in line
+
+
+def test_kernel_context_tune_cold_and_warm(cache_path, monkeypatch):
+    """The wired path (VERDICT r2 #7): create_ag_gemm_context(tune=True)
+    cold-tunes over the block space and caches; a second creation with
+    the same signature replays the cached winner without re-timing."""
+    import json
+    import os
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE", cache_path)
+    import jax
+    from triton_dist_tpu.kernels import ag_gemm, create_ag_gemm_context
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+    K, N_loc = 128, 128
+    ctx = create_ag_gemm_context(mesh, K=K, N_local=N_loc,
+                                 dtype=jnp.float32, tune=True, tune_M=8 * n)
+    assert ctx.block_n in (256, 512, 1024, 2048)
+    cache = json.load(open(cache_path))
+    assert any("ag_gemm" in k for k in cache)      # cold run cached
+    mtime = os.path.getmtime(cache_path)
+    ctx2 = create_ag_gemm_context(mesh, K=K, N_local=N_loc,
+                                  dtype=jnp.float32, tune=True,
+                                  tune_M=8 * n)
+    assert ctx2.block_n == ctx.block_n             # warm run hits
+    assert os.path.getmtime(cache_path) == mtime   # ...without rewriting
+    # and the tuned context actually computes correctly
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(8 * n, K), jnp.float32)
+    b = jnp.asarray(rng.randn(K, N_loc * n), jnp.float32)
+    a_s = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
+    b_s = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
+    with jax.default_matmul_precision("highest"):
+        y = jax.jit(lambda x, w: ag_gemm(x, w, ctx))(a_s, b_s)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(a @ b),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_contextual_autotune_profiles_nested_kernels(cache_path,
+                                                     monkeypatch):
+    """contextual_autotune (reference autotuner.py:97): tunes a nested
+    kernel inside a composite forward; the winner is installed in the
+    profile the kernel default consults, cached, and replayed."""
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE", cache_path)
+    import jax
+    import numpy as np
+    from triton_dist_tpu.kernels import flash_decode
+    from triton_dist_tpu.tools.tune import (contextual_autotune,
+                                            contextual_choice,
+                                            set_contextual)
+    set_contextual({})
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 1, 4, 128), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 64, 128), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 64, 128), jnp.float32)
+
+    def composite(q, k, v):
+        o = flash_decode(q, k, v, jnp.int32(64))
+        return jnp.sum(o.astype(jnp.float32))
+
+    vary = {"flash_decode": [{"block_t": 32}, {"block_t": 64}]}
+    prof = contextual_autotune(composite, (q, k, v), vary,
+                               name="test_layer")
+    assert prof["flash_decode"]["block_t"] in (32, 64)
+    assert contextual_choice("flash_decode") == prof["flash_decode"]
+    # warm: the cached profile is returned without re-timing
+    set_contextual({})
+    prof2 = contextual_autotune(composite, (q, k, v), vary,
+                                name="test_layer")
+    assert prof2 == prof
+    assert contextual_choice("flash_decode") == prof["flash_decode"]
+    set_contextual({})

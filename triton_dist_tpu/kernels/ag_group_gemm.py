@@ -151,7 +151,7 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
 
 
 def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
-                  block_n: int = 512,
+                  block_n: Optional[int] = None,
                   collective_id: Optional[int] = None,
                   resident_b: Optional[bool] = None):
     """y[e] = allgather(x_e[e]) @ w[e] for every expert, overlapped
@@ -167,6 +167,12 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     c_loc, n_loc = capT // n, N // n
     if collective_id is None:
         collective_id = next_collective_id()
+    if block_n is None:
+        from triton_dist_tpu.tools.tune import contextual_choice
+        prof = contextual_choice("ag_group_gemm") or {}
+        block_n = prof.get("block_n", 512)
+        if resident_b is None and "resident_b" in prof:
+            resident_b = prof["resident_b"]
     bn = _divisor_block(n_loc, block_n)
     # when every expert's whole panel fits VMEM alongside the a/o tiles,
     # hold B resident across ring steps (loaded once, not n times)

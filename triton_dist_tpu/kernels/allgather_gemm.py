@@ -77,17 +77,52 @@ def create_ag_gemm_context(mesh: Mesh, axis: str = "tp", *,
                            dtype=jnp.bfloat16,
                            block_n: Optional[int] = None,
                            collective_id: Optional[int] = None,
+                           tune: bool = False, tune_M: int = 256,
                            ) -> AllGatherGEMMTensorParallelContext:
-    """Reference: create_ag_gemm_context (allgather_gemm.py:447+)."""
+    """Reference: create_ag_gemm_context (allgather_gemm.py:447+).
+
+    block_n resolution order: explicit arg > tune=True (AutoTuner over
+    the block space on synthetic [tune_M, K] @ [K, n*N_local] inputs,
+    cached by shape+chip with cross-process consensus — the reference's
+    @autotune on ag_gemm, allgather_gemm.py:563) > an installed
+    contextual profile entry ("ag_gemm") > the VMEM-fit heuristic."""
+    n = mesh.shape[axis]
+    if block_n is None and tune:
+        assert K is not None and N_local is not None, \
+            "tune=True needs K and N_local"
+        block_n = _tune_block_n(mesh, axis, tune_M, K, N_local, dtype)
+    if block_n is None:
+        from triton_dist_tpu.tools.tune import contextual_choice
+        prof = contextual_choice("ag_gemm")
+        if prof is not None:
+            block_n = prof.get("block_n")
     if block_n is None:
         if K is not None and N_local is not None:
             block_n = _pick_block_n(K, N_local, jnp.dtype(dtype).itemsize)
         else:
             block_n = 512
     return AllGatherGEMMTensorParallelContext(
-        mesh=mesh, axis=axis, n=mesh.shape[axis], block_n=block_n,
+        mesh=mesh, axis=axis, n=n, block_n=block_n,
         collective_id=(collective_id if collective_id is not None
                        else next_collective_id()))
+
+
+def _tune_block_n(mesh: Mesh, axis: str, M: int, K: int, N_local: int,
+                  dtype) -> int:
+    """Eager AutoTuner pass over ag_gemm's block space (called once per
+    (shape, chip) — the winner comes from the JSON cache afterwards)."""
+    from triton_dist_tpu.tools.tune import tune_comm_gemm_block_n
+    n = mesh.shape[axis]
+
+    def make_op(block_n):
+        ctx = AllGatherGEMMTensorParallelContext(
+            mesh=mesh, axis=axis, n=n, block_n=block_n,
+            collective_id=next_collective_id())
+        return lambda x, w: ag_gemm(x, w, ctx)
+
+    return tune_comm_gemm_block_n(
+        "ag_gemm", mesh, axis, M, K, N_local * n, dtype,
+        P(axis, None), P(None, axis), make_op)
 
 
 def _ag_gemm_kernel(n: int, axis: str, block_n: int,

@@ -148,10 +148,10 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
     for bx in range(min(target, X), 0, -1):
         if X % bx:
             continue
-        if partial and X > 8 and bx % 8 and bx != X:
+        if partial and bx % 8 and bx != X:
             # partial mode writes (bx, rows) m/l blocks whose
             # second-to-minor dim is bx: Mosaic needs it 8-aligned
-            # (or the full dim)
+            # (only a FULL-dim block is exempt)
             continue
         q_out = 2 * 2 * 2 * bx * rows * d * itemsize   # q + out, dbuf, 2x
         kv = 2 * 2 * bx * bt * d * kv_itemsize         # k + v, dbuf
@@ -166,7 +166,8 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
 
 
 def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
-                 block_x: int = 64, block_t: int = 256,
+                 block_x: Optional[int] = None,
+                 block_t: Optional[int] = None,
                  k_scale=None, v_scale=None):
     """Cached GQA attention (decode and prefill-into-cache).
 
@@ -188,6 +189,16 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     rep = Hq // Hkv
     if scale is None:
         scale = d ** -0.5
+    if block_x is None or block_t is None:
+        # callers that do not pin the blocks take the installed
+        # contextual profile (tools/tune.contextual_autotune) or the
+        # static defaults
+        from triton_dist_tpu.tools.tune import contextual_choice
+        prof = contextual_choice("flash_decode") or {}
+        block_x = block_x if block_x is not None else prof.get("block_x",
+                                                               64)
+        block_t = block_t if block_t is not None else prof.get("block_t",
+                                                               256)
     X = B * Hkv
     rows = S * rep
     # queries grouped by kv head: [B, S, Hkv, rep, d] -> [X, rows, d]

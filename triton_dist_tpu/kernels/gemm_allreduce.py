@@ -44,11 +44,34 @@ class GemmARContext:
 
 
 def create_gemm_ar_context(mesh: Mesh, axis: str = "tp", *,
-                           block_n: int = 512,
+                           block_n: Optional[int] = None,
                            collective_id: Optional[int] = None,
+                           tune: bool = False, M: Optional[int] = None,
+                           K: Optional[int] = None,
+                           N: Optional[int] = None, dtype=jnp.bfloat16,
                            ) -> GemmARContext:
+    """block_n: explicit > tune=True (AutoTuner over the block space on
+    synthetic shapes, JSON-cached; the reference's @autotune on
+    gemm_allreduce_op) > contextual profile ("gemm_ar") > 512."""
+    n = mesh.shape[axis]
+    if block_n is None and tune:
+        assert None not in (M, K, N), "tune=True needs M, K, N"
+        from triton_dist_tpu.tools.tune import tune_comm_gemm_block_n
+
+        def make_op(bn):
+            ctx = GemmARContext(mesh=mesh, axis=axis, n=n, block_n=bn,
+                                collective_id=next_collective_id())
+            return lambda x, w: gemm_allreduce(x, w, ctx)
+
+        block_n = tune_comm_gemm_block_n(
+            "gemm_ar", mesh, axis, M, K, N, dtype,
+            P(None, axis), P(axis, None), make_op)
+    if block_n is None:
+        from triton_dist_tpu.tools.tune import contextual_choice
+        prof = contextual_choice("gemm_ar")
+        block_n = (prof or {}).get("block_n", 512)
     return GemmARContext(
-        mesh=mesh, axis=axis, n=mesh.shape[axis], block_n=block_n,
+        mesh=mesh, axis=axis, n=n, block_n=block_n,
         collective_id=(collective_id if collective_id is not None
                        else next_collective_id()))
 

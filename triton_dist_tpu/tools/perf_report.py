@@ -258,8 +258,10 @@ def run_report(write_json=None):
     from triton_dist_tpu.kernels.sp_attention import sp_ring_attention
     # rows kept small enough for BOTH modes' tilings (the XLA-permute
     # partial path needs an 8-aligned batch block)
+    # d=128 in BOTH substrates: smaller d fails ring_shmem's alignment
+    # gate and would silently time the XLA ring under the shmem label
     Bs, Hqs, Hkvs, Ss, ds = (2, 16, 16, 256, 128) if on_tpu else \
-                            (1, 2, 2, 8 * n, 32)
+                            (1, 2, 2, 8 * n, 128)
     qr = jnp.asarray(rng.randn(Bs, Ss, Hqs, ds), dt) * 0.3
     kr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
     vr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3

@@ -213,3 +213,114 @@ def autotune(configs: Sequence[Dict[str, Any]], *,
         functools.update_wrapper(tuner, fn, updated=())
         return tuner
     return wrap
+
+
+# ----------------------------------------------------------------------
+# Contextual autotuning (reference: autotuner.py:97 contextual_autotune)
+# ----------------------------------------------------------------------
+#
+# A process-global tuning PROFILE that kernels consult at trace time:
+# context creators and op defaults read their entry (by kernel name)
+# when the caller did not pin a config. `contextual_autotune` times a
+# COMPOSITE function (a layer forward, an engine step) end-to-end for
+# each candidate config of each nested kernel — coordinate descent, one
+# kernel at a time, freshly jitted per candidate so the profile is
+# re-read — and installs/caches the winners. This is the TPU answer to
+# the reference's interception of `triton.autotune` kernels inside a
+# composite op: on TPU the "interception point" is trace time, so the
+# profile is a host-side dict the tracers read.
+
+_CONTEXTUAL: Dict[str, Dict[str, Any]] = {}
+
+
+def contextual_choice(name: str) -> Optional[Dict[str, Any]]:
+    """The installed profile entry for kernel `name` (or None)."""
+    return _CONTEXTUAL.get(name)
+
+
+def set_contextual(profile: Dict[str, Dict[str, Any]]) -> None:
+    """Install a tuning profile directly (tests / precomputed)."""
+    _CONTEXTUAL.clear()
+    _CONTEXTUAL.update(profile)
+
+
+def contextual_autotune(fn: Callable, args: Sequence[Any],
+                        vary: Dict[str, Sequence[Dict[str, Any]]], *,
+                        name: str = "contextual",
+                        cache_path: Optional[str] = None,
+                        iters: int = 2, warmup: int = 1
+                        ) -> Dict[str, Dict[str, Any]]:
+    """Tune the nested kernels of a composite `fn(*args)` end-to-end.
+
+    vary: {kernel_name: [config, ...]} — kernel_name must be a profile
+    key the kernel's default path consults (e.g. "ag_gemm",
+    "flash_decode"). Returns (and installs) the winning profile; cached
+    on disk under the device/signature/space key with cross-process
+    consensus, like AutoTuner."""
+    cache_path = cache_path or default_cache_path()
+    key = "|".join([
+        _device_tag(), jax.__version__, f"ctx:{name}",
+        _arg_sig(args, {}),
+        json.dumps({k: list(v) for k, v in vary.items()},
+                   sort_keys=True),
+    ])
+    disk = _load_cache(cache_path)
+    hit = disk.get(key)
+    if hit is not None:
+        _CONTEXTUAL.update(hit["cfg"])
+        return dict(hit["cfg"])
+    chosen: Dict[str, Dict[str, Any]] = {}
+    for kname, cfgs in vary.items():
+        prior = _CONTEXTUAL.get(kname)
+        times = []
+        for cfg in cfgs:
+            _CONTEXTUAL[kname] = dict(cfg)
+            try:
+                # fresh jit per candidate: the profile is read at trace
+                # time, so a cached trace would pin the previous config
+                t = _time_call(jax.jit(fn), tuple(args), {},
+                               iters=iters, warmup=warmup)
+            except Exception:
+                t = float("inf")
+            times.append(t)
+        times = _consensus_sum(times)
+        best = min(range(len(times)), key=times.__getitem__)
+        if times[best] == float("inf"):
+            # restore: a known-bad candidate must not stay installed
+            # for later default-path calls
+            if prior is None:
+                _CONTEXTUAL.pop(kname, None)
+            else:
+                _CONTEXTUAL[kname] = prior
+            raise ValueError(
+                f"contextual_autotune({name}): every config of "
+                f"{kname} failed")
+        chosen[kname] = dict(cfgs[best])
+        _CONTEXTUAL[kname] = chosen[kname]
+    disk = _load_cache(cache_path)
+    disk[key] = {"cfg": chosen}
+    _store_cache(cache_path, disk)
+    return chosen
+
+
+def tune_comm_gemm_block_n(name: str, mesh, axis: str, M: int, K: int,
+                           N: int, dtype, a_spec, b_spec,
+                           make_op: Callable[[int], Callable],
+                           blocks: Sequence[int] = (256, 512, 1024, 2048)
+                           ) -> int:
+    """Shared scaffolding for the comm-GEMM context tuners (ag_gemm /
+    gemm_rs / gemm_ar): synthesize sharded inputs of the caller's
+    shapes, time `make_op(block_n)` (a callable of (a, b)) under each
+    block size with AutoTuner's cache+consensus, return the winner."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    a = jax.device_put(jnp.zeros((M, K), dtype),
+                       NamedSharding(mesh, a_spec))
+    b = jax.device_put(jnp.zeros((K, N), dtype),
+                       NamedSharding(mesh, b_spec))
+
+    def run(a, b, *, block_n):
+        return jax.jit(make_op(block_n))(a, b)
+
+    tuner = AutoTuner(run, [{"block_n": bn} for bn in blocks], name=name)
+    return tuner.pick(a, b)["block_n"]
