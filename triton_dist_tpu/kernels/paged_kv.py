@@ -1,0 +1,195 @@
+"""Paged KV cache + flash decode over a page table.
+
+TPU-native re-design of the reference megakernel's paged KV cache
+(`python/triton_dist/mega_triton_kernel/models/paged_kv_cache.py:28` —
+logical KV blocks indirected through a page table so sequences share a
+physical pool and grow without reallocation).
+
+Design: physical pages [NP, page, d] (one page = `page` contiguous KV
+positions of ONE (batch, kv-head) stream); a host/int32 page table
+[B*Hkv, max_pages] maps logical tiles to physical pages. The flash
+kernel walks logical tiles and resolves each one through the table IN
+THE BLOCKSPEC INDEX MAP — the page lookup costs nothing on the data
+path because the scalar-prefetch grid machinery already evaluates index
+maps ahead of the DMAs (the TPU analog of the reference's in-kernel
+`page_table[block_idx]` load).
+
+Deliberate trade (documented, measured in mega/CEILING.md): paging
+forces one (batch, head) stream per grid row (pages of different
+streams are not contiguous), so the walk runs at batch-block bx=1 —
+more grid steps than the contiguous cache's bx=64 walk. Paging buys
+allocation flexibility, not speed; use the contiguous cache when every
+sequence has the same static budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime import interpret_mode
+
+
+def _paged_kernel(scale: float, rep: int, page: int, len_ref, q_ref,
+                  k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+    """Grid (X, max_pages); one (batch, kv-head) stream per grid row.
+    Same online softmax as _flash_decode_kernel, block = one page."""
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    rows = q_ref.shape[1]
+    kv_len = len_ref[0]
+    q_off = len_ref[1]
+    start = t * page
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(start < kv_len)
+    def _compute():
+        q = q_ref[...]                                   # [1, rows, d]
+        s = jax.lax.dot_general(
+            q, k_ref[...], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [1, rows, page]
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // rep
+        col = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 1) + start
+        mask = (col <= (row + q_off)) & (col < kv_len)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(jnp.where(mask[None], s, -1e30), -1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha[..., None] + pv
+        m_scr[...] = m_new
+
+    @pl.when(t == nt - 1)
+    def _done():
+        o_ref[...] = (acc_scr[...]
+                      / jnp.maximum(l_scr[...], 1e-30)[..., None]
+                      ).astype(o_ref.dtype)
+
+
+def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
+                       scale: Optional[float] = None):
+    """Cached GQA decode attention through a page table.
+
+    q: [B, 1, Hq, d]; pages_k/v: [NP, page, d]; page_table:
+    [B*Hkv, max_pages] int32 (physical page of each logical tile; rows
+    beyond ceil(kv_len/page) may hold anything); kv_len: traced scalar
+    — valid positions INCLUDING the current query. Returns [B, 1, Hq, d].
+    """
+    B, S, Hq, d = q.shape
+    assert S == 1, "paged walk is the decode path (S == 1)"
+    NP, page, _ = pages_k.shape
+    X, maxp = page_table.shape
+    Hkv = X // B
+    rep = Hq // Hkv
+    if scale is None:
+        scale = d ** -0.5
+    rows = rep
+    qx = (q.reshape(B, Hkv, rep, d).reshape(X, rows, d))
+    # scalars: [kv_len, q_off, table...]; the kv index map resolves the
+    # logical tile through the table (clamped to the last valid tile so
+    # the tail is elided like the contiguous walk)
+    scalars = jnp.concatenate([
+        jnp.asarray([kv_len, kv_len - 1], jnp.int32),
+        page_table.reshape(-1).astype(jnp.int32)])
+
+    def kv_map(x, t, s_ref):
+        last = jnp.maximum((s_ref[0] + page - 1) // page - 1, 0)
+        return (s_ref[2 + x * maxp + jnp.minimum(t, last)], 0)
+
+    def q_map(x, t, s_ref):
+        return (x, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, float(scale), rep, page),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(X, maxp),
+            in_specs=[
+                pl.BlockSpec((1, rows, d), q_map),
+                pl.BlockSpec((1, page, d),
+                             lambda x, t, s: (kv_map(x, t, s)[0], 0, 0)),
+                pl.BlockSpec((1, page, d),
+                             lambda x, t, s: (kv_map(x, t, s)[0], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((1, rows), jnp.float32),
+                pltpu.VMEM((1, rows), jnp.float32),
+                pltpu.VMEM((1, rows, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((X, rows, d), q.dtype),
+        interpret=interpret_mode(),
+    )(scalars, qx, pages_k, pages_v)
+    return out.reshape(B, Hkv, rep, d).reshape(B, 1, Hq, d)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Page-table KV cache for one layer (reference:
+    paged_kv_cache.py:28). Pages are allocated lazily as sequences grow;
+    the table rows are per (batch, kv-head) stream.
+
+    pages_k/v: [NP, page, d]; table: [B*Hkv, max_pages] int32;
+    offset: valid positions. The allocator is the trivial static one —
+    stream i's tile t lives at page i*max_pages + t — so `alloc` is a
+    table initialization, not a runtime free-list; a serving layer can
+    swap in its own table (the indirection is what the kernel needs,
+    not the policy)."""
+
+    pages_k: jax.Array
+    pages_v: jax.Array
+    table: jax.Array
+    offset: jax.Array
+
+    @staticmethod
+    def create(batch: int, n_kv_heads: int, max_seq: int, head_dim: int,
+               *, page: int = 128, dtype=jnp.bfloat16) -> "PagedKVCache":
+        maxp = -(-max_seq // page)
+        X = batch * n_kv_heads
+        NP = X * maxp
+        table = jnp.arange(NP, dtype=jnp.int32).reshape(X, maxp)
+        z = jnp.zeros((NP, page, head_dim), dtype)
+        return PagedKVCache(pages_k=z, pages_v=z, table=table,
+                            offset=jnp.int32(0))
+
+    @property
+    def page(self) -> int:
+        return self.pages_k.shape[1]
+
+    def append(self, k_new, v_new) -> "PagedKVCache":
+        """Append one position: k/v_new [B, Hkv, 1, d] -> the page row
+        (stream, offset // page, offset % page). A single-row write into
+        a paged pool is a scatter (cannot be a tile-aligned DMA), so
+        appends go through XLA DUS — the paged cache trades append/walk
+        speed for allocation flexibility (mega/CEILING.md)."""
+        B, Hkv, _, d = k_new.shape
+        X, maxp = self.table.shape
+        rows = k_new.reshape(X, d)
+        vrows = v_new.reshape(X, d)
+        pidx = self.table[:, self.offset // self.page]     # [X]
+        r = self.offset % self.page
+
+        def scat(pages, rows):
+            return pages.at[pidx, r].set(rows.astype(pages.dtype))
+
+        return dataclasses.replace(
+            self, pages_k=scat(self.pages_k, rows),
+            pages_v=scat(self.pages_v, vrows), offset=self.offset + 1)

@@ -244,9 +244,35 @@ def set_contextual(profile: Dict[str, Dict[str, Any]]) -> None:
     _CONTEXTUAL.update(profile)
 
 
+def _sync_profile_hit(hit, vary):
+    """Multi-process agreement on a cached contextual profile (the same
+    per-host-cache deadlock guard as AutoTuner._sync_cached_choice):
+    every process advertises its cached winners as per-kernel config
+    INDICES (or -1); the first process with a full hit wins."""
+    names = sorted(vary)
+    if jax.process_count() == 1:
+        return hit["cfg"] if hit is not None else None
+    import numpy as np
+    from jax.experimental import multihost_utils
+    idx = [-1] * len(names)
+    if hit is not None:
+        for j, kname in enumerate(names):
+            for i, cfg in enumerate(vary[kname]):
+                if dict(cfg) == dict(hit["cfg"].get(kname, {})):
+                    idx[j] = i
+                    break
+    got = np.asarray(multihost_utils.process_allgather(
+        np.asarray(idx))).reshape(jax.process_count(), -1)
+    for row in got:
+        if (row >= 0).all():
+            return {kname: dict(vary[kname][int(row[j])])
+                    for j, kname in enumerate(names)}
+    return None
+
+
 def contextual_autotune(fn: Callable, args: Sequence[Any],
                         vary: Dict[str, Sequence[Dict[str, Any]]], *,
-                        name: str = "contextual",
+                        name: Optional[str] = None,
                         cache_path: Optional[str] = None,
                         iters: int = 2, warmup: int = 1
                         ) -> Dict[str, Dict[str, Any]]:
@@ -255,9 +281,12 @@ def contextual_autotune(fn: Callable, args: Sequence[Any],
     vary: {kernel_name: [config, ...]} — kernel_name must be a profile
     key the kernel's default path consults (e.g. "ag_gemm",
     "flash_decode"). Returns (and installs) the winning profile; cached
-    on disk under the device/signature/space key with cross-process
-    consensus, like AutoTuner."""
+    on disk under the device/name/signature/space key with
+    cross-process consensus, like AutoTuner. `name` defaults to the
+    composite's __qualname__ (two different composites over the same
+    shapes must not share a profile)."""
     cache_path = cache_path or default_cache_path()
+    name = name or getattr(fn, "__qualname__", "contextual")
     key = "|".join([
         _device_tag(), jax.__version__, f"ctx:{name}",
         _arg_sig(args, {}),
@@ -265,10 +294,10 @@ def contextual_autotune(fn: Callable, args: Sequence[Any],
                    sort_keys=True),
     ])
     disk = _load_cache(cache_path)
-    hit = disk.get(key)
+    hit = _sync_profile_hit(disk.get(key), vary)
     if hit is not None:
-        _CONTEXTUAL.update(hit["cfg"])
-        return dict(hit["cfg"])
+        _CONTEXTUAL.update(hit)
+        return dict(hit)
     chosen: Dict[str, Dict[str, Any]] = {}
     for kname, cfgs in vary.items():
         prior = _CONTEXTUAL.get(kname)
@@ -318,9 +347,13 @@ def tune_comm_gemm_block_n(name: str, mesh, axis: str, M: int, K: int,
                        NamedSharding(mesh, a_spec))
     b = jax.device_put(jnp.zeros((K, N), dtype),
                        NamedSharding(mesh, b_spec))
+    # ONE jitted op per block size, built before timing: a fresh
+    # jit/context per call would be a cache miss every iteration and the
+    # tuner would measure Mosaic compile time instead of the kernel
+    jitted = {bn: jax.jit(make_op(bn)) for bn in blocks}
 
     def run(a, b, *, block_n):
-        return jax.jit(make_op(block_n))(a, b)
+        return jitted[block_n](a, b)
 
     tuner = AutoTuner(run, [{"block_n": bn} for bn in blocks], name=name)
     return tuner.pick(a, b)["block_n"]
