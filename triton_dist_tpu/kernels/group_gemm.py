@@ -45,12 +45,21 @@ def grouped_gemm(x, w, *, block_c: int = 256, block_f: int = 512):
     F-tile) and are reused across C-tiles by the pallas pipeline."""
     E, C, D = x.shape
     F = w.shape[2]
-    bc = min(block_c, C)
-    while C % bc:
-        bc -= 1
-    bf = min(block_f, F)
-    while F % bf:
-        bf -= 1
+
+    def _pick(total, want, align):
+        """Largest divisor <= want that satisfies Mosaic's tiling
+        (full-dim blocks are exempt); falls back to one full block."""
+        b = min(want, total)
+        if b >= total:
+            return total
+        while b >= align:
+            if total % b == 0 and b % align == 0:
+                return b
+            b -= 1
+        return total
+
+    bc = _pick(C, block_c, 8)
+    bf = _pick(F, block_f, 128)
     grid = (E, cdiv(C, bc), cdiv(F, bf))
     return pl.pallas_call(
         _gg_kernel,

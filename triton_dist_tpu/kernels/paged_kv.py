@@ -182,6 +182,14 @@ class PagedKVCache:
         speed for allocation flexibility (mega/CEILING.md)."""
         B, Hkv, _, d = k_new.shape
         X, maxp = self.table.shape
+        if not isinstance(self.offset, jax.core.Tracer):
+            # eager appends (the common serving pattern) get a real
+            # capacity error; a clamped OOB table read would silently
+            # overwrite the last page
+            if int(self.offset) >= maxp * self.page:
+                raise ValueError(
+                    f"PagedKVCache full: offset {int(self.offset)} at "
+                    f"capacity {maxp * self.page}")
         rows = k_new.reshape(X, d)
         vrows = v_new.reshape(X, d)
         pidx = self.table[:, self.offset // self.page]     # [X]

@@ -163,6 +163,76 @@ class EP_MoE:
             return y, {"dropped": dropped[0]}
         return y
 
+    def _cap_e(self, t_loc: int) -> int:
+        """Per-(source, GLOBAL expert) capacity for the fused layout —
+        rounded UP to 8-row tiles AFTER every clamp (the fused kernel's
+        pl.ds slices need tile-aligned offsets on real TPUs)."""
+        E, k = self.num_experts, self.top_k
+        if self.capacity_factor == "dropless":
+            cap = t_loc * k
+        else:
+            cap = min(int(self.capacity_factor * k * t_loc / E) + 1,
+                      t_loc * k)
+        return max(8, -(-cap // 8) * 8)
+
+    def fwd_ep_fused(self, x, return_stats: bool = False,
+                     warn_drops: bool = True):
+        """ONE-kernel EP MoE (reference: ep_all2all_fused.py:73-560,
+        VERDICT r2 missing #3): dispatch puts -> per-arrival expert
+        MLPs -> combine puts from the GEMM epilogue, one pallas_call
+        instead of the fwd_ep chain (dispatch kernel + grouped GEMMs +
+        combine kernel, each boundary an HBM round-trip + barrier).
+
+        The grouping that the reference's tile scheduler does with
+        dynamic gathers happens in the LAYOUT here: the plan assigns
+        slots per GLOBAL expert (one destination per expert), so every
+        peer's slab arrives pre-grouped (kernels/ep_fused.py). x: [T, D]
+        row-sharded over the ep axis -> same sharding."""
+        from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_device
+        n = self.mesh.shape[self.axis]
+        axis = self.axis
+        E = self.num_experts
+        k = self.top_k
+        T = x.shape[0]
+        t_loc = T // n
+        cap_e = self._cap_e(t_loc)
+        cid = next_collective_id()
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(axis, None), P(None, None),
+                      P(axis, None, None), P(axis, None, None)),
+            out_specs=(P(axis, None), P(None)), check_vma=False)
+        def _f(x_loc, router, wgu_loc, wd_loc):
+            topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
+            # one "destination" per GLOBAL expert: the slot layout IS
+            # the expert grouping (experts are rank-major, so slab p =
+            # slots of peer p's local experts)
+            plan = plan_dispatch(topk_idx, E, 1, cap_e)
+            send_x, _ = fill_send_buffers(x_loc, topk_idx, plan, E, 1,
+                                          cap_e)
+            yback = ep_moe_fused_device(
+                send_x, wgu_loc.astype(x_loc.dtype),
+                wd_loc.astype(x_loc.dtype), n=n, axis=axis, cap_e=cap_e,
+                collective_id=cid)
+            y_flat = yback.reshape(E * cap_e, -1)
+            y = combine_from_slots(y_flat, plan, topk_w, t_loc)
+            # dropless-or-loud holds on this path too
+            loud = (warn_drops and self.capacity_factor != "dropless")
+            if loud or return_stats:
+                dropped = jax.lax.psum(plan.dropped, axis)
+                if loud:
+                    from triton_dist_tpu.kernels.ep_a2a import warn_on_drops
+                    warn_on_drops(dropped, "EP_MoE.fwd_ep_fused")
+            else:
+                dropped = jnp.zeros((), jnp.int32)
+            return y.astype(x_loc.dtype), dropped[None]
+
+        y, dropped = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        if return_stats:
+            return y, {"dropped": dropped[0]}
+        return y
+
     def fwd_xla(self, x):
         """Oracle (x row-sharded): dense all-experts math with XLA
         collectives — all_gather tokens, each device computes its experts
@@ -216,4 +286,6 @@ class EP_MoE:
     def __call__(self, x, mode: str = "ep"):
         if mode == "train":
             return self.fwd_train(x)
+        if mode == "ep_fused":
+            return self.fwd_ep_fused(x)
         return self.fwd_ep(x) if mode == "ep" else self.fwd_xla(x)
