@@ -129,8 +129,9 @@ def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None):
     if X is None:
         # head-block size: batches the [C,C] work so VPU ops and grid
         # overhead amortize over X heads per step. 16 measured fastest
-        # on v5e at C=64/d=128 (268us vs 914us at X=8 for
-        # B8/H16/T2048); cap by a per-head VMEM footprint model so
+        # on v5e at C=64/d=128 (1164us vs 1435us at X=8 for
+        # B8/H16/T2048, data-chained timing); cap by a per-head VMEM
+        # footprint model so
         # larger head dims scale X down instead of failing Mosaic
         # compilation (double-buffered chunk blocks + f32 state + f32
         # solve intermediates; 32 at d=128 already breaches ~16MB)

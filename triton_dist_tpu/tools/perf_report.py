@@ -276,7 +276,9 @@ def run_report(write_json=None):
                 sp_ring_attention(u, kr, vr, mesh=mesh, axis="tp",
                                   mode=mm), dtype=jnp.float32
                 ).astype(u.dtype))(ring_mode),
-            qr, ring_sol)
+            qr, ring_sol,
+            note="latency-bound at this size; SOL is the pure-FLOPs "
+                 "bound (compare the two modes, not the fraction)")
 
     header = {"backend": jax.default_backend(), "ndev": ndev,
               "chip": spec.name, "interpreted": not on_tpu}
