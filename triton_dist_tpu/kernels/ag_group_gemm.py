@@ -60,7 +60,7 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
     expert chunks and (non-resident) B tiles double-buffer under the
     dots, and output tiles stage through two slots waited two tiles
     later — the MXU never idles on a same-iteration DMA."""
-    me = dl.my_pe(axis)
+    me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     _, c_loc, D = x_ref.shape
     n_loc = w_ref.shape[2]
     nt = 1 if resident_b else pl.cdiv(n_loc, block_n)

@@ -147,7 +147,7 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
         buffer as soon as its recv semaphore fires, and waited only
         before step s+1's first dot.
     """
-    me = dl.my_pe(axis)
+    me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     m_loc, K = a_ref.shape
     n_loc = b_ref.shape[1]
     nt = cdiv(n_loc, block_n)

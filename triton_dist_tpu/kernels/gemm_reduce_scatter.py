@@ -108,7 +108,7 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
         of j+1 while the VPU adds tile j, and stages its writebacks the
         same way.
     """
-    me = dl.my_pe(axis)
+    me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     m_loc, N = o_ref.shape
     k_loc = a_ref.shape[1]
     nt = cdiv(N, block_n)

@@ -51,7 +51,7 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
     slabs stage through two deferred-writeback slots (drained before
     the fold reads them), and the fold prefetches the next expert's
     operand pair while the VPU adds the current one."""
-    me = dl.my_pe(axis)
+    me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     _, c_loc, D = o_ref.shape
     left, right = dl.ring_neighbors(axis)
 
