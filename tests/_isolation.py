@@ -15,7 +15,7 @@ _TESTS = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_TESTS)
 
 
-def run_isolated(driver: str, case: str, tries: int = 2,
+def run_isolated(driver: str, case: str, tries: int = 3,
                  timeout: int = 1200):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)

@@ -52,7 +52,7 @@ def pytest_configure(config):
             pass
     env = dict(os.environ)
     env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
-    env.setdefault("FAKE_NPROC", "32")
+    env.setdefault("FAKE_NPROC", "64")
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable, [sys.executable, "-m", "pytest"]
@@ -105,7 +105,7 @@ def cpu_mesh_env(extra=None):
     })
     if os.path.exists(_SHIM) and "fakecpus" not in env.get("LD_PRELOAD", ""):
         env["LD_PRELOAD"] = (_SHIM + " " + env.get("LD_PRELOAD", "")).strip()
-        env.setdefault("FAKE_NPROC", "32")
+        env.setdefault("FAKE_NPROC", "64")
     if extra:
         env.update(extra)
     return env
