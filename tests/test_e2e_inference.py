@@ -72,3 +72,38 @@ def test_engine_generates_same_tokens_as_oracle(backend):
     got = np.asarray(eng.serve(ids, gen))
     assert got.shape == (B, gen)
     np.testing.assert_array_equal(got, want)
+
+
+def test_sampled_decode_temp0_equals_greedy():
+    """temperature=0 through the sampled scan == the greedy scan bit
+    for bit (the differential the serving demo leans on)."""
+    B, S, gen = 1, 8, 6
+    ids = _prompt(B, S, model.config.vocab_size)
+    greedy = Engine(model, max_seq=32, backend="xla")
+    want = np.asarray(greedy.serve(ids, gen))
+    for mode in ("top_k", "top_p"):
+        eng = Engine(model, max_seq=32, backend="xla", sampling=mode,
+                     temperature=0.0)
+        got = np.asarray(eng.serve(ids, gen, seed=7))
+        np.testing.assert_array_equal(got, want, err_msg=mode)
+
+
+def test_sampled_decode_seed_behavior():
+    """Same seed -> same generation; different seeds may differ, and at
+    hot temperature the sampler must explore (not collapse to argmax).
+    top_k=1 is greedy regardless of temperature."""
+    B, S, gen = 2, 8, 8
+    ids = _prompt(B, S, model.config.vocab_size)
+    eng = Engine(model, max_seq=32, backend="xla", sampling="top_p",
+                 temperature=5.0, top_p=0.98)
+    a = np.asarray(eng.serve(ids, gen, seed=3))
+    b = np.asarray(eng.serve(ids, gen, seed=3))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(eng.serve(ids, gen, seed=4))
+    assert not np.array_equal(a, c), "hot sampling ignored the seed"
+    greedy = np.asarray(Engine(model, max_seq=32,
+                               backend="xla").serve(ids, gen))
+    k1 = Engine(model, max_seq=32, backend="xla", sampling="top_k",
+                temperature=5.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1.serve(ids, gen, seed=9)),
+                                  greedy)

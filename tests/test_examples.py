@@ -1,7 +1,8 @@
-"""Examples smoke: the kernel-library example must run end-to-end as a
-real subprocess on the virtual mesh (the same way a user would run it).
-One example suffices for CI time; all six are exercised manually and
-share the same _common.bootstrap substrate."""
+"""Examples smoke: each listed example must run end-to-end as a real
+subprocess on the virtual mesh (the same way a user would run it).
+The kernel example plus the serving demo suffice for CI time; the
+rest are exercised manually and share the same _common.bootstrap
+substrate."""
 
 import os
 import subprocess
@@ -10,7 +11,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_kernels_example_runs():
+def _run_example(name):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     env.update({
@@ -19,7 +20,15 @@ def test_kernels_example_runs():
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
     })
     out = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "examples", "05_kernels.py")],
+        [sys.executable, os.path.join(_REPO, "examples", name)],
         env=env, capture_output=True, text=True, timeout=1200)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "OK" in out.stdout, out.stdout
+
+
+def test_kernels_example_runs():
+    _run_example("05_kernels.py")
+
+
+def test_serving_example_runs():
+    _run_example("07_serving.py")

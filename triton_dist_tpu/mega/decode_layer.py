@@ -16,8 +16,15 @@ for the entire layer (zero HBM round-trips between ops), weights stream
 through a single staging tile, and the per-op pipeline
 prologue/epilogue cost of nine kernels collapses into one.
 
-Decode-only (S=1), single chip; the TP composition runs this under
-shard_map with the gemm_ar epilogue outside, like the other layers.
+Decode-only (S=1), single chip (`models/engine.py` rejects
+mesh.size != 1 for backend="mega"). There is deliberately no TP
+composition: the one-kernel-per-layer structure would have to split at
+the two cross-chip reduction points (o-proj and down-proj partials need
+an all-reduce BEFORE their residual adds), i.e. two kernels + two AR
+epilogues per layer — exactly the per-op "flash"+"gemm_ar" path that
+already exists and that CEILING.md measures as faster than the
+megakernel even single-chip. Use backend="dist"/"gemm_ar" for TP
+decode.
 """
 
 from __future__ import annotations

@@ -41,15 +41,35 @@ from triton_dist_tpu.models.kv_cache import KVCache
 class Engine:
     def __init__(self, model, *, max_seq: int = 256, backend: str = "gemm_ar",
                  prefill_backend: Optional[str] = None,
-                 kv_dtype=None):
+                 kv_dtype=None, sampling: str = "greedy",
+                 temperature: float = 1.0, top_k: int = 50,
+                 top_p: float = 0.9):
         """kv_dtype=jnp.int8 stores the KV cache quantized (per-position
         scales; kv_cache.py) — half the decode step's dominant HBM read.
         Pair with model.quantize_int8() for the full bandwidth-bound
-        decode configuration."""
+        decode configuration.
+
+        sampling: "greedy" (default), "top_k" or "top_p" (reference:
+        the sampling helpers of models/utils.py driven by the chat
+        server, mega_triton_kernel/test/models/model_server.py). The
+        non-greedy paths thread a PRNG key through the decode scan's
+        carry (split per step); greedy keeps the key-free carry so the
+        bench path is untouched. temperature=0 collapses every sampler
+        to greedy."""
         self.model = model
         self.max_seq = max_seq
         self.backend = backend
         self.kv_dtype = kv_dtype
+        if sampling not in ("greedy", "top_k", "top_p"):
+            raise ValueError(f"unknown sampling mode {sampling!r}")
+        if sampling != "greedy" and backend == "mega":
+            raise ValueError(
+                "backend='mega' decodes greedily (the scan carries the "
+                "argmax token only); use the per-op backends for "
+                "sampled generation")
+        self.sampling = sampling
+        self._sample_params = dict(temperature=temperature, k=top_k,
+                                   p=top_p)
         from triton_dist_tpu.kernels.quant import QuantW
         w0 = model.layers[0].attn.w_qkv if model.layers else None
         if isinstance(w0, QuantW) and backend not in ("flash", "xla"):
@@ -85,8 +105,13 @@ class Engine:
         # program constants — that would bake GBs into the executable)
         self._prefill = jax.jit(functools.partial(
             _prefill_fn, mode=self.prefill_backend))
-        scan_fn = (_mega_scan_decode_fn if backend == "mega"
-                   else functools.partial(_scan_decode_fn, backend))
+        if backend == "mega":
+            scan_fn = _mega_scan_decode_fn
+        elif sampling == "greedy":
+            scan_fn = functools.partial(_scan_decode_fn, backend)
+        else:
+            scan_fn = functools.partial(_sampled_scan_decode_fn, backend,
+                                        sampling, self._sample_params)
         self._decode_scan = jax.jit(
             scan_fn, static_argnames=("gen_len",), donate_argnums=(2,))
 
@@ -97,21 +122,27 @@ class Engine:
                                       dtype=self.kv_dtype)
         return self._prefill(self.model, input_ids, cache)
 
-    def decode(self, logits, cache, gen_len: int):
-        """Greedy decode from prefill state: one jitted lax.scan over
-        gen_len steps with a donated cache. Returns tokens [B, gen_len].
-        The benchmark times this call alone — it is the reference's
-        measured decode loop (engine.py:166)."""
-        toks, _, _ = self._decode_scan(self.model, logits, cache,
-                                       gen_len=gen_len)
+    def decode(self, logits, cache, gen_len: int, *, seed: int = 0):
+        """Decode from prefill state: one jitted lax.scan over gen_len
+        steps with a donated cache. Returns tokens [B, gen_len]. The
+        benchmark times this call alone — it is the reference's measured
+        decode loop (engine.py:166). `seed` feeds the sampler key for
+        the non-greedy modes (ignored under greedy)."""
+        if self.sampling == "greedy" or self.backend == "mega":
+            toks, _, _ = self._decode_scan(self.model, logits, cache,
+                                           gen_len=gen_len)
+        else:
+            toks, _, _ = self._decode_scan(
+                self.model, logits, cache, jax.random.key(seed),
+                gen_len=gen_len)
         return toks
 
-    def serve(self, input_ids, gen_len: int):
-        """Generate greedily (reference: Engine.serve, engine.py:113).
+    def serve(self, input_ids, gen_len: int, *, seed: int = 0):
+        """Generate (reference: Engine.serve, engine.py:113).
         input_ids: [B, S] int32. Returns generated tokens [B, gen_len].
         """
         logits, cache = self.prefill(input_ids)
-        return self.decode(logits, cache, gen_len)
+        return self.decode(logits, cache, gen_len, seed=seed)
 
 
 def _prefill_fn(model, ids, cache, *, mode):
@@ -131,6 +162,38 @@ def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):
 
     (logits, cache), toks = jax.lax.scan(
         step, (logits0, cache), None, length=gen_len)
+    return toks.T, logits, cache                     # [B, gen_len]
+
+
+def _sampled_scan_decode_fn(backend, sampling, params, model, logits0,
+                            cache, key, *, gen_len: int):
+    """Sampled decode scan: same structure as _scan_decode_fn with a
+    PRNG key in the carry, split once per step (reference: the sampling
+    loop of the chat server, model_server.py + models/utils.py).
+    temperature=0 degenerates to argmax so servers can flip modes
+    without recompiling a separate greedy engine."""
+    from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
+
+    temp = max(params["temperature"], 0.0)
+
+    def sample(k, logits):
+        if temp == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        if sampling == "top_k":
+            return sample_top_k(k, logits, k=params["k"],
+                                temperature=temp)
+        return sample_top_p(k, logits, p=params["p"], temperature=temp)
+
+    def step(carry, _):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(sub, logits)                   # [B]
+        logits, cache = model.forward_tokens(tok[:, None], cache,
+                                             mode=backend)
+        return (logits, cache, key), tok
+
+    (logits, cache, _), toks = jax.lax.scan(
+        step, (logits0, cache, key), None, length=gen_len)
     return toks.T, logits, cache                     # [B, gen_len]
 
 
