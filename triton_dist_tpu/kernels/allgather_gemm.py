@@ -125,10 +125,8 @@ def _tune_block_n(mesh: Mesh, axis: str, M: int, K: int, N_local: int,
         P(axis, None), P(None, axis), make_op)
 
 
-def _ag_gemm_kernel(n: int, axis: str, block_n: int,
-                    a_ref, b_ref, ag_ref, o_ref,
-                    a_vmem, b_vmem, o_vmem,
-                    copy_sem, a_sem, b_sems, o_sems, send_sem, recv_sems):
+def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
+                    straggler, *refs):
     """Fused ring-AG + GEMM (consumer analog: kernel_consumer_gemm_persistent,
     allgather_gemm.py:199; producer analog: cp_engine_producer_all_gather,
     allgather.py:202 — both folded into one kernel here).
@@ -147,6 +145,15 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
         buffer as soon as its recv semaphore fires, and waited only
         before step s+1's first dot.
     """
+    if straggler is not None:
+        spin_vmem, refs = refs[-1], refs[:-1]
+    if quant:
+        (a_ref, b_ref, s_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
+         s_vmem, copy_sem, a_sem, b_sems, o_sems, send_sem, recv_sems,
+         s_sem) = refs
+    else:
+        (a_ref, b_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
+         copy_sem, a_sem, b_sems, o_sems, send_sem, recv_sems) = refs
     me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     m_loc, K = a_ref.shape
     n_loc = b_ref.shape[1]
@@ -172,6 +179,15 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
     cp_a = pltpu.make_async_copy(a_ref, a_vmem.at[0], a_sem)
     cp_a.start()
     pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
+    if quant:
+        # per-output-column dequant scales: tiny, loaded once, applied
+        # AFTER each dot (exact — quant.py's per-column contract); the
+        # int8 B stream is the point: half the weight HBM/VMEM traffic
+        # (reference analog: the int8/fp8 comm payloads of
+        # low_latency_all_to_all_v2.py:213, applied to the weight path)
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
+        cp_s.wait()
     cp_ag.wait()
     dl.barrier_all(axis)
 
@@ -179,6 +195,19 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
     for s in range(n):
         cur, nxt = s % 2, (s + 1) % 2
         src = jax.lax.rem(me - s + jnp.int32(n), jnp.int32(n))
+        if straggler is not None and s == straggler[1]:
+            # fault injection INSIDE the ring (reference:
+            # ag_gemm(..., straggler_option), allgather_gemm.py:660 —
+            # one rank stalls mid-op so consumers must really wait on
+            # the per-chunk semaphores, not on luck): burn VPU cycles
+            # on the designated rank at this step; the scrap result
+            # lands in this rank's own (never-read) ag_ref slot
+            @pl.when(me == jnp.int32(straggler[0]))
+            def _stall():
+                spin_vmem[...] = jax.lax.fori_loop(
+                    0, straggler[2],
+                    lambda i, a: a * 1.0000001 + 1e-9,
+                    jnp.ones((8, 128), jnp.float32))
         if s < n - 1:
             # Producer: forward the chunk we are about to compute-from to
             # the right neighbor while the MXU works (the overlap). One
@@ -205,8 +234,13 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
                 # the writeback issued two tiles ago reuses this slot
                 pltpu.make_async_copy(o_vmem.at[t % 2], o_dst(t - 2),
                                       o_sems.at[t % 2]).wait()
-            acc = jnp.dot(a_vmem[cur], b_vmem[slot],
+            bt = b_vmem[slot]
+            if quant:
+                bt = bt.astype(a_vmem.dtype)
+            acc = jnp.dot(a_vmem[cur], bt,
                           preferred_element_type=jnp.float32)
+            if quant:
+                acc = acc * s_vmem[:, pl.ds(j * block_n, block_n)]
             o_vmem[t % 2] = acc.astype(o_ref.dtype)
             pltpu.make_async_copy(o_vmem.at[t % 2], o_dst(t),
                                   o_sems.at[t % 2]).start()
@@ -228,44 +262,57 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int,
 from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
-def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext):
+def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext,
+                  s_shard=None, straggler=None):
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]
     n = ctx.n
+    quant = s_shard is not None
     block_n = _divisor_block(n_loc, ctx.block_n)
     M = n * m_loc
-    kernel = functools.partial(_ag_gemm_kernel, n, ctx.axis, block_n)
+    kernel = functools.partial(_ag_gemm_kernel, n, ctx.axis, block_n,
+                               quant, straggler)
+    scratch = [
+        pltpu.VMEM((2, m_loc, K), a_shard.dtype),
+        pltpu.VMEM((1 if block_n >= n_loc else 2, K, block_n),
+                   b_shard.dtype),
+        pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+    ]
+    if quant:
+        scratch.append(pltpu.VMEM((1, n_loc), jnp.float32))
+    scratch += [
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((n,)),
+    ]
+    if quant:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+    if straggler is not None:
+        scratch.append(pltpu.VMEM((8, 128), jnp.float32))
+    args = (a_shard, b_shard) + ((s_shard,) if quant else ())
     ag, out = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((M, K), a_shard.dtype),
             jax.ShapeDtypeStruct((M, n_loc), a_shard.dtype),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY)),
-        scratch_shapes=[
-            pltpu.VMEM((2, m_loc, K), a_shard.dtype),
-            pltpu.VMEM((1 if block_n >= n_loc else 2, K, block_n),
-                       b_shard.dtype),
-            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((n,)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
-    )(a_shard, b_shard)
+    )(*args)
     return ag, out
 
 
 def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
             *, mesh: Optional[Mesh] = None, axis: str = "tp",
-            return_ag: bool = False):
+            return_ag: bool = False,
+            straggler: Optional[Tuple[int, int, int]] = None):
     """C = allgather(A) @ B with comm/compute overlap (reference: ag_gemm,
     allgather_gemm.py:568).
 
@@ -274,23 +321,41 @@ def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
     optionally the gathered A (replicated) — the reference keeps gathered
     A in the ctx workspace for reuse by the attention path.
     """
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(b, QuantW)
+    bq = b.q if quant else b
     if ctx is None:
         assert mesh is not None, "pass ctx or mesh"
         ctx = create_ag_gemm_context(mesh, axis, K=a.shape[1],
-                                     N_local=b.shape[1] // mesh.shape[axis],
+                                     N_local=bq.shape[1] // mesh.shape[axis],
                                      dtype=a.dtype)
     mesh = ctx.mesh
     axis = ctx.axis
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(axis, None), P(None, axis)),
-        out_specs=(P(None, None), P(None, axis)),
-        check_vma=False)
-    def _f(a_shard, b_shard):
-        return _ag_gemm_call(a_shard, b_shard, ctx)
+    if quant:
+        # int8 weight panels stream through the kernel; per-column
+        # scales ride as a [1, N] side input, applied after each dot
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(axis, None), P(None, axis), P(None, axis)),
+            out_specs=(P(None, None), P(None, axis)),
+            check_vma=False)
+        def _fq(a_shard, b_shard, s_shard):
+            return _ag_gemm_call(a_shard, b_shard, ctx, s_shard,
+                                 straggler)
 
-    ag, out = _f(a, b)
+        ag, out = _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
+    else:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(axis, None), P(None, axis)),
+            out_specs=(P(None, None), P(None, axis)),
+            check_vma=False)
+        def _f(a_shard, b_shard):
+            return _ag_gemm_call(a_shard, b_shard, ctx,
+                                 straggler=straggler)
+
+        ag, out = _f(a, bq)
     if return_ag:
         return out, ag
     return out

@@ -79,10 +79,8 @@ def create_gemm_ar_context(mesh: Mesh, axis: str = "tp", *,
 from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
-def _gemm_ar_kernel(n: int, axis: str, block_n: int,
-                    a_ref, b_ref, o_ref, land_ref, send_buf,
-                    a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
-                    a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem):
+def _gemm_ar_kernel(n: int, axis: str, block_n: int, quant: bool,
+                    *refs):
     """GEMM -> one-shot push -> VPU reduce (ref: fused GEMM+AR kernel,
     gemm_allreduce.py:566), software-pipelined:
       * B tiles double-buffer under the dots;
@@ -93,6 +91,15 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int,
       * the reduce prefetches the next landed tile while the VPU adds
         the current one, and stages its output writebacks two behind.
     """
+    if quant:
+        (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, l_vmem, p_vmem, s_vmem,
+         a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem,
+         s_sem) = refs
+    else:
+        (a_ref, b_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
+         a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem) = refs
     me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     M, N = o_ref.shape
     nt = cdiv(N, block_n)
@@ -107,6 +114,12 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int,
 
     pltpu.make_async_copy(a_ref, a_vmem, a_sem).start()
     pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
+    if quant:
+        # per-column dequant scales, applied to each PARTIAL after its
+        # dot — exact for the later n-way sum
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
+        cp_s.wait()
     dl.barrier_all(axis)
     pltpu.make_async_copy(a_ref, a_vmem, a_sem).wait()
 
@@ -125,9 +138,14 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int,
             pltpu.make_async_copy(b_src(j), b_vmem.at[0 if resident
                                                       else ts],
                                   b_sems.at[0 if resident else ts]).wait()
-        t_vmem[ts] = jnp.dot(a_vmem[...], b_vmem[0 if resident else ts],
-                             preferred_element_type=jnp.float32
-                             ).astype(t_vmem.dtype)
+        bt = b_vmem[0 if resident else ts]
+        if quant:
+            bt = bt.astype(a_vmem.dtype)
+        acc = jnp.dot(a_vmem[...], bt,
+                      preferred_element_type=jnp.float32)
+        if quant:
+            acc = acc * s_vmem[:, pl.ds(j * block_n, block_n)]
+        t_vmem[ts] = acc.astype(t_vmem.dtype)
         pltpu.make_async_copy(t_vmem.at[ts], tile(send_buf, j),
                               t_sems.at[ts]).start()
         if j >= 1:
@@ -178,12 +196,35 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int,
                               send_sem).wait()
 
 
-def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
+def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext, s_shard=None):
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
     n = ctx.n
+    quant = s_shard is not None
     block_n = _divisor_block(N, ctx.block_n)
-    kernel = functools.partial(_gemm_ar_kernel, n, ctx.axis, block_n)
+    kernel = functools.partial(_gemm_ar_kernel, n, ctx.axis, block_n,
+                               quant)
+    scratch = [
+        pltpu.VMEM((M, k_loc), a_shard.dtype),
+        pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
+                   b_shard.dtype),
+        pltpu.VMEM((2, M, block_n), a_shard.dtype),
+        pltpu.VMEM((2, M, block_n), a_shard.dtype),
+        pltpu.VMEM((M, block_n), jnp.float32),
+    ]
+    if quant:
+        scratch.append(pltpu.VMEM((1, N), jnp.float32))
+    scratch += [
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA(()),
+    ]
+    if quant:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+    args = (a_shard, b_shard) + ((s_shard,) if quant else ())
     # landing/staging HBM buffers as extra outputs (hardware forbids
     # non-vmem scratch); kernel arg order is unchanged
     res = pl.pallas_call(
@@ -191,27 +232,13 @@ def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext):
         out_shape=(jax.ShapeDtypeStruct((M, N), a_shard.dtype),
                    jax.ShapeDtypeStruct((n, M, N), a_shard.dtype),
                    jax.ShapeDtypeStruct((M, N), a_shard.dtype)),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                         for _ in range(3)),
-        scratch_shapes=[
-            pltpu.VMEM((M, k_loc), a_shard.dtype),
-            pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
-                       b_shard.dtype),
-            pltpu.VMEM((2, M, block_n), a_shard.dtype),
-            pltpu.VMEM((2, M, block_n), a_shard.dtype),
-            pltpu.VMEM((M, block_n), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        scratch_shapes=scratch,
         compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
-    )(a_shard, b_shard)
+    )(*args)
     return res[0]
 
 
@@ -224,11 +251,25 @@ def gemm_allreduce(a, b, ctx: Optional[GemmARContext] = None, *,
     [M, N] replicated over `axis` — the torch-AR-equivalent TP epilogue
     but without a separate collective.
     """
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(b, QuantW)
+    bq = b.q if quant else b
     if ctx is None:
         assert mesh is not None, "pass ctx or mesh"
         ctx = create_gemm_ar_context(mesh, axis)
     mesh = ctx.mesh
     axis = ctx.axis
+
+    if quant:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None), P(None, None)),
+            out_specs=P(None, None),
+            check_vma=False)
+        def _fq(a_shard, b_shard, s_shard):
+            return _gemm_ar_call(a_shard, b_shard, ctx, s_shard)
+
+        return _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

@@ -91,12 +91,8 @@ def create_gemm_rs_context(mesh: Mesh, axis: str = "tp", *,
 from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
-def _gemm_rs_kernel(n: int, axis: str, block_n: int,
-                    a_ref, b_ref, o_ref,
-                    land_ref, send_buf,
-                    a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
-                    a_sem, b_sems, t_sems, d_sems, l_sems,
-                    send_sems, recv_sems, credit_sem):
+def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
+                    *refs):
     """Software-pipelined producer + fold (the TPU analog of the
     reference's per-tile-notify producer GEMM, gemm_reduce_scatter.py:
     125-333, which never stalls the tensor cores on memory):
@@ -108,6 +104,16 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
         of j+1 while the VPU adds tile j, and stages its writebacks the
         same way.
     """
+    if quant:
+        (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, d_vmem, l_vmem, s_vmem,
+         a_sem, b_sems, t_sems, d_sems, l_sems,
+         send_sems, recv_sems, credit_sem, s_sem) = refs
+    else:
+        (a_ref, b_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
+         a_sem, b_sems, t_sems, d_sems, l_sems,
+         send_sems, recv_sems, credit_sem) = refs
     me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     m_loc, N = o_ref.shape
     k_loc = a_ref.shape[1]
@@ -129,6 +135,12 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
     pltpu.make_async_copy(a_ref.at[pl.ds(chunk_of(0) * m_loc, m_loc)],
                           a_vmem.at[0], a_sem).start()
     pltpu.make_async_copy(b_src(0), b_vmem.at[0], b_sems.at[0]).start()
+    if quant:
+        # per-column dequant scales, applied to each PARTIAL after its
+        # dot — exact, since sum_i (A_i q_i) * s == (sum_i A_i q_i) * s
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
+        cp_s.wait()
     dl.barrier_all(axis)
 
     for s in range(n):
@@ -167,9 +179,14 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
                     t_vmem.at[ts],
                     dest.at[:, pl.ds((j - 2) * block_n, block_n)],
                     t_sems.at[ts]).wait()
-            t_vmem[ts] = jnp.dot(a_vmem[slot], b_vmem[bslot],
-                                 preferred_element_type=jnp.float32
-                                 ).astype(t_vmem.dtype)
+            bt = b_vmem[bslot]
+            if quant:
+                bt = bt.astype(a_vmem.dtype)
+            acc = jnp.dot(a_vmem[slot], bt,
+                          preferred_element_type=jnp.float32)
+            if quant:
+                acc = acc * s_vmem[:, pl.ds(j * block_n, block_n)]
+            t_vmem[ts] = acc.astype(t_vmem.dtype)
             pltpu.make_async_copy(
                 t_vmem.at[ts], dest.at[:, pl.ds(j * block_n, block_n)],
                 t_sems.at[ts]).start()
@@ -241,17 +258,43 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int,
 
 
 def _gemm_rs_call(a_shard, b_shard,
-                  ctx: GEMMReduceScatterTensorParallelContext):
+                  ctx: GEMMReduceScatterTensorParallelContext,
+                  s_shard=None):
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
     n = ctx.n
+    quant = s_shard is not None
     if M % n:
         raise ValueError(
             f"gemm_rs: M={M} must be divisible by the TP size n={n}; "
             "trailing rows would be silently dropped from the scatter")
     m_loc = M // n
     block_n = _divisor_block(N, ctx.block_n)
-    kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n)
+    kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n,
+                               quant)
+    scratch = [
+        pltpu.VMEM((2, m_loc, k_loc), a_shard.dtype),
+        pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
+                   b_shard.dtype),
+        pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+        pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+        pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
+    ]
+    if quant:
+        scratch.append(pltpu.VMEM((1, N), jnp.float32))
+    scratch += [
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR,
+    ]
+    if quant:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+    args = (a_shard, b_shard) + ((s_shard,) if quant else ())
     # landing/staging HBM buffers as extra outputs (hardware forbids
     # non-vmem scratch); kernel arg order is unchanged
     res = pl.pallas_call(
@@ -259,29 +302,13 @@ def _gemm_rs_call(a_shard, b_shard,
         out_shape=(jax.ShapeDtypeStruct((m_loc, N), a_shard.dtype),
                    jax.ShapeDtypeStruct((2, m_loc, N), a_shard.dtype),
                    jax.ShapeDtypeStruct((2, m_loc, N), a_shard.dtype)),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                         for _ in range(3)),
-        scratch_shapes=[
-            pltpu.VMEM((2, m_loc, k_loc), a_shard.dtype),
-            pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
-                       b_shard.dtype),
-            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
-            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
-            pltpu.VMEM((2, m_loc, block_n), a_shard.dtype),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR,
-        ],
+        scratch_shapes=scratch,
         compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
-    )(a_shard, b_shard)
+    )(*args)
     return res[0]
 
 
@@ -294,11 +321,25 @@ def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
     sharded on rows (row-parallel weight). Returns C: [M, N] sharded on
     rows over `axis` — the TP MLP/attention epilogue.
     """
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(b, QuantW)
+    bq = b.q if quant else b
     if ctx is None:
         assert mesh is not None, "pass ctx or mesh"
         ctx = create_gemm_rs_context(mesh, axis)
     mesh = ctx.mesh
     axis = ctx.axis
+
+    if quant:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None), P(None, None)),
+            out_specs=P(axis, None),
+            check_vma=False)
+        def _fq(a_shard, b_shard, s_shard):
+            return _gemm_rs_call(a_shard, b_shard, ctx, s_shard)
+
+        return _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -308,4 +349,4 @@ def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
     def _f(a_shard, b_shard):
         return _gemm_rs_call(a_shard, b_shard, ctx)
 
-    return _f(a, b)
+    return _f(a, bq)

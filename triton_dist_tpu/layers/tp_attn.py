@@ -135,8 +135,22 @@ class TP_Attn:
 
     def fwd_xla(self, x, cos, sin, positions):
         """Pure-XLA oracle (reference: torch_fwd): jnp + XLA psum
-        collective — the torch/NCCL role from the reference."""
-        qkv = x @ self.w_qkv
+        collective — the torch/NCCL role from the reference. QuantW
+        weights dequant via qmm."""
+        from triton_dist_tpu.kernels.quant import QuantW, qmm, qspec
+        if isinstance(self.w_qkv, QuantW):
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=(P(None, None),
+                          qspec(self.w_qkv, P(None, self.axis),
+                                P(self.axis))),
+                out_specs=P(None, self.axis), check_vma=False)
+            def up(x_r, w_loc):
+                return qmm(x_r, w_loc)
+
+            qkv = up(x, self.w_qkv)
+        else:
+            qkv = x @ self.w_qkv
         o = self._local_attn(qkv, cos, sin, positions, impl="ref")
         return self._down_psum(o)
 
@@ -258,20 +272,26 @@ class TP_Attn:
         axis = self.axis
         hq, hd = self._hq_loc, self.head_dim
 
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, None), P(None, axis)),
+                           in_specs=(P(None, None),
+                                     qspec(self.w_qkv, P(None, axis),
+                                           P(axis))),
                            out_specs=P(None, axis), check_vma=False)
         def qkv_local(x_r, w_loc):
-            return x_r @ w_loc
+            return qmm(x_r, w_loc)
 
         qkv = qkv_local(x, self.w_qkv)
         o = self._local_attn(qkv, cos, sin, positions)
 
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, axis), P(axis, None)),
+                           in_specs=(P(None, axis),
+                                     qspec(self.w_o, P(axis, None),
+                                           P(None))),
                            out_specs=P(axis, None, None), check_vma=False)
         def o_partial(o_loc, wo_loc):
-            return (o_loc @ wo_loc)[None]
+            return qmm(o_loc, wo_loc)[None]
 
         parts = o_partial(o, self.w_o)
         del hq, hd
@@ -281,11 +301,15 @@ class TP_Attn:
         """Fused GEMM+AR for the O projection (reference: tp_attn.py:318)."""
         axis = self.axis
 
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, None), P(None, axis)),
+                           in_specs=(P(None, None),
+                                     qspec(self.w_qkv, P(None, axis),
+                                           P(axis))),
                            out_specs=P(None, axis), check_vma=False)
         def qkv_local(x_r, w_loc):
-            return x_r @ w_loc
+            return qmm(x_r, w_loc)
 
         qkv = qkv_local(x, self.w_qkv)
         o = self._local_attn(qkv, cos, sin, positions)
@@ -459,12 +483,16 @@ class TP_Attn:
             ctx = create_gemm_ar_context(self.mesh, axis)
             y = gemm_allreduce(o, self.w_o, ctx)
         elif mode == "ar":
+            from triton_dist_tpu.kernels.quant import qmm, qspec
+
             @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, axis), P(axis, None)),
+                               in_specs=(P(None, axis),
+                                         qspec(self.w_o, P(axis, None),
+                                               P(None))),
                                out_specs=P(axis, None, None),
                                check_vma=False)
             def o_partial(o_loc, wo_loc):
-                return (o_loc @ wo_loc)[None]
+                return qmm(o_loc, wo_loc)[None]
 
             y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
         else:  # "xla" oracle and "flash": psum epilogue

@@ -66,31 +66,45 @@ class TP_MLP:
 
     def _local_swiglu(self, c):
         """Apply SwiGLU on each rank's [gate_r | up_r] block."""
-        n = self.mesh.shape[self.axis]
-        i_loc = self.w_gate_up.shape[1] // (2 * n)
-
         import functools
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
                            in_specs=P(None, self.axis),
                            out_specs=P(None, self.axis), check_vma=False)
         def f(c_loc):
             return swiglu_ref(c_loc)
 
-        del i_loc
         return f(c)
 
     def fwd_xla(self, x):
         """Pure-XLA oracle (reference: torch_fwd, tp_mlp.py:~100): jnp +
-        XLA psum collective — the torch/NCCL role from the reference."""
+        XLA psum collective — the torch/NCCL role from the reference.
+        QuantW weights dequant via qmm (the int8 decode config runs
+        every mode)."""
         import functools
-        c = x @ self.w_gate_up
+        from triton_dist_tpu.kernels.quant import QuantW, qmm, qspec
+        if isinstance(self.w_gate_up, QuantW):
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=(P(None, None),
+                          qspec(self.w_gate_up, P(None, self.axis),
+                                P(self.axis))),
+                out_specs=P(None, self.axis), check_vma=False)
+            def up(x_r, w_loc):
+                return qmm(x_r, w_loc)
+
+            c = up(x, self.w_gate_up)
+        else:
+            c = x @ self.w_gate_up
         h = self._local_swiglu(c)
 
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, self.axis), P(self.axis, None)),
+                           in_specs=(P(None, self.axis),
+                                     qspec(self.w_down, P(self.axis, None),
+                                           P(None))),
                            out_specs=P(None, None), check_vma=False)
         def down(h_loc, wd_loc):
-            return jax.lax.psum(h_loc @ wd_loc, self.axis)
+            return jax.lax.psum(qmm(h_loc, wd_loc), self.axis)
 
         return down(h, self.w_down)
 
@@ -109,14 +123,19 @@ class TP_MLP:
         axis = self.axis
 
         import functools
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, None), P(None, axis),
-                                     P(axis, None)),
+                           in_specs=(P(None, None),
+                                     qspec(self.w_gate_up, P(None, axis),
+                                           P(axis)),
+                                     qspec(self.w_down, P(axis, None),
+                                           P(None))),
                            out_specs=P(axis, None, None), check_vma=False)
         def partial_mlp(x_r, wgu_loc, wd_loc):
-            c = x_r @ wgu_loc
+            c = qmm(x_r, wgu_loc)
             h = swiglu_ref(c)
-            return (h @ wd_loc)[None]
+            return qmm(h, wd_loc)[None]
 
         parts = partial_mlp(x, self.w_gate_up, self.w_down)  # [n, M, D]
         return all_reduce(parts, mesh=self.mesh, axis=axis)
@@ -127,11 +146,15 @@ class TP_MLP:
         axis = self.axis
 
         import functools
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
         @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=(P(None, None), P(None, axis)),
+                           in_specs=(P(None, None),
+                                     qspec(self.w_gate_up, P(None, axis),
+                                           P(axis))),
                            out_specs=P(None, axis), check_vma=False)
         def up(x_r, wgu_loc):
-            return swiglu_ref(x_r @ wgu_loc)
+            return swiglu_ref(qmm(x_r, wgu_loc))
 
         h = up(x, self.w_gate_up)                   # [M, I] P(None, tp)
         ctx = create_gemm_ar_context(self.mesh, axis)

@@ -144,10 +144,11 @@ class DenseLLM:
         """Weight-only int8 copy for the bandwidth-bound decode regime
         (kernels/quant.py): projection weights and the lm_head become
         QuantW (int8 + per-column scale), halving the per-step weight
-        read. Valid for the "flash"/"xla" forward modes (qmm dequants
-        after each dot); the comm-kernel modes keep bf16 weights — their
-        Pallas GEMMs stream bf16 operands. Embed stays bf16 (it is a
-        gather, not a GEMM)."""
+        read. Valid for EVERY forward mode: "flash"/"xla" dequant via
+        qmm, and the comm-kernel modes ("dist"/"ar"/"gemm_ar") stream
+        int8 weight panels through ag_gemm/gemm_rs/gemm_allreduce with
+        the per-column dequant fused after each dot (exact). Embed
+        stays bf16 (it is a gather, not a GEMM)."""
         from triton_dist_tpu.kernels.quant import quantize_int8 as q8
         layers = tuple(
             dataclasses.replace(

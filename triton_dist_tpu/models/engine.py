@@ -70,14 +70,19 @@ class Engine:
         self.sampling = sampling
         self._sample_params = dict(temperature=temperature, k=top_k,
                                    p=top_p)
-        from triton_dist_tpu.kernels.quant import QuantW
-        w0 = model.layers[0].attn.w_qkv if model.layers else None
-        if isinstance(w0, QuantW) and backend not in ("flash", "xla"):
-            raise ValueError(
-                f"backend={backend!r} runs the comm-kernel GEMMs, which "
-                "stream bf16 weight operands; int8-quantized models "
-                "(quantize_int8) support the 'flash'/'xla' backends only")
+        # int8-quantized models run on EVERY backend: the comm-kernel
+        # GEMMs (ag_gemm/gemm_rs/gemm_allreduce) stream int8 weight
+        # panels and dequant per column after the dot (exact), so the
+        # bandwidth win survives multi-chip TP decode (reference analog:
+        # quantized comm payloads, low_latency_all_to_all_v2.py:213).
         if backend == "mega":
+            from triton_dist_tpu.kernels.quant import QuantW
+            if model.layers and isinstance(model.layers[0].attn.w_qkv,
+                                           QuantW):
+                raise ValueError(
+                    "backend='mega' repacks raw bf16 weight panels and "
+                    "has no dequant path; int8 models run on the other "
+                    "backends")
             if kv_dtype is not None:
                 raise ValueError(
                     "backend='mega' reads the KV cache directly and has "
