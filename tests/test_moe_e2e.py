@@ -89,3 +89,60 @@ def test_ep_moe_fused_vs_xla(ctx8, k):
         out = moe(x, mode="ep_fused")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_ep_moe_fused_tiled_weights(ctx8):
+    """Shapes whose expert panels exceed VMEM now stream I-tiles inside
+    the fused kernel (gate/up column tiles + down-proj row tiles with
+    an accumulated down-proj) instead of raising — the fused-kernel
+    analog of the chain's grouped-GEMM tiling (reference:
+    ep_all2all_fused.py:599). Forced here via block_i at an
+    interpreter-sized shape; the auto picker's threshold math is
+    exercised by test_ep_fused_tiling_picker."""
+    import functools
+    from jax.sharding import NamedSharding
+    from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_device
+    from triton_dist_tpu.layers.ep_moe import EP_MoE
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, D, I = 2 * n, 128, 256
+    T = 8 * n
+    rng = np.random.RandomState(77)
+    router = rng.randn(D, E).astype(np.float32) * 0.5
+    wg = rng.randn(E, D, I).astype(np.float32) * (D ** -0.5)
+    wu = rng.randn(E, D, I).astype(np.float32) * (D ** -0.5)
+    wd = rng.randn(E, I, D).astype(np.float32) * (I ** -0.5)
+    moe = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp", top_k=2,
+                      capacity_factor=float(E))
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = moe.fwd_xla(x)
+        out = moe(x, mode="ep_fused", fused_block_i=128)
+        out1 = moe(x, mode="ep_fused", fused_block_i=128,
+                   fused_weight_buffers=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_ep_fused_tiling_picker():
+    """The auto picker streams I-tiles when whole panels blow the VMEM
+    budget, and raises only when even a 128-tile cannot fit."""
+    from triton_dist_tpu.kernels.ep_fused import _pick_block_i
+    # two whole bf16 panels of D=4096, I=1536 are ~50MB -> tiled (the
+    # VERDICT r3 'real MoE shape'); cap_e=256 needs the single-buffered
+    # weight stream
+    bi, wbuf = _pick_block_i(cap_e=256, D=4096, I=1536, isz=2)
+    assert bi is not None and bi % 128 == 0 and 1536 % bi == 0
+    assert wbuf in (1, 2)
+    # smaller token tiles get the double-buffered stream
+    bi2, wbuf2 = _pick_block_i(cap_e=64, D=4096, I=1536, isz=2)
+    assert bi2 is not None and wbuf2 == 2
+    # small shapes stream whole panels (no tiling requested)
+    assert _pick_block_i(cap_e=64, D=128, I=256, isz=4,
+                         need=False) == (None, 0)
+    # pathological: cap_e so large the fixed tiles alone blow VMEM
+    import pytest
+    with pytest.raises(ValueError):
+        _pick_block_i(cap_e=8192, D=4096, I=1536, isz=2)

@@ -231,3 +231,27 @@ else:
                           text=True, timeout=1200)
     assert "WATCHDOG_OK" in proc.stdout, (proc.stdout[-2000:],
                                           proc.stderr[-2000:])
+
+
+def test_ag_gemm_in_kernel_straggler():
+    """Mid-ring straggler INSIDE the op (reference:
+    ag_gemm(..., straggler_option), allgather_gemm.py:660-661): rank 3
+    stalls at ring step 2, so every later consumer step must really
+    block on its per-chunk recv semaphore. Output must be unchanged."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.kernels import ag_gemm, create_ag_gemm_context
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+    rng = np.random.RandomState(8)
+    M, K, N = 8 * n, 64, 32 * n
+    a = jax.device_put(jnp.asarray(rng.randn(M, K), jnp.float32) * .1,
+                       NamedSharding(mesh, P("tp", None)))
+    b = jax.device_put(jnp.asarray(rng.randn(K, N), jnp.float32) * .1,
+                       NamedSharding(mesh, P(None, "tp")))
+    want = np.asarray(jax.jit(
+        lambda x, w: ag_gemm(x, w, create_ag_gemm_context(mesh)))(a, b))
+    got = np.asarray(jax.jit(
+        lambda x, w: ag_gemm(x, w, create_ag_gemm_context(mesh),
+                             straggler=(3, min(2, n - 1), 500)))(a, b))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

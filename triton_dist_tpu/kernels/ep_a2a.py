@@ -159,6 +159,38 @@ def plan_dispatch(topk_idx, n: int, experts_per_rank: int, cap: int
     return DispatchPlan(slot=slot, valid=valid, token=token)
 
 
+def plan_dispatch_valid(expert_ids, valid, n: int, experts_per_rank: int,
+                        cap: int) -> "tuple[DispatchPlan, jax.Array]":
+    """plan_dispatch for rows that carry their own validity mask —
+    the SECOND hop of the two-tier EP path, where the 'tokens' are
+    capacity slots arrived over DCN and the padding slots must not
+    consume ICI capacity (reference analog: the per-node recv-offset
+    recomputation of kernel_get_ag_splits_and_recv_offset,
+    ep_a2a.py:382, which the inter-node dispatch runs after the
+    cross-node exchange). expert_ids: [R] ids within this tier's range
+    [0, n*experts_per_rank); valid: [R] bool. Invalid rows get
+    slot=n*cap, valid=False."""
+    R = expert_ids.shape[0]
+    dest = jnp.where(valid, expert_ids // experts_per_rank, n)
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    start = jnp.searchsorted(sorted_dest, jnp.arange(n), side="left")
+    pos = jnp.arange(R) - start[jnp.minimum(sorted_dest, n - 1)]
+    ok = (sorted_dest < n) & (pos < cap)
+    slot_sorted = jnp.where(
+        ok, sorted_dest * cap + jnp.minimum(pos, cap - 1), n * cap)
+    inv = jnp.argsort(order, stable=True)
+    # dropped counts only VALID rows lost to capacity (padding is not
+    # a drop)
+    dropped = jnp.sum((sorted_dest < n) & ~ok).astype(jnp.int32)
+    plan = DispatchPlan(slot=slot_sorted[inv],
+                        valid=ok[inv] & valid,
+                        token=jnp.arange(R))
+    # DispatchPlan.dropped would count padding rows as drops on this
+    # tier; return the true (valid-only) count alongside
+    return plan, dropped
+
+
 def plan_dispatch_host(topk_idx, n: int, experts_per_rank: int, cap: int
                        ) -> DispatchPlan:
     """Host-side dispatch planning on the native icishmem alignment
