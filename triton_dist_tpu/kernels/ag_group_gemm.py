@@ -43,7 +43,7 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
-                          resident_b: bool,
+                          resident_b: bool, ablate: frozenset,
                           x_ref, w_ref, ag_ref, o_ref,
                           a_vmem, b_vmem, o_vmem,
                           a_sem, b_sems, o_sems, send_sem,
@@ -97,7 +97,9 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
             return x_ref
         return ag_ref.at[:, pl.ds(src * c_loc, c_loc), :]
 
-    if resident_b:
+    if "b_stream" in ablate:
+        pass
+    elif resident_b:
         pltpu.make_async_copy(w_ref, b_vmem, b_sems.at[0]).start()
     else:
         pltpu.make_async_copy(b_src(0, 0), b_vmem.at[0],
@@ -116,20 +118,25 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                           send_sem, recv_sems.at[src], right, axis)
         for e in range(E):
             et = s * E + e
-            pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
-                                  a_sem).wait()
-            if e + 1 < E:
+            if "a_stream" not in ablate or et == 0:
+                pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
+                                      a_sem).wait()
+            if "a_stream" not in ablate and e + 1 < E:
                 pltpu.make_async_copy(a_src(s, e + 1),
                                       a_vmem.at[(et + 1) % 2],
                                       a_sem).start()
             for j in range(nt):
                 g = et * nt + j
-                if not resident_b and g + 1 < G:
+                if "b_stream" in ablate:
+                    b_tile = b_vmem[0 if not resident_b else e]
+                elif not resident_b and g + 1 < G:
                     q1 = (g + 1) % EQ
                     pltpu.make_async_copy(b_src(q1 // nt, q1 % nt),
                                           b_vmem.at[(g + 1) % 2],
                                           b_sems.at[(g + 1) % 2]).start()
-                if resident_b:
+                if "b_stream" in ablate:
+                    pass
+                elif resident_b:
                     if g == 0:
                         pltpu.make_async_copy(w_ref, b_vmem,
                                               b_sems.at[0]).wait()
@@ -138,22 +145,26 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                     pltpu.make_async_copy(b_src(e, j), b_vmem.at[g % 2],
                                           b_sems.at[g % 2]).wait()
                     b_tile = b_vmem[g % 2]
-                if g >= 2:
+                if "writeback" not in ablate and g >= 2:
                     pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g - 2),
                                           o_sems.at[g % 2]).wait()
-                acc = jnp.dot(a_vmem[et % 2], b_tile,
-                              preferred_element_type=jnp.float32)
-                o_vmem[g % 2] = acc.astype(o_ref.dtype)
-                pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
-                                      o_sems.at[g % 2]).start()
+                if "dots" not in ablate:
+                    acc = jnp.dot(a_vmem[et % 2], b_tile,
+                                  preferred_element_type=jnp.float32)
+                    o_vmem[g % 2] = acc.astype(o_ref.dtype)
+                if "writeback" not in ablate:
+                    pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
+                                          o_sems.at[g % 2]).start()
         if s < n - 1:
             nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
             pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
-            # next step's first expert chunk: start now, wait at its dot
-            pltpu.make_async_copy(a_src(s + 1, 0),
-                                  a_vmem.at[((s + 1) * E) % 2],
-                                  a_sem).start()
-    for g in range(max(G - 2, 0), G):
+            if "a_stream" not in ablate:
+                # next step's first chunk: start now, wait at its dot
+                pltpu.make_async_copy(a_src(s + 1, 0),
+                                      a_vmem.at[((s + 1) * E) % 2],
+                                      a_sem).start()
+    for g in (range(max(G - 2, 0), G) if "writeback" not in ablate
+              else ()):
         pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
                               o_sems.at[g % 2]).wait()
     dl.quiet(send_sem, x_ref, n - 1)
@@ -162,7 +173,8 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
 def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
                   block_n: Optional[int] = None,
                   collective_id: Optional[int] = None,
-                  resident_b: Optional[bool] = None):
+                  resident_b: Optional[bool] = None,
+                  ablate: frozenset = frozenset()):
     """y[e] = allgather(x_e[e]) @ w[e] for every expert, overlapped
     (reference: ag_group_gemm, allgather_group_gemm.py:253).
 
@@ -210,7 +222,7 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
         out_specs=P(None, None, axis), check_vma=False)
     def _f(x_loc, w_loc):
         kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn,
-                                   resident)
+                                   resident, ablate)
         _, out = pl.pallas_call(
             kernel,
             out_shape=(

@@ -28,6 +28,17 @@ state are then plain [C, C] / [C, d] matmuls — everything MXU-shaped,
 sequential only across chunks (a lax.scan of length T/C). mode="scan"
 keeps the exact per-token recurrence (a lax.scan over tokens whose step
 is a batched outer product) as the slow-but-transparent oracle path.
+
+Perf note (round 4, B8/H16/T2048/d128 on v5e, data-chained timing):
+991 us with bf16 dot operands + the idec=ldec+I fold (was 1158). The
+kernel sits at ~3.9 us per grid step against ~1.2 us DMA + ~1.2 us
+ideal MXU + ~1.5 us VPU; variants MEASURED WORSE (keep for round 5):
+chunk C=128 1793, C=32 1221, head block X=8 1467, X=32 OOM; two-level
+block [32,32] solve 1553 (small-matmul overhead beats the 2.3x flop
+cut); state-independent U0/W2 precompute with K=2 chunks/step 1459
+(VMEM forces X=8); bf16 [C,C] elementwise + parallel head dim 1621.
+The remaining gap is the [64,64] solve chain's ~25% MXU shape
+utilization, which no tested restructuring beat.
 """
 
 from __future__ import annotations
@@ -65,9 +76,15 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
         S_scr[...] = s0_ref[...].astype(jnp.float32)
 
     f32 = jnp.float32
+    # bf16 inputs run every dot with bf16 operands + f32 accumulation
+    # (the MXU's native mode; f32-operand matmuls cost multiple passes).
+    # Measured 1158 -> 991 us at B8/H16/T2048/d128 with bit-identical
+    # outputs vs the f32-operand kernel on bf16 inputs. f32 inputs (the
+    # CPU differential tests) keep f32 operands.
+    mx = jnp.bfloat16 if q_ref.dtype == jnp.bfloat16 else f32
     S = S_scr[...]
-    qf = q_ref[...].astype(f32)                      # [X, C, dk]
-    kf = k_ref[...].astype(f32)
+    qf = q_ref[...].astype(mx)                       # [X, C, dk]
+    kf = k_ref[...].astype(mx)
     vf = v_ref[...].astype(f32)                      # [X, C, dv]
     # g/beta arrive pre-chunked as [1, X, C] blocks of a [nc, BH, C]
     # array (chunk axis major: a [X, C] block with C < 128 lanes, or a
@@ -76,11 +93,13 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
     bf = b_ref[0].astype(f32)
 
     def bmm(x, y):                                   # [X,a,b]@[X,b,c]
-        return jax.lax.dot_general(x, y, (((2,), (1,)), ((0,), (0,))),
+        return jax.lax.dot_general(x.astype(mx), y.astype(mx),
+                                   (((2,), (1,)), ((0,), (0,))),
                                    preferred_element_type=f32)
 
     def bmmT(x, y):                                  # [X,a,d]@[X,c,d]^T
-        return jax.lax.dot_general(x, y, (((2,), (2,)), ((0,), (0,))),
+        return jax.lax.dot_general(x.astype(mx), y.astype(mx),
+                                   (((2,), (2,)), ((0,), (0,))),
                                    preferred_element_type=f32)
 
     rowi = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
@@ -94,9 +113,9 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
     # mask exponents BEFORE exp: unmasked upper-triangle entries are
     # positive and overflow
     ldec = jnp.exp(jnp.where((rowi > colj)[None], decay, -1e30))
-    idec = jnp.exp(jnp.where((rowi >= colj)[None], decay, -1e30))
-    N = bf[..., None] * (ldec * bmmT(kf, kf))        # strictly lower
     eye = jnp.eye(C, dtype=f32)[None]
+    idec = ldec + eye            # diag decay is exp(0)=1: one exp saved
+    N = bf[..., None] * (ldec * bmmT(kf, kf))        # strictly lower
     Minv = eye - N
     P = bmm(N, N)
     for i in range(last_sq):
@@ -107,9 +126,10 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
     U = bmm(Minv, rhs)                               # [X, C, dv]
     O = A[..., None] * bmm(qf, S) + bmm(idec * bmmT(qf, kf), U)
     cum_last = jax.lax.slice_in_dim(cum, C - 1, C, axis=1)   # [X, 1]
-    w = jnp.exp(cum_last - cum)[..., None] * kf      # [X, C, dk]
+    w = jnp.exp(cum_last - cum)[..., None] * kf.astype(f32)  # [X, C, dk]
     S_new = (jnp.exp(cum_last)[..., None] * S
-             + jax.lax.dot_general(w, U, (((1,), (1,)), ((0,), (0,))),
+             + jax.lax.dot_general(w.astype(mx), U.astype(mx),
+                                   (((1,), (1,)), ((0,), (0,))),
                                    preferred_element_type=f32))
     o_ref[...] = O.astype(o_ref.dtype)
     S_scr[...] = S_new

@@ -9,6 +9,7 @@ Usage inside Pallas kernels:
 """
 
 from triton_dist_tpu.language.shmem_device import (  # noqa: F401
+    comm_trace,
     my_pe,
     n_pes,
     ring_neighbors,

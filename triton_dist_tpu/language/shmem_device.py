@@ -34,6 +34,58 @@ from jax.experimental import pallas as pl  # noqa: F401  (re-exported)
 from jax.experimental.pallas import tpu as pltpu
 
 
+# --------------------------------------------------------------------------
+# Trace-time comm recorder: with comm_trace() active, every facade call
+# appends its STATIC structure (op kind, payload bytes, program order)
+# while the kernel traces. Captures the per-device SPMD program exactly
+# once (shard_map traces one program), with zero runtime overhead —
+# tools/overlap_report.py uses it to build MULTICHIP_OVERLAP.md, the
+# structural analog of the reference's per-op scaling traces.
+# --------------------------------------------------------------------------
+
+_COMM_TRACE = None
+
+
+class comm_trace:
+    """Capture the comm structure of kernels traced inside the block:
+
+        with dl.comm_trace() as events:
+            jax.jit(fn)(args)          # or plain call
+        # events == [{"op": "put", "bytes": ..., ...}, ...]
+    """
+
+    def __enter__(self):
+        global _COMM_TRACE
+        self._prev = _COMM_TRACE
+        _COMM_TRACE = []
+        return _COMM_TRACE
+
+    def __exit__(self, *exc):
+        global _COMM_TRACE
+        _COMM_TRACE = self._prev
+        return False
+
+
+def _ref_bytes(ref):
+    try:
+        import math as _math
+        n = _math.prod(ref.shape)
+        return int(n) * jnp.dtype(ref.dtype).itemsize
+    except Exception:
+        return None
+
+
+def _emit(op: str, ref=None, **kw):
+    if _COMM_TRACE is None:
+        return
+    ev = {"op": op}
+    if ref is not None:
+        ev["bytes"] = _ref_bytes(ref)
+        ev["shape"] = tuple(getattr(ref, "shape", ()) or ())
+    ev.update(kw)
+    _COMM_TRACE.append(ev)
+
+
 def my_pe(axis: str) -> jax.Array:
     """This device's rank along `axis` (ref: nvshmem_my_pe).
 
@@ -80,6 +132,7 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,
     device `pe` of the same kernel instance (ref: nvshmem_putmem_nbi_block,
     libshmem_device.py). Returns the descriptor; call .wait_send()/.wait()
     or use quiet() on the send semaphore."""
+    _emit("put", src_ref, axis=axis)
     device_id, did_type = _device_id(pe, axis)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref, dst_ref=dst_ref,
@@ -106,12 +159,14 @@ def local_copy(dst_ref, src_ref, sem) -> None:
     putmem from the peer's program instance. Keeping the name honest
     avoids silently-local 'gets' in ported kernels.
     """
+    _emit("local_copy", src_ref)
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
     dma.start()
     dma.wait()
 
 
 def local_copy_nbi(dst_ref, src_ref, sem):
+    _emit("local_copy_nbi", src_ref)
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
     dma.start()
     return dma
@@ -120,6 +175,7 @@ def local_copy_nbi(dst_ref, src_ref, sem):
 def signal_op(sem, inc: int = 1, pe=None, axis: Optional[str] = None) -> None:
     """Increment a (possibly remote) semaphore (ref: nvshmemx_signal_op
     with NVSHMEM_SIGNAL_ADD)."""
+    _emit("signal", remote=pe is not None, axis=axis)
     if pe is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -141,6 +197,7 @@ def dma_wait(sem, ref, count: int = 1) -> None:
     semaphore. TPU DMA semaphores count *bytes*, so the wait is expressed
     by a descriptor of matching shape (the canonical Pallas idiom: a
     self-copy descriptor used only for its wait)."""
+    _emit("dma_wait", ref, count=count)
     for _ in range(count):
         pltpu.make_async_copy(ref, ref, sem).wait()
 
@@ -180,6 +237,7 @@ def barrier_all(axis: str, barrier_sem=None) -> None:
     # semaphore (Mosaic pairs get_barrier_semaphore with a collective_id,
     # which single-device kernels must not pass)
     n_static = _static_axis_size(axis)
+    _emit("barrier_all", axis=axis, n=n_static)
     if n_static <= 1 and barrier_sem is None:
         return
     sem = barrier_sem if barrier_sem is not None else pltpu.get_barrier_semaphore()

@@ -233,6 +233,58 @@ def run_report(write_json=None):
         gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
         + collective_sol_us("rs", E * capT * Dm * isz, n, spec=spec))
 
+    he2 = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
+                         NamedSharding(mesh, P(None, None, "tp")))
+    from triton_dist_tpu.kernels.moe_reduce_ar import moe_reduce_ar
+    add("moe_reduce_ar",
+        chain(lambda v: moe_reduce_ar(v, w2, mesh=mesh)), he2,
+        gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
+        + collective_sol_us("ar", E * capT * Dm * isz, n, spec=spec))
+
+    # fused one-kernel EP MoE at the ep_fused docstring shape; SOL =
+    # the grouped-GEMM flops over the CAPACITY rows the kernel actually
+    # multiplies + the a2a payload both ways
+    from triton_dist_tpu.layers.ep_moe import EP_MoE
+    Ee, De, Ie = (8, 1024, 512) if on_tpu else (2 * n, 64, 32)
+    Te = 1024 if on_tpu else 8 * n
+    epr_rng = np.random.RandomState(7)
+    moe_f = EP_MoE.init(
+        jnp.asarray(epr_rng.randn(De, Ee), dt) * 0.5,
+        jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
+        jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
+        jnp.asarray(epr_rng.randn(Ee, Ie, De), dt) * (Ie ** -0.5),
+        mesh=mesh, axis="tp", top_k=2, capacity_factor=1.25)
+    xe_f = jax.device_put(jnp.asarray(epr_rng.randn(Te, De), dt) * 0.3,
+                          NamedSharding(mesh, P("tp", None)))
+    cap_rows = Ee * moe_f._cap_e(Te // n) * n
+    ep_sol = (gemm_sol_us(cap_rows, De, 2 * Ie, itemsize=isz, spec=spec)
+              + gemm_sol_us(cap_rows, Ie, De, itemsize=isz, spec=spec)
+              + 2 * collective_sol_us("a2a", cap_rows * De * isz, n,
+                                      spec=spec))
+    add("ep_fused",
+        chain(lambda v: moe_f(v, mode="ep_fused")), xe_f, ep_sol)
+
+    # Ulysses fused QKV/O kernels (both a2a directions ride their
+    # adjacent GEMMs): SOL = GEMM + a2a payload
+    from triton_dist_tpu.kernels.sp_attention import (o_a2a_gemm,
+                                                      qkv_gemm_a2a)
+    Bu, Su, Du, Nu = (2, 2048, 1024, 1024) if on_tpu else (1, 8 * n, 64,
+                                                           64)
+    xu = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Du), dt) * 0.1,
+                        NamedSharding(mesh, P(None, "tp", None)))
+    wu_ = jnp.asarray(rng.randn(Du, Nu), dt) * 0.1
+    add("ulysses_qkv_gemm_a2a",
+        chain(lambda v: qkv_gemm_a2a(v, wu_, mesh=mesh, axis="tp")), xu,
+        gemm_sol_us(Bu * Su // n, Du, Nu, itemsize=isz, spec=spec)
+        + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n, spec=spec))
+    xo = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Nu), dt) * 0.1,
+                        NamedSharding(mesh, P(None, None, "tp")))
+    wo_ = jnp.asarray(rng.randn(Nu, Du), dt) * 0.1
+    add("ulysses_o_a2a_gemm",
+        chain(lambda v: o_a2a_gemm(v, wo_, mesh=mesh, axis="tp")), xo,
+        gemm_sol_us(Bu * Su // n, Nu, Du, itemsize=isz, spec=spec)
+        + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n, spec=spec))
+
     # GDN chunkwise forward, Pallas kernel (gdn_fwd default; roofline:
     # qkv/g/beta/o traffic vs the chunk matmul FLOPs)
     from triton_dist_tpu.kernels.gdn import gdn_fwd
