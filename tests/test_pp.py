@@ -73,3 +73,47 @@ def test_pipeline_single_microbatch():
         ref = ref @ w[s]
     np.testing.assert_allclose(np.asarray(out[0]), ref, atol=1e-4,
                                rtol=1e-4)
+
+
+def test_ppipeline_no_replicate_out():
+    """replicate_out=False skips the output psum and returns the
+    per-stage banks pp-sharded [n, M, B, D]: index n-1 is the result,
+    other stages banked zeros — the zero-comm mode for consumers on
+    the final stage."""
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("pp",))
+    rng = np.random.RandomState(21)
+    D = 16
+    ws = rng.randn(n, D, D).astype(np.float32) * (D ** -0.5)
+    pipe = PPipeline.init({"w": ws}, lambda p, x: jnp.tanh(x @ p["w"]),
+                          mesh=mesh, axis="pp")
+    M, B = n + 2, 4
+    x = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+    want = np.asarray(jax.jit(lambda v: pipe(v))(x))
+    got = np.asarray(jax.jit(
+        lambda v: pipe(v, replicate_out=False))(x))
+    assert got.shape == (n, M, B, D)
+    np.testing.assert_allclose(got[-1], want, rtol=1e-5, atol=1e-5)
+    assert not np.any(got[:-1])
+
+
+def test_ppipeline_many_microbatches_nonsquare():
+    """M >> n and a non-square stage shape: the GPipe tick arithmetic
+    (bubble masking, out_slot clamping) must hold away from the M==n
+    corner the basic test uses."""
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("pp",))
+    rng = np.random.RandomState(22)
+    D = 24
+    ws = rng.randn(n, D, D).astype(np.float32) * (D ** -0.5)
+    bs = rng.randn(n, 1, D).astype(np.float32) * 0.1
+    pipe = PPipeline.init(
+        {"w": ws, "b": bs},
+        lambda p, x: jnp.tanh(x @ p["w"] + p["b"]), mesh=mesh, axis="pp")
+    M, B = 3 * n + 1, 2
+    x = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+    got = np.asarray(jax.jit(lambda v: pipe(v))(x))
+    ref = np.asarray(x)
+    for s in range(n):
+        ref = np.tanh(ref @ ws[s] + bs[s])
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)

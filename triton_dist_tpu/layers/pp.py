@@ -52,9 +52,19 @@ class PPipeline:
         return PPipeline(stage_params=jax.tree.map(put, stage_params),
                          mesh=mesh, axis=axis, stage_fn=stage_fn)
 
-    def __call__(self, x_mb):
+    def __call__(self, x_mb, replicate_out: bool = True):
         """x_mb: [M, B, D] microbatches, replicated. Returns [M, B, D]:
-        each microbatch passed through all n stages in order."""
+        each microbatch passed through all n stages in order.
+
+        replicate_out=True (default) replicates the output stack to
+        every stage with ONE psum over the pp axis per call (a ring
+        all-reduce: ~2(n-1)/n of the stack's bytes per device — n-1
+        stages contribute zero stacks, the price of the SPMD-uniform
+        formulation). replicate_out=False skips the collective
+        entirely and returns the per-stage banks as an HONESTLY-sharded
+        [n_stages, M, B, D] array (P(pp) on dim 0): only index n-1
+        holds data; `out[-1]` materializes it where consumed, so a
+        consumer living on the last stage pays zero comm."""
         n = self.mesh.shape[self.axis]
         M, B, D = x_mb.shape
         axis = self.axis
@@ -67,7 +77,8 @@ class PPipeline:
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
             in_specs=(p_specs, P(*(None,) * 3)),
-            out_specs=P(*(None,) * 3), check_vma=False)
+            out_specs=(P(*(None,) * 3) if replicate_out
+                       else P(axis, *(None,) * 3)), check_vma=False)
         def run(params_loc, mb):
             me = jax.lax.axis_index(axis)
             params = jax.tree.map(lambda l: l[0], params_loc)
@@ -96,6 +107,8 @@ class PPipeline:
             outs0 = jnp.zeros((M, B, D), x_mb.dtype)
             reg0 = jnp.zeros((B, D), x_mb.dtype)
             _, outs = jax.lax.fori_loop(0, M + n - 1, tick, (reg0, outs0))
+            if not replicate_out:
+                return outs[None]     # -> [n, M, B, D] sharded on pp
             # only the last stage banked non-zeros; psum replicates its
             # values to every stage (the out spec says replicated)
             return jax.lax.psum(outs, axis)
