@@ -1,0 +1,39 @@
+"""Isolated driver for the comm_trace structure case (run_isolated):
+the recorder works standalone but the in-process pytest substrate's
+interpreter state makes the traced ag_gemm flaky, so it runs in a
+fresh process like the other heavy interpreted cases."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def case_ag_gemm_trace():
+    from triton_dist_tpu import language as dl
+    from triton_dist_tpu.kernels import ag_gemm, create_ag_gemm_context
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+    rng = np.random.RandomState(2)
+    M, K, N = 8 * n, 128, 32 * n
+    a = jax.device_put(jnp.asarray(rng.randn(M, K), jnp.float32),
+                       NamedSharding(mesh, P("tp", None)))
+    b = jax.device_put(jnp.asarray(rng.randn(K, N), jnp.float32),
+                       NamedSharding(mesh, P(None, "tp")))
+    ctx = create_ag_gemm_context(mesh)
+    with dl.comm_trace() as events:
+        jax.jit(lambda x, w: ag_gemm(x, w, ctx))(a, b)
+    puts = [e for e in events if e["op"] == "put"]
+    assert len(puts) == n - 1, events
+    assert all(e["bytes"] == (M // n) * K * 4 for e in puts), puts
+    assert sum(e["op"] == "barrier_all" for e in events) == 1
+    assert events[-1]["op"] == "dma_wait", events[-1]
+    with dl.comm_trace() as empty:
+        pass
+    assert empty == []
+
+
+if __name__ == "__main__":
+    {"ag_gemm_trace": case_ag_gemm_trace}[sys.argv[1]]()
+    print("CASE_OK")

@@ -132,3 +132,35 @@ def test_group_profile(tmp_path):
     assert prof["wall_s"] > 0
     assert prof["trace_dir"] == str(tmp_path)
     assert any(os.path.isfile(f) for f in prof["files"])
+
+
+def test_comm_trace_records_put_structure():
+    """dl.comm_trace() captures the per-device SPMD comm structure at
+    trace time: the ag_gemm ring must show n-1 neighbor puts of the
+    local chunk's bytes, one barrier, and the final send drain — the
+    raw material of MULTICHIP_OVERLAP.md. Runs isolated (fresh
+    process): see _comm_trace_case.py."""
+    from tests._isolation import run_isolated
+    run_isolated("_comm_trace_case.py", "ag_gemm_trace")
+
+
+def test_kprof_attribution_and_trace(tmp_path):
+    """kprof: attribution = t_full - t_without (clamped at 0), residual
+    covers unattributed time, Perfetto export is well-formed."""
+    import json
+    from triton_dist_tpu.tools.kprof import profile_phases
+    rep = profile_phases(
+        "toy", lambda: 100.0,
+        {"mxu": lambda: 40.0,      # attribution 60
+         "dma": lambda: 90.0,      # attribution 10
+         "hidden": lambda: 120.0}, # slower-without (noise) -> clamp 0
+        json_path=str(tmp_path / "p.json"),
+        trace_path=str(tmp_path / "p.trace.json"))
+    assert rep["phases"]["mxu"]["attribution_us"] == 60.0
+    assert rep["phases"]["hidden"]["attribution_us"] == 0.0
+    assert rep["residual_us"] == 30.0
+    assert abs(rep["overlap_slack"] - 0.7) < 1e-9
+    tr = json.load(open(tmp_path / "p.trace.json"))
+    names = [e["name"] for e in tr["traceEvents"]]
+    assert "toy (full)" in names and "mxu" in names
+    assert "residual (protocol/launch)" in names
