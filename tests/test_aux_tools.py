@@ -140,7 +140,7 @@ def test_comm_trace_records_put_structure():
     local chunk's bytes, one barrier, and the final send drain — the
     raw material of MULTICHIP_OVERLAP.md. Runs isolated (fresh
     process): see _comm_trace_case.py."""
-    from tests._isolation import run_isolated
+    from _isolation import run_isolated
     run_isolated("_comm_trace_case.py", "ag_gemm_trace")
 
 
