@@ -44,10 +44,7 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                           resident_b: bool, ablate: frozenset,
-                          x_ref, w_ref, ag_ref, o_ref,
-                          a_vmem, b_vmem, o_vmem,
-                          a_sem, b_sems, o_sems, send_sem,
-                          recv_sems):
+                          quant: bool, *refs):
     """Ring AG of capacity chunks + per-expert GEMM consumption.
     x_ref: [E, c_loc, D]; w_ref: [E, D, n_loc]; ag_ref: [E, capT, D];
     o_ref: [E, capT, n_loc].
@@ -67,6 +64,13 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
     expert chunks and (non-resident) B tiles double-buffer under the
     dots, and output tiles stage through two slots waited two tiles
     later — the MXU never idles on a same-iteration DMA."""
+    if quant:
+        (x_ref, w_ref, s_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
+         s_vmem, a_sem, b_sems, o_sems, send_sem, recv_sems,
+         s_sem) = refs
+    else:
+        (x_ref, w_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
+         a_sem, b_sems, o_sems, send_sem, recv_sems) = refs
     me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     _, c_loc, D = x_ref.shape
     n_loc = w_ref.shape[2]
@@ -105,6 +109,12 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
         pltpu.make_async_copy(b_src(0, 0), b_vmem.at[0],
                               b_sems.at[0]).start()
     pltpu.make_async_copy(a_src(0, 0), a_vmem.at[0], a_sem).start()
+    if quant:
+        # per-expert per-output-column dequant scales (tiny, loaded
+        # once; applied after each dot — exact, kernels/quant.py)
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
+        cp_s.wait()
     dl.barrier_all(axis)
 
     _, right = dl.ring_neighbors(axis)
@@ -149,8 +159,12 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                     pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g - 2),
                                           o_sems.at[g % 2]).wait()
                 if "dots" not in ablate:
+                    if quant:
+                        b_tile = b_tile.astype(a_vmem.dtype)
                     acc = jnp.dot(a_vmem[et % 2], b_tile,
                                   preferred_element_type=jnp.float32)
+                    if quant:
+                        acc = acc * s_vmem[e, :, pl.ds(j * bn, bn)]
                     o_vmem[g % 2] = acc.astype(o_ref.dtype)
                 if "writeback" not in ablate:
                     pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
@@ -179,8 +193,23 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     (reference: ag_group_gemm, allgather_group_gemm.py:253).
 
     x_e: [E, capT, D] capacity-grouped tokens, capT sharded over `axis`;
-    w: [E, D, N] expert weights, N sharded. Returns [E, capT, N] with N
-    sharded over `axis`."""
+    w: [E, D, N] expert weights (or QuantW with q [E, D, N] int8 and
+    s [E, N] per-expert per-column scales — int8 panels stream, dequant
+    after each dot), N sharded. Returns [E, capT, N] with N sharded
+    over `axis`."""
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(w, QuantW)
+    w_s = None
+    if quant:
+        E_, N_ = w.q.shape[0], w.q.shape[2]
+        if w.q.ndim != 3 or w.s.shape != (E_, N_):
+            raise ValueError(
+                f"ag_group_gemm QuantW wants q [E, D, N] with s [E, N] "
+                f"(per-expert per-column scales; quantize_int8 on the "
+                f"[E, D, N] stack produces this); got q {w.q.shape}, "
+                f"s {w.s.shape}")
+        w_s = w.s.astype(jnp.float32)[:, None, :]   # [E, 1, N]
+        w = w.q
     n = mesh.shape[axis]
     E, capT, D = x_e.shape
     N = w.shape[2]
@@ -216,38 +245,59 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     if resident:
         bn = n_loc
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(None, axis, None), P(None, None, axis)),
-        out_specs=P(None, None, axis), check_vma=False)
-    def _f(x_loc, w_loc):
+    def _call(x_loc, w_loc, s_loc=None):
         kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn,
-                                   resident, ablate)
+                                   resident, ablate, quant)
+        scratch = [
+            pltpu.VMEM((2, c_loc, D), x_loc.dtype),
+            pltpu.VMEM((E, D, n_loc) if resident else (2, D, bn),
+                       w_loc.dtype),
+            pltpu.VMEM((2, c_loc, bn), x_loc.dtype),
+        ]
+        if quant:
+            scratch.append(pltpu.VMEM((E, 1, n_loc), jnp.float32))
+        scratch += [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ]
+        if quant:
+            scratch.append(pltpu.SemaphoreType.DMA(()))
+        args = (x_loc, w_loc) + ((s_loc,) if quant else ())
         _, out = pl.pallas_call(
             kernel,
             out_shape=(
                 jax.ShapeDtypeStruct((E, capT, D), x_loc.dtype),
                 jax.ShapeDtypeStruct((E, capT, n_loc), x_loc.dtype),
             ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec(memory_space=pl.ANY)],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
-            scratch_shapes=[
-                pltpu.VMEM((2, c_loc, D), x_loc.dtype),
-                pltpu.VMEM((E, D, n_loc) if resident else (2, D, bn),
-                           w_loc.dtype),
-                pltpu.VMEM((2, c_loc, bn), x_loc.dtype),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((n,)),
-            ],
+            scratch_shapes=scratch,
             compiler_params=shmem_compiler_params(collective_id, n=n),
             interpret=interpret_mode(),
-        )(x_loc, w_loc)
+        )(*args)
         return out
+
+    if quant:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, axis, None), P(None, None, axis),
+                      P(None, None, axis)),
+            out_specs=P(None, None, axis), check_vma=False)
+        def _fq(x_loc, w_loc, s_loc):
+            return _call(x_loc, w_loc, s_loc)
+
+        return _fq(x_e, w, w_s)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis, None), P(None, None, axis)),
+        out_specs=P(None, None, axis), check_vma=False)
+    def _f(x_loc, w_loc):
+        return _call(x_loc, w_loc)
 
     return _f(x_e, w)
 

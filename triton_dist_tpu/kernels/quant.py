@@ -34,12 +34,16 @@ class QuantW:
 
 
 def quantize_int8(w) -> QuantW:
-    """Per-output-channel symmetric int8 quantization of [K, N]."""
+    """Per-output-channel symmetric int8 quantization: [K, N] -> s [N],
+    or a stacked expert weight [E, K, N] -> s [E, N] (reduction over
+    the contraction axis in both cases — the shape ag_group_gemm's
+    QuantW path expects)."""
     if isinstance(w, QuantW):
         return w
     wf = jnp.asarray(w).astype(jnp.float32)
-    s = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), 1e-8) / 127.0
-    q = jnp.round(wf / s).astype(jnp.int8)
+    axis = wf.ndim - 2          # the contraction (K) axis
+    s = jnp.maximum(jnp.max(jnp.abs(wf), axis=axis), 1e-8) / 127.0
+    q = jnp.round(wf / jnp.expand_dims(s, axis)).astype(jnp.int8)
     return QuantW(q=q, s=s)
 
 
