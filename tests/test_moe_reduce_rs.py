@@ -82,3 +82,29 @@ def test_moe_reduce_ar_vs_oracle(resident_b):
     assert y.shape == (E, capT, D)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                atol=5e-4, rtol=1e-4)
+
+
+def test_moe_reduce_ar_int8_weights():
+    """QuantW down-proj panels (q [E,F,D] int8, s [E,D]) through the
+    fused grouped-GEMM+AR decode epilogue — dequant applied to each
+    partial before the n-way sum (exact)."""
+    import os
+    from triton_dist_tpu.kernels.moe_reduce_ar import moe_reduce_ar
+    from triton_dist_tpu.kernels.quant import QuantW, quantize_int8
+    n = mesh.shape["tp"]
+    # real-device runs need F/n and D lane-aligned (the kernel's guard)
+    f_dev = 128 if os.environ.get("TDTPU_REAL_DEVICES") == "1" else 32
+    E, capT, F, D = 2, 16, f_dev * n, 128
+    rng = np.random.RandomState(12)
+    h = jax.device_put(
+        jnp.asarray(rng.randn(E, capT, F), jnp.float32) * .1,
+        NamedSharding(mesh, P(None, None, "tp")))
+    wf = rng.randn(E, F, D).astype(np.float32) * .1
+    wq = quantize_int8(jnp.asarray(wf))
+    assert wq.s.shape == (E, D)
+    deq = np.asarray(wq.q, np.float32) * np.asarray(wq.s)[:, None, :]
+    ref = np.einsum("ecf,efd->ecd", np.asarray(h), deq)
+    for res in (False, True):
+        got = np.asarray(moe_reduce_ar(h, wq, mesh=mesh, resident_b=res))
+        np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"resident={res}")

@@ -201,8 +201,9 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     quant = isinstance(w, QuantW)
     w_s = None
     if quant:
-        E_, N_ = w.q.shape[0], w.q.shape[2]
-        if w.q.ndim != 3 or w.s.shape != (E_, N_):
+        if (w.q.ndim != 3
+                or w.s.shape != (w.q.shape[0],
+                                      w.q.shape[2])):
             raise ValueError(
                 f"ag_group_gemm QuantW wants q [E, D, N] with s [E, N] "
                 f"(per-expert per-column scales; quantize_int8 on the "

@@ -40,23 +40,38 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 
 def _moe_ar_kernel(n: int, axis: str, E: int, resident_b: bool,
-                   a_ref, b_ref, o_ref, land_ref, send_buf,
-                   a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
-                   a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem):
+                   quant: bool, *refs):
     """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
     o_ref: [E, capT, D]; land_ref: [n, E, capT, D]; send_buf like o.
 
     Same software pipeline as the dense _gemm_ar_kernel: double-buffered
     operand loads, staged sends one expert behind the compute, and a
     prefetching reduce over the flattened (expert, peer) space."""
+    if quant:
+        (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, l_vmem, p_vmem, s_vmem,
+         a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem,
+         s_sem) = refs
+    else:
+        (a_ref, b_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, l_vmem, p_vmem,
+         a_sem, b_sems, t_sems, l_sems, send_sem, recv_sem) = refs
     me = dl.my_pe(axis)
 
+    if quant:
+        # per-expert per-column dequant scales: start alongside the
+        # operand loads, wait only after they are in flight (the
+        # scales are first needed after the first dot)
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
     if resident_b:
         pltpu.make_async_copy(b_ref, b_vmem, b_sems.at[0]).start()
     else:
         pltpu.make_async_copy(b_ref.at[0], b_vmem.at[0],
                               b_sems.at[0]).start()
     pltpu.make_async_copy(a_ref.at[0], a_vmem.at[0], a_sem).start()
+    if quant:
+        cp_s.wait()
     dl.barrier_all(axis)
 
     def push(e):
@@ -82,9 +97,13 @@ def _moe_ar_kernel(n: int, axis: str, E: int, resident_b: bool,
                                       b_vmem.at[(e + 1) % 2],
                                       b_sems.at[(e + 1) % 2]).start()
             b_tile = b_vmem[e % 2]
-        t_vmem[e % 2] = jnp.dot(a_vmem[e % 2], b_tile,
-                                preferred_element_type=jnp.float32
-                                ).astype(t_vmem.dtype)
+        if quant:
+            b_tile = b_tile.astype(a_vmem.dtype)
+        acc = jnp.dot(a_vmem[e % 2], b_tile,
+                      preferred_element_type=jnp.float32)
+        if quant:
+            acc = acc * s_vmem[e]
+        t_vmem[e % 2] = acc.astype(t_vmem.dtype)
         pltpu.make_async_copy(t_vmem.at[e % 2], send_buf.at[e],
                               t_sems.at[e % 2]).start()
         if e >= 1:
@@ -138,7 +157,21 @@ def moe_reduce_ar(h, w2, *, mesh: Mesh, axis: str = "tp",
     """y = allreduce(sum over F of h @ w2) per expert, fused in one
     kernel (reference: moe_reduce_ar.py:323-645). h: [E, capT, F]
     F-sharded; w2: [E, F, D] F-row-sharded. Returns [E, capT, D]
-    replicated over `axis` — the MoE TP decode epilogue."""
+    replicated over `axis` — the MoE TP decode epilogue. w2 may be
+    QuantW (q [E, F, D] int8, s [E, D]): int8 panels stream, per-expert
+    per-column dequant after each dot."""
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(w2, QuantW)
+    w_s = None
+    if quant:
+        if (w2.q.ndim != 3
+                or w2.s.shape != (w2.q.shape[0],
+                                      w2.q.shape[2])):
+            raise ValueError(
+                f"moe_reduce_ar QuantW wants q [E, F, D] with s [E, D]; "
+                f"got q {w2.q.shape}, s {w2.s.shape}")
+        w_s = w2.s.astype(jnp.float32)[:, None, :]   # [E, 1, D]
+        w2 = w2.q
     n = mesh.shape[axis]
     E, capT, F = h.shape
     D = w2.shape[2]
@@ -158,13 +191,31 @@ def moe_reduce_ar(h, w2, *, mesh: Mesh, axis: str = "tp",
         resident_b = (E * f_l * D * wsz + 2 * capT * f_l * isz
                       + capT * D * (4 + 3 * isz)) <= (10 << 20)
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(None, None, axis), P(None, axis, None)),
-        out_specs=P(None, None, None), check_vma=False)
-    def _f(h_loc, w_loc):
+    def _call(h_loc, w_loc, s_loc=None):
         f_loc = h_loc.shape[2]
-        kernel = functools.partial(_moe_ar_kernel, n, axis, E, resident_b)
+        kernel = functools.partial(_moe_ar_kernel, n, axis, E, resident_b,
+                                   quant)
+        scratch = [
+            pltpu.VMEM((2, capT, f_loc), h_loc.dtype),
+            pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
+                       w_loc.dtype),
+            pltpu.VMEM((2, capT, D), h_loc.dtype),
+            pltpu.VMEM((2, capT, D), h_loc.dtype),
+            pltpu.VMEM((capT, D), jnp.float32),
+        ]
+        if quant:
+            scratch.append(pltpu.VMEM((E, 1, D), jnp.float32))
+        scratch += [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ]
+        if quant:
+            scratch.append(pltpu.SemaphoreType.DMA(()))
+        args = (h_loc, w_loc) + ((s_loc,) if quant else ())
         out, _, _ = pl.pallas_call(
             kernel,
             out_shape=(
@@ -172,28 +223,32 @@ def moe_reduce_ar(h, w2, *, mesh: Mesh, axis: str = "tp",
                 jax.ShapeDtypeStruct((n, E, capT, D), h_loc.dtype),
                 jax.ShapeDtypeStruct((E, capT, D), h_loc.dtype),
             ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec(memory_space=pl.ANY)],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
             out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                             for _ in range(3)),
-            scratch_shapes=[
-                pltpu.VMEM((2, capT, f_loc), h_loc.dtype),
-                pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
-                           w_loc.dtype),
-                pltpu.VMEM((2, capT, D), h_loc.dtype),
-                pltpu.VMEM((2, capT, D), h_loc.dtype),
-                pltpu.VMEM((capT, D), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-            ],
+            scratch_shapes=scratch,
             compiler_params=shmem_compiler_params(collective_id, n=n),
             interpret=interpret_mode(),
-        )(h_loc, w_loc)
+        )(*args)
         return out
+
+    if quant:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, None, axis), P(None, axis, None),
+                      P(None, None, None)),
+            out_specs=P(None, None, None), check_vma=False)
+        def _fq(h_loc, w_loc, s_loc):
+            return _call(h_loc, w_loc, s_loc)
+
+        return _fq(h, w2, w_s)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis, None)),
+        out_specs=P(None, None, None), check_vma=False)
+    def _f(h_loc, w_loc):
+        return _call(h_loc, w_loc)
 
     return _f(h, w2)
 
