@@ -108,3 +108,27 @@ def test_moe_reduce_ar_int8_weights():
         got = np.asarray(moe_reduce_ar(h, wq, mesh=mesh, resident_b=res))
         np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4,
                                    err_msg=f"resident={res}")
+
+
+def test_moe_reduce_rs_int8_weights():
+    """QuantW down-proj panels through the slab-ring RS: dequant in the
+    producer, so the ring folds already-dequantized partials — exact vs
+    the dequantized-weight oracle, both resident paths."""
+    import os
+    from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
+    from triton_dist_tpu.kernels.quant import quantize_int8
+    n = mesh.shape["tp"]
+    f_dev = 128 if os.environ.get("TDTPU_REAL_DEVICES") == "1" else 32
+    E, capT, F, D = 2, 8 * n, f_dev * n, 128
+    rng = np.random.RandomState(14)
+    h = jax.device_put(
+        jnp.asarray(rng.randn(E, capT, F), jnp.float32) * .1,
+        NamedSharding(mesh, P(None, None, "tp")))
+    wq = quantize_int8(jnp.asarray(
+        rng.randn(E, F, D).astype(np.float32) * .1))
+    deq = np.asarray(wq.q, np.float32) * np.asarray(wq.s)[:, None, :]
+    full = np.einsum("ecf,efd->ecd", np.asarray(h), deq)
+    for res in (False, True):
+        got = np.asarray(moe_reduce_rs(h, wq, mesh=mesh, resident_b=res))
+        np.testing.assert_allclose(got, full, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"resident={res}")

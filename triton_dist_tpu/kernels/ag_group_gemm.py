@@ -197,20 +197,8 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
     s [E, N] per-expert per-column scales — int8 panels stream, dequant
     after each dot), N sharded. Returns [E, capT, N] with N sharded
     over `axis`."""
-    from triton_dist_tpu.kernels.quant import QuantW
-    quant = isinstance(w, QuantW)
-    w_s = None
-    if quant:
-        if (w.q.ndim != 3
-                or w.s.shape != (w.q.shape[0],
-                                      w.q.shape[2])):
-            raise ValueError(
-                f"ag_group_gemm QuantW wants q [E, D, N] with s [E, N] "
-                f"(per-expert per-column scales; quantize_int8 on the "
-                f"[E, D, N] stack produces this); got q {w.q.shape}, "
-                f"s {w.s.shape}")
-        w_s = w.s.astype(jnp.float32)[:, None, :]   # [E, 1, N]
-        w = w.q
+    from triton_dist_tpu.kernels.quant import unpack_quant_3d
+    quant, w, w_s = unpack_quant_3d(w, "ag_group_gemm")
     n = mesh.shape[axis]
     E, capT, D = x_e.shape
     N = w.shape[2]

@@ -36,10 +36,7 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 
 def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
-                   a_ref, b_ref, o_ref, land_ref, send_buf,
-                   a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
-                   a_sem, b_sems, t_sems, d_sems, l_sems,
-                   send_sems, recv_sems, credit_sem):
+                   quant: bool, *refs):
     """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
     o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D].
 
@@ -51,6 +48,16 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
     slabs stage through two deferred-writeback slots (drained before
     the fold reads them), and the fold prefetches the next expert's
     operand pair while the VPU adds the current one."""
+    if quant:
+        (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, d_vmem, l_vmem, s_vmem,
+         a_sem, b_sems, t_sems, d_sems, l_sems,
+         send_sems, recv_sems, credit_sem, s_sem) = refs
+    else:
+        (a_ref, b_ref, o_ref, land_ref, send_buf,
+         a_vmem, b_vmem, t_vmem, d_vmem, l_vmem,
+         a_sem, b_sems, t_sems, d_sems, l_sems,
+         send_sems, recv_sems, credit_sem) = refs
     me = dl.my_pe(axis)   # concrete 0 at n==1: indices fold static
     _, c_loc, D = o_ref.shape
     left, right = dl.ring_neighbors(axis)
@@ -67,6 +74,14 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
         pltpu.make_async_copy(b_ref.at[0], b_vmem.at[0],
                               b_sems.at[0]).start()
     pltpu.make_async_copy(a_src(0, 0), a_vmem.at[0], a_sem).start()
+    if quant:
+        # per-expert per-column dequant scales: applied to each partial
+        # in the PRODUCER, so the ring folds already-dequantized slabs
+        # (exact — kernels/quant.py); wait after the operand loads are
+        # in flight
+        cp_s = pltpu.make_async_copy(s_ref, s_vmem, s_sem)
+        cp_s.start()
+        cp_s.wait()
     dl.barrier_all(axis)
 
     for s in range(n):
@@ -107,9 +122,13 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
                 # slot (per-step slots: drained below before the fold)
                 pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e - 2],
                                       t_sems.at[e % 2]).wait()
-            t_vmem[e % 2] = jnp.dot(a_vmem[et % 2], b_tile,
-                                    preferred_element_type=jnp.float32
-                                    ).astype(t_vmem.dtype)
+            if quant:
+                b_tile = b_tile.astype(a_vmem.dtype)
+            acc = jnp.dot(a_vmem[et % 2], b_tile,
+                          preferred_element_type=jnp.float32)
+            if quant:
+                acc = acc * s_vmem[e]
+            t_vmem[e % 2] = acc.astype(t_vmem.dtype)
             pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
                                   t_sems.at[e % 2]).start()
         # drain producer writebacks: the fold (or the RDMA) reads dest
@@ -168,10 +187,21 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
                   resident_b: Optional[bool] = None):
     """y = reduce_scatter(sum over F of h @ w2) per expert, fused
     (reference: moe_reduce_rs.py:168). h: [E, capT, F] F-sharded;
-    w2: [E, F, D] F-row-sharded. Returns [E, capT, D] capT-sharded."""
+    w2: [E, F, D] F-row-sharded (or QuantW: q [E, F, D] int8 with
+    s [E, D] — int8 panels stream, dequant in the producer).
+    Returns [E, capT, D] capT-sharded."""
+    from triton_dist_tpu.kernels.quant import unpack_quant_3d
+    quant, w2, w_s = unpack_quant_3d(w2, "moe_reduce_rs")
     n = mesh.shape[axis]
     E, capT, F = h.shape
     D = w2.shape[2]
+    from triton_dist_tpu.runtime import on_tpu
+    if on_tpu() and ((F // n) % 128 or D % 128):
+        # compiled Mosaic rejects expert-sliced DMAs whose minor dim is
+        # not lane-aligned (the interpreter does not enforce this)
+        raise ValueError(
+            f"moe_reduce_rs on TPU needs F/n ({F}/{n}) and D ({D}) to "
+            "be multiples of 128 (pad the intermediate dim)")
     assert capT % n == 0, (capT, n)
     c_loc = capT // n
     if collective_id is None:
@@ -183,13 +213,33 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
         resident_b = (E * f_l * D * wsz + c_loc * f_l * isz
                       + c_loc * D * (4 + isz)) <= (6 << 20)
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P(None, None, axis), P(None, axis, None)),
-        out_specs=P(None, axis, None), check_vma=False)
-    def _f(h_loc, w_loc):
+    def _call(h_loc, w_loc, s_loc=None):
         f_loc = h_loc.shape[2]
-        kernel = functools.partial(_moe_rs_kernel, n, axis, E, resident_b)
+        kernel = functools.partial(_moe_rs_kernel, n, axis, E, resident_b,
+                                   quant)
+        scratch = [
+            pltpu.VMEM((2, c_loc, f_loc), h_loc.dtype),
+            pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
+                       w_loc.dtype),
+            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+        ]
+        if quant:
+            scratch.append(pltpu.VMEM((E, 1, D), jnp.float32))
+        scratch += [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ]
+        if quant:
+            scratch.append(pltpu.SemaphoreType.DMA(()))
+        args = (h_loc, w_loc) + ((s_loc,) if quant else ())
         out, _, _ = pl.pallas_call(
             kernel,
             out_shape=(
@@ -197,30 +247,32 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
                 jax.ShapeDtypeStruct((2, E, c_loc, D), h_loc.dtype),
                 jax.ShapeDtypeStruct((2, E, c_loc, D), h_loc.dtype),
             ),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec(memory_space=pl.ANY)],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
             out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                             for _ in range(3)),
-            scratch_shapes=[
-                pltpu.VMEM((2, c_loc, f_loc), h_loc.dtype),
-                pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
-                           w_loc.dtype),
-                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
-                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
-                pltpu.VMEM((2, c_loc, D), h_loc.dtype),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.REGULAR,
-            ],
+            scratch_shapes=scratch,
             compiler_params=shmem_compiler_params(collective_id, n=n),
             interpret=interpret_mode(),
-        )(h_loc, w_loc)
+        )(*args)
         return out
+
+    if quant:
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, None, axis), P(None, axis, None),
+                      P(None, None, None)),
+            out_specs=P(None, axis, None), check_vma=False)
+        def _fq(h_loc, w_loc, s_loc):
+            return _call(h_loc, w_loc, s_loc)
+
+        return _fq(h, w2, w_s)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis, None)),
+        out_specs=P(None, axis, None), check_vma=False)
+    def _f(h_loc, w_loc):
+        return _call(h_loc, w_loc)
 
     return _f(h, w2)
 

@@ -69,3 +69,20 @@ def qmm(x, w, *, preferred_element_type=None):
     if preferred_element_type is None:
         return x @ w
     return jnp.dot(x, w, preferred_element_type=preferred_element_type)
+
+
+def unpack_quant_3d(w, opname: str):
+    """Shared QuantW handling for the stacked-expert kernels
+    (ag_group_gemm / moe_reduce_rs / moe_reduce_ar): validates the
+    q [E, K, N] / s [E, N] contract and returns
+    (quant, q, s_f32 [E, 1, N]) — (False, w, None) for plain arrays."""
+    if not isinstance(w, QuantW):
+        return False, w, None
+    if w.q.ndim != 3 or w.s.shape != (w.q.shape[0], w.q.shape[2]):
+        raise ValueError(
+            f"{opname} QuantW wants q [E, K, N] with s [E, N] "
+            f"(per-expert per-output-column scales; quantize_int8 on "
+            f"the stacked weight produces this); got q {w.q.shape}, "
+            f"s {w.s.shape}")
+    import jax.numpy as _jnp
+    return True, w.q, w.s.astype(_jnp.float32)[:, None, :]
