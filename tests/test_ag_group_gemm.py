@@ -86,3 +86,23 @@ def test_ag_group_gemm_int8_weights():
                                        resident_b=res, block_n=32))
         np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4,
                                    err_msg=f"resident={res}")
+
+
+@pytest.mark.parametrize("wb_depth", [2, 3, 4])
+def test_ag_group_gemm_wb_depths(wb_depth):
+    """Every deferred-writeback staging depth is exact: the budget
+    picker selects 4 at test shapes, so the 2/3 fallback branches
+    (taken only at large perf shapes on chip) need explicit coverage.
+    E=3 < depth=4 also exercises the G < wb_depth drain edge."""
+    n = mesh.shape["tp"]
+    E, capT, D, N = 3, 4 * n, 128, 128 * n
+    rng = np.random.RandomState(wb_depth)
+    x = jnp.asarray(rng.randn(E, capT, D), jnp.float32) * 0.3
+    w = jnp.asarray(rng.randn(E, D, N), jnp.float32) * 0.3
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "tp", None)))
+    ws = jax.device_put(w, NamedSharding(mesh, P(None, None, "tp")))
+    with jax.default_matmul_precision("highest"):
+        y = ag_group_gemm(xs, ws, mesh=mesh, wb_depth=wb_depth)
+        ref = ag_group_gemm_ref(x, w)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)

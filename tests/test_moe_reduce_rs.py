@@ -132,3 +132,23 @@ def test_moe_reduce_rs_int8_weights():
         got = np.asarray(moe_reduce_rs(h, wq, mesh=mesh, resident_b=res))
         np.testing.assert_allclose(got, full, atol=1e-4, rtol=1e-4,
                                    err_msg=f"resident={res}")
+
+
+@pytest.mark.parametrize("wb_depth", [2, 3, 4])
+def test_moe_reduce_rs_wb_depths(wb_depth):
+    """Producer/fold staging at every deferred-writeback depth (the
+    budget picker selects 4 at test shapes; 2/3 are the large-shape
+    fallbacks). E=3 < depth=4 exercises the E < wb_depth drain edge in
+    both the producer and the fold."""
+    n = mesh.shape["tp"]
+    E, capT, F, D = 3, 4 * n, 128 * n, 128
+    rng = np.random.RandomState(10 + wb_depth)
+    h = jnp.asarray(rng.randn(E, capT, F), jnp.float32) * 0.3
+    w2 = jnp.asarray(rng.randn(E, F, D), jnp.float32) * 0.3
+    hs = jax.device_put(h, NamedSharding(mesh, P(None, None, "tp")))
+    ws = jax.device_put(w2, NamedSharding(mesh, P(None, "tp", None)))
+    with jax.default_matmul_precision("highest"):
+        y = moe_reduce_rs(hs, ws, mesh=mesh, wb_depth=wb_depth)
+        ref = moe_reduce_rs_ref(h, w2)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)

@@ -40,11 +40,12 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 
 from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
+from triton_dist_tpu.utils import pick_wb_depth  # noqa: E402
 
 
 def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                           resident_b: bool, ablate: frozenset,
-                          quant: bool, *refs):
+                          quant: bool, wb_depth: int, *refs):
     """Ring AG of capacity chunks + per-expert GEMM consumption.
     x_ref: [E, c_loc, D]; w_ref: [E, D, n_loc]; ag_ref: [E, capT, D];
     o_ref: [E, capT, n_loc].
@@ -62,8 +63,18 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
 
     Software-pipelined over the flattened (step, expert, tile) space:
     expert chunks and (non-resident) B tiles double-buffer under the
-    dots, and output tiles stage through two slots waited two tiles
-    later — the MXU never idles on a same-iteration DMA."""
+    dots, and output tiles stage through `wb_depth` slots waited
+    wb_depth tiles later — the MXU never idles on a same-iteration DMA.
+
+    wb_depth: at this kernel's perf shape the in+out DMA demand sits
+    within ~10% of HBM peak, and with only two staging slots the slot
+    wait lands two dots behind the MXU — any transient issue-order
+    contention stalls the dot chain (kprof measured the writeback
+    phase's critical-path share at 19.2us of 76.7, PROFILE_ag_group_gemm
+    .json). Four slots (VMEM-budget permitting, picked by the host
+    wrapper) push the reuse wait four dots back so the writeback stream
+    rides entirely under compute — the same deferred-epilogue
+    discipline that put gemm_allreduce at 0.96 SOL."""
     if quant:
         (x_ref, w_ref, s_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
          s_vmem, a_sem, b_sems, o_sems, send_sem, recv_sems,
@@ -155,9 +166,10 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                     pltpu.make_async_copy(b_src(e, j), b_vmem.at[g % 2],
                                           b_sems.at[g % 2]).wait()
                     b_tile = b_vmem[g % 2]
-                if "writeback" not in ablate and g >= 2:
-                    pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g - 2),
-                                          o_sems.at[g % 2]).wait()
+                if "writeback" not in ablate and g >= wb_depth:
+                    pltpu.make_async_copy(o_vmem.at[g % wb_depth],
+                                          o_dst(g - wb_depth),
+                                          o_sems.at[g % wb_depth]).wait()
                 if "dots" not in ablate:
                     if quant:
                         b_tile = b_tile.astype(a_vmem.dtype)
@@ -165,10 +177,11 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                                   preferred_element_type=jnp.float32)
                     if quant:
                         acc = acc * s_vmem[e, :, pl.ds(j * bn, bn)]
-                    o_vmem[g % 2] = acc.astype(o_ref.dtype)
+                    o_vmem[g % wb_depth] = acc.astype(o_ref.dtype)
                 if "writeback" not in ablate:
-                    pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
-                                          o_sems.at[g % 2]).start()
+                    pltpu.make_async_copy(o_vmem.at[g % wb_depth],
+                                          o_dst(g),
+                                          o_sems.at[g % wb_depth]).start()
         if s < n - 1:
             nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
             pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
@@ -177,10 +190,10 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                 pltpu.make_async_copy(a_src(s + 1, 0),
                                       a_vmem.at[((s + 1) * E) % 2],
                                       a_sem).start()
-    for g in (range(max(G - 2, 0), G) if "writeback" not in ablate
+    for g in (range(max(G - wb_depth, 0), G) if "writeback" not in ablate
               else ()):
-        pltpu.make_async_copy(o_vmem.at[g % 2], o_dst(g),
-                              o_sems.at[g % 2]).wait()
+        pltpu.make_async_copy(o_vmem.at[g % wb_depth], o_dst(g),
+                              o_sems.at[g % wb_depth]).wait()
     dl.quiet(send_sem, x_ref, n - 1)
 
 
@@ -188,6 +201,7 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
                   block_n: Optional[int] = None,
                   collective_id: Optional[int] = None,
                   resident_b: Optional[bool] = None,
+                  wb_depth: Optional[int] = None,
                   ablate: frozenset = frozenset()):
     """y[e] = allgather(x_e[e]) @ w[e] for every expert, overlapped
     (reference: ag_group_gemm, allgather_group_gemm.py:253).
@@ -233,22 +247,31 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
         resident = resident_b
     if resident:
         bn = n_loc
+    # deferred-writeback depth: as many output staging slots as the VMEM
+    # budget allows (up to 4) so the slot-reuse wait lands wb_depth dots
+    # behind the MXU instead of two (see kernel docstring)
+    if wb_depth is None:
+        a_bytes = 2 * c_loc * D * isz
+        b_bytes = (E * D * n_loc if resident else 2 * D * bn) * wsz
+        s_bytes = E * n_loc * 4 if quant else 0   # f32 dequant scales
+        wb_depth = pick_wb_depth(a_bytes + b_bytes + s_bytes,
+                                 c_loc * bn * isz)
 
     def _call(x_loc, w_loc, s_loc=None):
         kernel = functools.partial(_ag_group_gemm_kernel, n, axis, E, bn,
-                                   resident, ablate, quant)
+                                   resident, ablate, quant, wb_depth)
         scratch = [
             pltpu.VMEM((2, c_loc, D), x_loc.dtype),
             pltpu.VMEM((E, D, n_loc) if resident else (2, D, bn),
                        w_loc.dtype),
-            pltpu.VMEM((2, c_loc, bn), x_loc.dtype),
+            pltpu.VMEM((wb_depth, c_loc, bn), x_loc.dtype),
         ]
         if quant:
             scratch.append(pltpu.VMEM((E, 1, n_loc), jnp.float32))
         scratch += [
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((wb_depth,)),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((n,)),
         ]

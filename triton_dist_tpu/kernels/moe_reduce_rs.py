@@ -36,7 +36,8 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 
 def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
-                   quant: bool, *refs):
+                   quant: bool, wb_depth: int, ablate: frozenset,
+                   *refs):
     """a_ref: [E, capT, F_loc]; b_ref: [E, F_loc, D];
     o_ref: [E, c_loc, D]; land/send bufs: [2, E, c_loc, D].
 
@@ -45,9 +46,17 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
 
     Software-pipelined like the dense gemm_rs: expert activation chunks
     and (non-resident) B panels double-buffer under the dots, producer
-    slabs stage through two deferred-writeback slots (drained before
-    the fold reads them), and the fold prefetches the next expert's
-    operand pair while the VPU adds the current one."""
+    slabs stage through `wb_depth` deferred-writeback slots (drained
+    before the fold reads them), and the fold prefetches the next
+    expert's operand pair while the VPU adds the current one.
+
+    wb_depth: same deferred-epilogue depth argument as ag_group_gemm —
+    at the perf shape the producer's in+out DMA demand is within ~10%
+    of HBM peak and a 2-slot stage waits only two dots behind the MXU;
+    4 slots (budget permitting) keep the dot chain free of writeback
+    stalls. At n == 1 the fold/ring blocks below are statically dead
+    (the s-loop is Python-unrolled), so the host wrapper passes dummy
+    fold buffers and spends the reclaimed VMEM on staging depth."""
     if quant:
         (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
          a_vmem, b_vmem, t_vmem, d_vmem, l_vmem, s_vmem,
@@ -68,7 +77,13 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
     def a_src(s, e):
         return a_ref.at[e, pl.ds(chunk_of(s) * c_loc, c_loc), :]
 
-    if resident_b:
+    # ablate: kprof compiled-phase ablation switches (tools/kprof.py —
+    # remove one phase, keep the semaphore discipline balanced, time
+    # the difference). Phases: a_stream / b_stream / dots / writeback /
+    # fold. Ring protocol ops (RDMA, credits, quiet) always run.
+    if "b_stream" in ablate:
+        pass
+    elif resident_b:
         pltpu.make_async_copy(b_ref, b_vmem, b_sems.at[0]).start()
     else:
         pltpu.make_async_copy(b_ref.at[0], b_vmem.at[0],
@@ -94,17 +109,21 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
         # step s-1 is in flight under them
         for e in range(E):
             et = s * E + e
-            pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
-                                  a_sem).wait()
-            if e + 1 < E:
-                pltpu.make_async_copy(a_src(s, e + 1),
-                                      a_vmem.at[(et + 1) % 2],
-                                      a_sem).start()
-            elif not last:
-                pltpu.make_async_copy(a_src(s + 1, 0),
-                                      a_vmem.at[(et + 1) % 2],
-                                      a_sem).start()
-            if resident_b:
+            if "a_stream" not in ablate or et == 0:
+                pltpu.make_async_copy(a_src(s, e), a_vmem.at[et % 2],
+                                      a_sem).wait()
+            if "a_stream" not in ablate:
+                if e + 1 < E:
+                    pltpu.make_async_copy(a_src(s, e + 1),
+                                          a_vmem.at[(et + 1) % 2],
+                                          a_sem).start()
+                elif not last:
+                    pltpu.make_async_copy(a_src(s + 1, 0),
+                                          a_vmem.at[(et + 1) % 2],
+                                          a_sem).start()
+            if "b_stream" in ablate:
+                b_tile = b_vmem[0 if not resident_b else e]
+            elif resident_b:
                 if et == 0:
                     pltpu.make_async_copy(b_ref, b_vmem,
                                           b_sems.at[0]).wait()
@@ -117,34 +136,43 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
                                           b_vmem.at[(et + 1) % 2],
                                           b_sems.at[(et + 1) % 2]).start()
                 b_tile = b_vmem[et % 2]
-            if e >= 2:
-                # the slab writeback issued two experts ago reuses this
-                # slot (per-step slots: drained below before the fold)
-                pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e - 2],
-                                      t_sems.at[e % 2]).wait()
-            if quant:
-                b_tile = b_tile.astype(a_vmem.dtype)
-            acc = jnp.dot(a_vmem[et % 2], b_tile,
-                          preferred_element_type=jnp.float32)
-            if quant:
-                acc = acc * s_vmem[e]
-            t_vmem[e % 2] = acc.astype(t_vmem.dtype)
-            pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
-                                  t_sems.at[e % 2]).start()
+            if "writeback" not in ablate and e >= wb_depth:
+                # the slab writeback issued wb_depth experts ago reuses
+                # this slot (per-step slots: drained below before the
+                # fold)
+                pltpu.make_async_copy(t_vmem.at[e % wb_depth],
+                                      dest.at[e - wb_depth],
+                                      t_sems.at[e % wb_depth]).wait()
+            if "dots" not in ablate:
+                if quant:
+                    b_tile = b_tile.astype(a_vmem.dtype)
+                acc = jnp.dot(a_vmem[et % 2], b_tile,
+                              preferred_element_type=jnp.float32)
+                if quant:
+                    acc = acc * s_vmem[e]
+                t_vmem[e % wb_depth] = acc.astype(t_vmem.dtype)
+            if "writeback" not in ablate:
+                pltpu.make_async_copy(t_vmem.at[e % wb_depth], dest.at[e],
+                                      t_sems.at[e % wb_depth]).start()
         # drain producer writebacks: the fold (or the RDMA) reads dest
-        for e in range(max(E - 2, 0), E):
-            pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
-                                  t_sems.at[e % 2]).wait()
+        for e in (range(max(E - wb_depth, 0), E)
+                  if "writeback" not in ablate else ()):
+            pltpu.make_async_copy(t_vmem.at[e % wb_depth], dest.at[e],
+                                  t_sems.at[e % wb_depth]).wait()
         if s >= 1:
-            # consumer: fold the accumulated slab from the left
+            # consumer: fold the accumulated slab from the left. The
+            # recv wait and the credit signal are PROTOCOL (always run);
+            # the data movement + VPU add between them are the "fold"
+            # ablation phase.
             pltpu.make_async_copy(o_ref, o_ref,
                                   recv_sems.at[(s - 1) % 2]).wait()
             prev = (s - 1) % 2
-            pltpu.make_async_copy(dest.at[0], d_vmem.at[0],
-                                  d_sems.at[0]).start()
-            pltpu.make_async_copy(land_ref.at[prev, 0], l_vmem.at[0],
-                                  l_sems.at[0]).start()
-            for e in range(E):
+            if "fold" not in ablate:
+                pltpu.make_async_copy(dest.at[0], d_vmem.at[0],
+                                      d_sems.at[0]).start()
+                pltpu.make_async_copy(land_ref.at[prev, 0], l_vmem.at[0],
+                                      l_sems.at[0]).start()
+            for e in (range(E) if "fold" not in ablate else ()):
                 fs = e % 2
                 if e + 1 < E:
                     pltpu.make_async_copy(dest.at[e + 1],
@@ -157,17 +185,19 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
                                       d_sems.at[fs]).wait()
                 pltpu.make_async_copy(land_ref.at[prev, e], l_vmem.at[fs],
                                       l_sems.at[fs]).wait()
-                if e >= 2:
-                    pltpu.make_async_copy(t_vmem.at[fs], dest.at[e - 2],
-                                          t_sems.at[fs]).wait()
-                t_vmem[fs] = (d_vmem[fs].astype(jnp.float32)
-                              + l_vmem[fs].astype(jnp.float32)
-                              ).astype(t_vmem.dtype)
-                pltpu.make_async_copy(t_vmem.at[fs], dest.at[e],
-                                      t_sems.at[fs]).start()
-            for e in range(max(E - 2, 0), E):
-                pltpu.make_async_copy(t_vmem.at[e % 2], dest.at[e],
-                                      t_sems.at[e % 2]).wait()
+                if e >= wb_depth:
+                    pltpu.make_async_copy(t_vmem.at[e % wb_depth],
+                                          dest.at[e - wb_depth],
+                                          t_sems.at[e % wb_depth]).wait()
+                t_vmem[e % wb_depth] = (
+                    d_vmem[fs].astype(jnp.float32)
+                    + l_vmem[fs].astype(jnp.float32)).astype(t_vmem.dtype)
+                pltpu.make_async_copy(t_vmem.at[e % wb_depth], dest.at[e],
+                                      t_sems.at[e % wb_depth]).start()
+            for e in (range(max(E - wb_depth, 0), E)
+                      if "fold" not in ablate else ()):
+                pltpu.make_async_copy(t_vmem.at[e % wb_depth], dest.at[e],
+                                      t_sems.at[e % wb_depth]).wait()
             dl.signal_op(credit_sem, 1, left, axis)
         if not last:
             if s >= 2:
@@ -184,7 +214,9 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
 
 def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
                   collective_id: Optional[int] = None,
-                  resident_b: Optional[bool] = None):
+                  resident_b: Optional[bool] = None,
+                  wb_depth: Optional[int] = None,
+                  ablate: frozenset = frozenset()):
     """y = reduce_scatter(sum over F of h @ w2) per expert, fused
     (reference: moe_reduce_rs.py:168). h: [E, capT, F] F-sharded;
     w2: [E, F, D] F-row-sharded (or QuantW: q [E, F, D] int8 with
@@ -212,25 +244,38 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
     if resident_b is None:   # hold B across ring steps when it fits
         resident_b = (E * f_l * D * wsz + c_loc * f_l * isz
                       + c_loc * D * (4 + isz)) <= (6 << 20)
+    # deferred-writeback depth (see kernel docstring). At n == 1 the
+    # fold never traces, so its d/l prefetch buffers shrink to dummies
+    # and the reclaimed VMEM funds staging depth.
+    fold_live = n > 1
+    if wb_depth is None:
+        from triton_dist_tpu.utils import pick_wb_depth
+        a_bytes = 2 * c_loc * f_l * isz
+        b_bytes = (E * f_l * D if resident_b else 2 * f_l * D) * wsz
+        fold_bytes = (4 * c_loc * D * isz) if fold_live else 0
+        s_bytes = E * D * 4 if quant else 0       # f32 dequant scales
+        wb_depth = pick_wb_depth(a_bytes + b_bytes + fold_bytes + s_bytes,
+                                 c_loc * D * isz)
 
     def _call(h_loc, w_loc, s_loc=None):
         f_loc = h_loc.shape[2]
         kernel = functools.partial(_moe_rs_kernel, n, axis, E, resident_b,
-                                   quant)
+                                   quant, wb_depth, ablate)
+        fold_shape = (2, c_loc, D) if fold_live else (2, 8, 128)
         scratch = [
             pltpu.VMEM((2, c_loc, f_loc), h_loc.dtype),
             pltpu.VMEM((E, f_loc, D) if resident_b else (2, f_loc, D),
                        w_loc.dtype),
-            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
-            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
-            pltpu.VMEM((2, c_loc, D), h_loc.dtype),
+            pltpu.VMEM((wb_depth, c_loc, D), h_loc.dtype),
+            pltpu.VMEM(fold_shape, h_loc.dtype),
+            pltpu.VMEM(fold_shape, h_loc.dtype),
         ]
         if quant:
             scratch.append(pltpu.VMEM((E, 1, D), jnp.float32))
         scratch += [
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((wb_depth,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),

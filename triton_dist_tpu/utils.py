@@ -89,6 +89,19 @@ def env_flag(name: str, default: bool = False) -> bool:
     return v.lower() not in ("0", "false", "off", "")
 
 
+def pick_wb_depth(fixed_bytes: int, slot_bytes: int,
+                  budget: int = 12 << 20) -> int:
+    """Deferred-writeback staging depth for the fused comm-GEMM
+    epilogues: as many output slots as the VMEM budget allows (4 -> 3,
+    floor 2), so the slot-reuse wait lands `depth` dots behind the MXU
+    instead of two. Shared by ag_group_gemm / moe_reduce_rs (the two
+    kernels whose writeback phase kprof put on the critical path)."""
+    for cand in (4, 3):
+        if fixed_bytes + cand * slot_bytes <= budget:
+            return cand
+    return 2
+
+
 def divisor_block(n_total: int, block: int) -> int:
     """Largest lane-aligned (128-multiple) tile <= block dividing
     n_total; totals under one lane row pass through whole. Shared by
