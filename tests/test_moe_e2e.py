@@ -146,3 +146,41 @@ def test_ep_fused_tiling_picker():
     import pytest
     with pytest.raises(ValueError):
         _pick_block_i(cap_e=8192, D=4096, I=1536, isz=2)
+
+
+@pytest.mark.parametrize("block_i", [None, 128])
+def test_ep_moe_fused_int8_weights(ctx8, block_i):
+    """QuantW expert panels through the fused one-kernel EP path
+    (VERDICT r4 missing #3): int8 gate/up/down panels stream (resident
+    AND I-tiled), per-expert per-column dequant lands on h before the
+    activation and on the down-proj accumulator — exact vs the
+    dequantized-weight oracle."""
+    from triton_dist_tpu.kernels.quant import quantize_int8
+    from triton_dist_tpu.layers.ep_moe import EP_MoE
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, D, I = 2 * n, 128, 256
+    T = 8 * n
+    rng = np.random.RandomState(40 + (block_i or 0))
+    router = rng.randn(D, E).astype(np.float32) * 0.5
+    wg = rng.randn(E, D, I).astype(np.float32) * (D ** -0.5)
+    wu = rng.randn(E, D, I).astype(np.float32) * (D ** -0.5)
+    wd = rng.randn(E, I, D).astype(np.float32) * (I ** -0.5)
+    moe = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp", top_k=2,
+                      capacity_factor=float(E))
+    mq = moe.quantize_int8_experts()
+    # oracle: the SAME dequantized weights through the bf16 fused path
+    # (isolates the kernel's int8 data path from the rounding itself)
+    wgu_dq = np.asarray(mq.w_gate_up.q).astype(np.float32) \
+        * np.asarray(mq.w_gate_up.s)[:, None, :]
+    wd_dq = np.asarray(mq.w_down.q).astype(np.float32) \
+        * np.asarray(mq.w_down.s)[:, None, :]
+    m_dq = EP_MoE.init(router, wgu_dq[..., :I], wgu_dq[..., I:], wd_dq,
+                       mesh=mesh, axis="tp", top_k=2,
+                       capacity_factor=float(E))
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        ref = m_dq(x, mode="ep_fused", fused_block_i=block_i)
+        out = mq(x, mode="ep_fused", fused_block_i=block_i)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)

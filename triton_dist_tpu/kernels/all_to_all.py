@@ -99,22 +99,18 @@ def low_latency_all_to_all(x, *, mesh: Mesh, axis: str = "ep",
         in_specs=P(axis, None, None, None),
         out_specs=P(axis, None, None, None), check_vma=False)
     def _f(x_loc):
-        flat = x_loc.reshape(n2 * C, D)
-        amax = jnp.max(jnp.abs(flat), axis=-1, keepdims=True)
-        scale = jnp.maximum(amax, 1e-12) / 127.0
-        q8 = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
         # ONE exchange: the f32 scale rides as 4 int8 lanes appended to
         # its row's payload (the reference LL protocol packs the fp8
-        # scale into the same message for the same reason)
-        sc8 = jax.lax.bitcast_convert_type(
-            scale.astype(jnp.float32), jnp.int8).reshape(n2 * C, 4)
-        packed = jnp.concatenate([q8, sc8], axis=1)
+        # scale into the same message for the same reason) — the shared
+        # wire format of kernels/ep_a2a.py, also used by the EP layers'
+        # payload_int8 mode
+        from triton_dist_tpu.kernels.ep_a2a import (pack_rows_int8,
+                                                    unpack_rows_int8)
+        packed = pack_rows_int8(x_loc.reshape(n2 * C, D))
         y = _a2a_pallas(packed, n=n, axis=axis,
                         collective_id=collective_id)
-        ys = jax.lax.bitcast_convert_type(
-            y[:, D:D + 4].reshape(n2 * C, 1, 4), jnp.float32)
-        out = y[:, :D].astype(jnp.float32) * ys.reshape(n2 * C, 1)
-        return out.reshape(x_loc.shape).astype(x_loc.dtype)
+        out = unpack_rows_int8(y, D, x_loc.dtype)
+        return out.reshape(x_loc.shape)
 
     return _f(x)
 

@@ -223,6 +223,36 @@ def plan_dispatch_host(topk_idx, n: int, experts_per_rank: int, cap: int
                         token=_jnp.asarray(token))
 
 
+def pack_rows_int8(x):
+    """[R, D] -> [R, D+4] int8: per-row symmetric int8 quantization with
+    the f32 scale packed as 4 trailing int8 lanes, so ONE message
+    carries payload and scale (reference: the fp8 online pack inside
+    the LL dispatch kernel, low_latency_all_to_all_v2.py:55, and this
+    repo's low_latency_all_to_all). Zero rows — capacity padding and
+    dropped slots — quantize to zero rows, so they stay inert through
+    the wire. Used by EP_MoE(payload_int8=True): the token payload of
+    dispatch AND combine travels at half the bf16 bytes; on the DCN
+    tier of fwd_ep_2d (where bytes hurt most) the packed rows cross
+    BOTH hops without an intermediate dequant, so the only numeric loss
+    is one int8 rounding per direction."""
+    R, D = x.shape
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q8 = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    sc8 = jax.lax.bitcast_convert_type(scale, jnp.int8).reshape(R, 4)
+    return jnp.concatenate([q8, sc8], axis=1)
+
+
+def unpack_rows_int8(p, D: int, dtype):
+    """Inverse of pack_rows_int8 ([R, >=D+4] int8 -> [R, D] dtype);
+    trailing columns beyond D+4 (lane padding) are ignored."""
+    R = p.shape[0]
+    scale = jax.lax.bitcast_convert_type(
+        p[:, D:D + 4].reshape(R, 1, 4), jnp.float32).reshape(R, 1)
+    return (p[:, :D].astype(jnp.float32) * scale).astype(dtype)
+
+
 def fill_send_buffers(x, topk_idx, plan: DispatchPlan, n: int,
                       experts_per_rank: int, cap: int):
     """Scatter tokens (+ metadata) into the [n*cap] send layout.
@@ -369,6 +399,24 @@ def dispatch_a2a(send_x, send_meta, *, n: int, axis: str,
         compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(send_x, send_meta)
+
+
+def dispatch_a2a_int8(send_p, send_meta, *, n: int, axis: str,
+                      collective_id: int):
+    """dispatch_a2a for pack_rows_int8 payloads: pads the packed lane
+    dim to a 128-multiple (Mosaic sliced-DMA alignment) before the
+    payload+meta exchange and strips it after. Row capacities must be
+    32-multiples on real chips (int8 sublane tiling) — EP_MoE._caps
+    rounds them when payload_int8 is on."""
+    if n == 1:
+        return send_p, send_meta
+    R, Dp = send_p.shape
+    pad = (-Dp) % 128
+    if pad:
+        send_p = jnp.pad(send_p, ((0, 0), (0, pad)))
+    recv_p, recv_m = dispatch_a2a(send_p, send_meta, n=n, axis=axis,
+                                  collective_id=collective_id)
+    return recv_p[:, :Dp], recv_m
 
 
 def combine_a2a(y_slots, *, n: int, axis: str, collective_id: int):

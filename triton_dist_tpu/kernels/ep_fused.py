@@ -68,18 +68,36 @@ from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
 
 def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
                      resident_w: bool, block_i: Optional[int],
-                     wbuf: int,
-                     x_ref, wgu_ref, wd_ref,
-                     recv_ref, yback_ref, ystage_ref,
-                     a_vmem, wgu_vmem, wd_vmem, y_vmem,
-                     copy_sem, a_sem, w_sems, y_sems,
-                     send_sem, recv_sems, ydone_sems):
+                     wbuf: int, quant: bool, ablate: frozenset,
+                     straggler, *refs):
     """x_ref: [n, E, cap_e, D] send slots (slab p = peer p's block);
     wgu_ref: [E, D, 2I]; wd_ref: [E, I, D];
     recv_ref: [E, n*cap_e, D] (peer p's rows at [p*cap_e, (p+1)*cap_e));
     yback_ref: [n, E, cap_e, D] (slab p = results of MY tokens sent to
     peer p); ystage_ref: [n, E, cap_e, D] staging for outgoing combines.
+
+    quant: the expert panels stream as int8 (QuantW) with per-expert
+    per-output-column f32 scales (sgu_ref [E, 1, 2I] applied to h
+    BEFORE the activation, sd_ref [E, 1, D] applied to the down-proj
+    accumulator) — exact per-column dequant after each dot, halving the
+    weight-stream bytes its own docstring measured as the bound
+    (reference: fp8 weights through the fused grouped GEMM,
+    ep_all2all_fused.py:599).
     """
+    if straggler is not None:
+        spin_vmem, refs = refs[-1], refs[:-1]
+    if quant:
+        (x_ref, wgu_ref, wd_ref, sgu_ref, sd_ref,
+         recv_ref, yback_ref, ystage_ref,
+         a_vmem, wgu_vmem, wd_vmem, y_vmem, sgu_vmem, sd_vmem,
+         copy_sem, a_sem, w_sems, y_sems,
+         send_sem, recv_sems, ydone_sems, s_sem) = refs
+    else:
+        (x_ref, wgu_ref, wd_ref,
+         recv_ref, yback_ref, ystage_ref,
+         a_vmem, wgu_vmem, wd_vmem, y_vmem,
+         copy_sem, a_sem, w_sems, y_sems,
+         send_sem, recv_sems, ydone_sems) = refs
     me = dl.my_pe(axis)
     D = x_ref.shape[-1]
     I = wd_ref.shape[1]
@@ -133,7 +151,12 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
         send_slab(me), recv_ref.at[:, pl.ds(me * cap_e, cap_e), :],
         copy_sem)
     cp.start()
-    if resident_w:
+    # kprof ablation phases: w_stream / a_stream / dots / stage
+    # (tools/kprof.py). Dispatch puts, combine puts and arrival waits
+    # are PROTOCOL and always run.
+    if "w_stream" in ablate:
+        pass
+    elif resident_w:
         pltpu.make_async_copy(wgu_ref, wgu_vmem, w_sems.at[0]).start()
         pltpu.make_async_copy(wd_ref, wd_vmem, w_sems.at[1]).start()
     elif bi is not None:
@@ -144,10 +167,29 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
                               w_sems.at[0]).start()
         pltpu.make_async_copy(wd_ref.at[0], wd_vmem.at[0],
                               w_sems.at[1]).start()
+    if quant:
+        # per-expert dequant scales: tiny, loaded once — started AFTER
+        # the weight-panel prefetches are in flight, waited together
+        pltpu.make_async_copy(sgu_ref, sgu_vmem, s_sem).start()
+        pltpu.make_async_copy(sd_ref, sd_vmem, s_sem).start()
+        pltpu.make_async_copy(sgu_ref, sgu_vmem, s_sem).wait()
+        pltpu.make_async_copy(sd_ref, sd_vmem, s_sem).wait()
     cp.wait()
 
     for step in range(n):
         q = jax.lax.rem(me + jnp.int32(step), jnp.int32(n))
+        if straggler is not None and step == straggler[1]:
+            # fault injection INSIDE the fused op (reference:
+            # straggler_option, allgather_gemm.py:660-661): the rank
+            # stalls before this step's expert GEMMs, delaying its
+            # COMBINE-EPILOGUE put to peer q — q's final ydone wait
+            # must genuinely block on the per-peer semaphore
+            @pl.when(me == jnp.int32(straggler[0]))
+            def _stall():
+                spin_vmem[...] = jax.lax.fori_loop(
+                    0, straggler[2],
+                    lambda i, a: a * 1.0000001 + 1e-9,
+                    jnp.ones((8, 128), jnp.float32))
         if step > 0:
             # per-slab arrival signal (the consumer-side dl.wait of the
             # reference's dispatch/consume handshake)
@@ -164,57 +206,87 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
             # nothing and its VMEM doubles the reachable cap_e.
             for e in range(E):
                 g = step * E + e
-                cpa = pltpu.make_async_copy(
-                    recv_ref.at[e, pl.ds(q * cap_e, cap_e), :],
-                    a_vmem.at[0], a_sem)
-                cpa.start()
-                cpa.wait()
+                if "a_stream" not in ablate or (step == 0 and e == 0):
+                    cpa = pltpu.make_async_copy(
+                        recv_ref.at[e, pl.ds(q * cap_e, cap_e), :],
+                        a_vmem.at[0], a_sem)
+                    cpa.start()
+                    cpa.wait()
                 a = a_vmem[0]
                 acc = None
                 for it in range(nt):
                     gt = g * nt + it
-                    wait_w_tile(gt)
-                    if wbuf > 1 and gt + 1 < n * E * nt:
-                        start_w_tile(gt + 1)
-                    h = jnp.dot(a, wgu_vmem[gt % wbuf],
-                                preferred_element_type=jnp.float32)
-                    gate, up = h[:, :bi], h[:, bi:]
-                    act = (gate * jax.lax.logistic(gate) * up
-                           ).astype(a.dtype)
-                    part = jnp.dot(act, wd_vmem[gt % wbuf],
-                                   preferred_element_type=jnp.float32)
-                    acc = part if acc is None else acc + part
-                    if wbuf == 1 and gt + 1 < n * E * nt:
+                    if "w_stream" not in ablate:
+                        wait_w_tile(gt)
+                        if wbuf > 1 and gt + 1 < n * E * nt:
+                            start_w_tile(gt + 1)
+                    if "dots" not in ablate:
+                        wgu_t = wgu_vmem[gt % wbuf]
+                        if quant:
+                            wgu_t = wgu_t.astype(a.dtype)
+                        h = jnp.dot(a, wgu_t,
+                                    preferred_element_type=jnp.float32)
+                        if quant:
+                            # gate/up column tiles sit side by side in
+                            # the slot; their scale slices do too
+                            h = h * jnp.concatenate(
+                                [sgu_vmem[e, :, pl.ds(it * bi, bi)],
+                                 sgu_vmem[e, :, pl.ds(I + it * bi, bi)]],
+                                axis=-1)
+                        gate, up = h[:, :bi], h[:, bi:]
+                        act = (gate * jax.lax.logistic(gate) * up
+                               ).astype(a.dtype)
+                        wd_t = wd_vmem[gt % wbuf]
+                        if quant:
+                            wd_t = wd_t.astype(a.dtype)
+                        part = jnp.dot(act, wd_t,
+                                       preferred_element_type=jnp.float32)
+                        acc = part if acc is None else acc + part
+                    if ("w_stream" not in ablate and wbuf == 1
+                            and gt + 1 < n * E * nt):
                         # single-buffered: the reload starts only after
                         # this tile's dots read the slot (program order
                         # preserves the WAR dependency)
                         start_w_tile(gt + 1)
-                if e > 0:   # e-1's writeback frees the single slot
+                if quant and "dots" not in ablate:
+                    # down-proj scales are constant across I-tiles:
+                    # applied once to the accumulator (exact)
+                    acc = acc * sd_vmem[e]
+                if "stage" not in ablate:
+                    if e > 0:   # e-1's writeback frees the single slot
+                        pltpu.make_async_copy(y_vmem.at[0],
+                                              ystage_ref.at[q, e - 1],
+                                              y_sems.at[0]).wait()
+                    if "dots" not in ablate:
+                        y_vmem[0] = acc.astype(y_vmem.dtype)
                     pltpu.make_async_copy(y_vmem.at[0],
-                                          ystage_ref.at[q, e - 1],
-                                          y_sems.at[0]).wait()
-                y_vmem[0] = acc.astype(y_vmem.dtype)
-                pltpu.make_async_copy(y_vmem.at[0], ystage_ref.at[q, e],
-                                      y_sems.at[0]).start()
-            pltpu.make_async_copy(y_vmem.at[0], ystage_ref.at[q, E - 1],
-                                  y_sems.at[0]).wait()
+                                          ystage_ref.at[q, e],
+                                          y_sems.at[0]).start()
+            if "stage" not in ablate:
+                pltpu.make_async_copy(y_vmem.at[0],
+                                      ystage_ref.at[q, E - 1],
+                                      y_sems.at[0]).wait()
         else:
-            pltpu.make_async_copy(
-                recv_ref.at[0, pl.ds(q * cap_e, cap_e), :], a_vmem.at[0],
-                a_sem).start()
+            if "a_stream" not in ablate or step == 0:
+                pltpu.make_async_copy(
+                    recv_ref.at[0, pl.ds(q * cap_e, cap_e), :],
+                    a_vmem.at[0], a_sem).start()
         for e in (range(E) if bi is None else ()):
             es = e % 2            # A/Y slots: per-step expert parity
             g = step * E + e      # weight slots: GLOBAL parity (the
                                   # prefetch chain wraps across steps)
-            pltpu.make_async_copy(
-                recv_ref.at[e, pl.ds(q * cap_e, cap_e), :],
-                a_vmem.at[es], a_sem).wait()
-            if e + 1 < E:
+            if "a_stream" not in ablate or (step == 0 and e == 0):
+                pltpu.make_async_copy(
+                    recv_ref.at[e, pl.ds(q * cap_e, cap_e), :],
+                    a_vmem.at[es], a_sem).wait()
+            if "a_stream" not in ablate and e + 1 < E:
                 pltpu.make_async_copy(
                     recv_ref.at[e + 1, pl.ds(q * cap_e, cap_e), :],
                     a_vmem.at[(e + 1) % 2], a_sem).start()
             a = a_vmem[es]
-            if resident_w:
+            if "w_stream" in ablate:
+                wgu_e, wd_e = wgu_vmem[0], wd_vmem[0]
+            elif resident_w:
                 if step == 0 and e == 0:
                     pltpu.make_async_copy(wgu_ref, wgu_vmem,
                                           w_sems.at[0]).wait()
@@ -240,22 +312,35 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
                                           wd_vmem.at[(g + 1) % 2],
                                           w_sems.at[1]).start()
                 wgu_e, wd_e = wgu_vmem[ws], wd_vmem[ws]
-            h = jnp.dot(a, wgu_e,
-                        preferred_element_type=jnp.float32)  # [cap_e, 2I]
-            gate, up = h[:, :I], h[:, I:]
-            act = (gate * jax.lax.logistic(gate) * up).astype(a.dtype)
-            y = jnp.dot(act, wd_e,
-                        preferred_element_type=jnp.float32)
-            if e >= 2:
-                # the staging writeback issued two experts ago reuses
-                # this slot (drained below before the combine put)
-                pltpu.make_async_copy(y_vmem.at[es],
-                                      ystage_ref.at[q, e - 2],
-                                      y_sems.at[es]).wait()
-            y_vmem[es] = y.astype(y_vmem.dtype)
-            pltpu.make_async_copy(y_vmem.at[es], ystage_ref.at[q, e],
-                                  y_sems.at[es]).start()
-        for e in (range(max(E - 2, 0), E) if bi is None else ()):
+            if "dots" not in ablate:
+                if quant:
+                    wgu_e = wgu_e.astype(a.dtype)
+                    wd_e = wd_e.astype(a.dtype)
+                h = jnp.dot(a, wgu_e,
+                            preferred_element_type=jnp.float32)
+                if quant:
+                    h = h * sgu_vmem[e]
+                gate, up = h[:, :I], h[:, I:]
+                act = (gate * jax.lax.logistic(gate) * up
+                       ).astype(a.dtype)
+                y = jnp.dot(act, wd_e,
+                            preferred_element_type=jnp.float32)
+                if quant:
+                    y = y * sd_vmem[e]
+            if "stage" not in ablate:
+                if e >= 2:
+                    # the staging writeback issued two experts ago
+                    # reuses this slot (drained below before the
+                    # combine put)
+                    pltpu.make_async_copy(y_vmem.at[es],
+                                          ystage_ref.at[q, e - 2],
+                                          y_sems.at[es]).wait()
+                if "dots" not in ablate:
+                    y_vmem[es] = y.astype(y_vmem.dtype)
+                pltpu.make_async_copy(y_vmem.at[es], ystage_ref.at[q, e],
+                                      y_sems.at[es]).start()
+        for e in (range(max(E - 2, 0), E)
+                  if bi is None and "stage" not in ablate else ()):
             pltpu.make_async_copy(y_vmem.at[e % 2], ystage_ref.at[q, e],
                                   y_sems.at[e % 2]).wait()
         # combine put FROM the epilogue: peer q's results leave now,
@@ -282,7 +367,8 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
 
 
 def _pick_block_i(cap_e: int, D: int, I: int, isz: int,
-                  need: bool = True):
+                  need: bool = True, wsz: Optional[int] = None,
+                  fixed_extra: int = 0):
     """Pick (I-tile width, weight buffer depth) for the tiled path:
     the largest 128-multiple tile dividing I whose gate/up/down tiles
     fit the VMEM budget next to the single-slot token tiles — double
@@ -292,12 +378,14 @@ def _pick_block_i(cap_e: int, D: int, I: int, isz: int,
     is not needed; raises when even a single 128-tile cannot fit."""
     if not need:
         return None, 0
+    wsz = isz if wsz is None else wsz     # int8 panels halve the tiles
     tile_fixed = (2 * cap_e * D * isz      # single-slot a + y stage
-                  + cap_e * D * 4)         # f32 down-proj accumulator
+                  + cap_e * D * 4          # f32 down-proj accumulator
+                  + fixed_extra)           # quant scale buffers etc.
     budget = (12 << 20) - tile_fixed
     for wbuf in (2, 1):
         for cand in (1024, 512, 256, 128):
-            if I % cand == 0 and (wbuf * 3 * D * cand * isz
+            if I % cand == 0 and (wbuf * 3 * D * cand * wsz
                                   + 2 * cap_e * 2 * cand * 4) <= budget:
                 return cand, wbuf
     raise ValueError(
@@ -311,23 +399,40 @@ def ep_moe_fused_device(x_loc, wgu_loc, wd_loc, *, n: int, axis: str,
                         cap_e: int, collective_id: int,
                         resident_w: Optional[bool] = None,
                         block_i: Optional[int] = None,
-                        weight_buffers: int = 2):
+                        weight_buffers: int = 2,
+                        ablate: frozenset = frozenset(),
+                        straggler=None):
     """DEVICE-LOCAL one-kernel EP MoE (called inside the layer's
     shard_map, like dispatch_a2a/combine_a2a).
 
     x_loc: [n*E_loc*cap_e, D] send slots (global-expert-major, from
     plan_dispatch with one destination per global expert; reshaped to
     [n, E_loc, cap_e, D] slabs for the kernel);
-    wgu_loc: [E_loc, D, 2I]; wd_loc: [E_loc, I, D]. Returns
+    wgu_loc: [E_loc, D, 2I]; wd_loc: [E_loc, I, D] — either may be a
+    QuantW (q int8 + s per-expert per-output-column; both must then
+    be): the panels stream int8 and dequant after each dot. Returns
     y_back [n, E_loc, cap_e, D]: slab p = this device's tokens that
     were processed on peer p, in their slot order — flatten to
     [E_total*cap_e, D] for combine_from_slots."""
+    from triton_dist_tpu.kernels.quant import QuantW
+    quant = isinstance(wgu_loc, QuantW)
+    assert quant == isinstance(wd_loc, QuantW), \
+        "ep_moe_fused_device: quantize both expert weights or neither"
+    if quant:
+        sgu = wgu_loc.s.astype(jnp.float32)[:, None, :]   # [E, 1, 2I]
+        sd = wd_loc.s.astype(jnp.float32)[:, None, :]     # [E, 1, D]
+        wgu_loc, wd_loc = wgu_loc.q, wd_loc.q
     E_loc, D, I2 = wgu_loc.shape
     I = I2 // 2
     x_loc = x_loc.reshape(n, E_loc, cap_e, D)
     isz = jnp.dtype(x_loc.dtype).itemsize
+    wsz = jnp.dtype(wgu_loc.dtype).itemsize
+    # the f32 scale buffers are VMEM-resident in quant mode: they must
+    # count against every budget below or a real chip OOMs where the
+    # interpreter passes
+    s_bytes = E_loc * (2 * I + D) * 4 if quant else 0
     if resident_w is None:
-        resident_w = (E_loc * D * 3 * I * isz
+        resident_w = (E_loc * D * 3 * I * wsz + s_bytes
                       + 2 * cap_e * (2 * D + 2 * I) * 4) <= (10 << 20)
     # working set: double-buffered a/y tiles + weight panels (resident:
     # all experts once; streaming: 2 whole panels) + the f32 h
@@ -340,12 +445,14 @@ def ep_moe_fused_device(x_loc, wgu_loc, wd_loc, *, n: int, axis: str,
         wbuf = weight_buffers
         assert I % block_i == 0 and block_i % 128 == 0, (I, block_i)
     else:
-        ws = (4 * cap_e * D * isz + 2 * cap_e * 2 * I * 4
-              + (E_loc if resident_w else 2) * D * 3 * I * isz)
+        ws = (4 * cap_e * D * isz + 2 * cap_e * 2 * I * 4 + s_bytes
+              + (E_loc if resident_w else 2) * D * 3 * I * wsz)
         block_i, wbuf = _pick_block_i(
-            cap_e, D, I, isz, need=not resident_w and ws > (12 << 20))
+            cap_e, D, I, isz, need=not resident_w and ws > (12 << 20),
+            wsz=wsz, fixed_extra=s_bytes)
     kernel = functools.partial(_ep_fused_kernel, n, axis, E_loc,
-                               cap_e, resident_w, block_i, wbuf)
+                               cap_e, resident_w, block_i, wbuf, quant,
+                               ablate, straggler)
     nslot = 2 if block_i is None else 1
     if resident_w:
         wgu_shape, wd_shape = (E_loc, D, 2 * I), (E_loc, I, D)
@@ -354,6 +461,29 @@ def ep_moe_fused_device(x_loc, wgu_loc, wd_loc, *, n: int, axis: str,
     else:
         wgu_shape, wd_shape = ((wbuf, D, 2 * block_i),
                                (wbuf, block_i, D))
+    args = (x_loc, wgu_loc, wd_loc) + ((sgu, sd) if quant else ())
+    scratch = [
+        pltpu.VMEM((nslot, cap_e, D), x_loc.dtype),
+        pltpu.VMEM(wgu_shape, wgu_loc.dtype),
+        pltpu.VMEM(wd_shape, wd_loc.dtype),
+        pltpu.VMEM((nslot, cap_e, D), x_loc.dtype),
+    ]
+    if quant:
+        scratch += [pltpu.VMEM((E_loc, 1, 2 * I), jnp.float32),
+                    pltpu.VMEM((E_loc, 1, D), jnp.float32)]
+    scratch += [
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((n,)),
+        pltpu.SemaphoreType.DMA((n,)),
+    ]
+    if quant:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+    if straggler is not None:
+        scratch.append(pltpu.VMEM((8, 128), jnp.float32))
     _, yback, _ = pl.pallas_call(
         kernel,
         out_shape=(
@@ -361,23 +491,11 @@ def ep_moe_fused_device(x_loc, wgu_loc, wd_loc, *, n: int, axis: str,
             jax.ShapeDtypeStruct((n, E_loc, cap_e, D), x_loc.dtype),
             jax.ShapeDtypeStruct((n, E_loc, cap_e, D), x_loc.dtype),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                         for _ in range(3)),
-        scratch_shapes=[
-            pltpu.VMEM((nslot, cap_e, D), x_loc.dtype),
-            pltpu.VMEM(wgu_shape, wgu_loc.dtype),
-            pltpu.VMEM(wd_shape, wd_loc.dtype),
-            pltpu.VMEM((nslot, cap_e, D), x_loc.dtype),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((n,)),
-            pltpu.SemaphoreType.DMA((n,)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
-    )(x_loc, wgu_loc, wd_loc)
+    )(*args)
     return yback

@@ -25,9 +25,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels.ep_a2a import (combine_a2a, combine_from_slots,
-                                            dispatch_a2a, fill_send_buffers,
-                                            group_by_expert, plan_dispatch,
-                                            plan_dispatch_valid, route)
+                                            dispatch_a2a, dispatch_a2a_int8,
+                                            fill_send_buffers,
+                                            group_by_expert, pack_rows_int8,
+                                            plan_dispatch,
+                                            plan_dispatch_valid, route,
+                                            unpack_rows_int8)
 from triton_dist_tpu.kernels.group_gemm import grouped_gemm
 from triton_dist_tpu.kernels.swiglu import swiglu_ref
 from triton_dist_tpu.runtime import next_collective_id
@@ -56,12 +59,21 @@ class EP_MoE:
     # hop on slice_axis (mode="ep_2d"); None = single-tier ICI EP
     slice_axis: Optional[str] = dataclasses.field(
         default=None, metadata=dict(static=True))
+    # int8 token payloads on the wire (reference: the fp8 online quant
+    # of the LL EP protocol, low_latency_all_to_all_v2.py:55,213):
+    # dispatch AND combine rows travel packed (kernels/ep_a2a.py
+    # pack_rows_int8) at half the bf16 bytes; on fwd_ep_2d the packed
+    # rows cross DCN and ICI without an intermediate dequant. Lossy
+    # (one int8 rounding per direction), like the reference's fp8 wire.
+    payload_int8: bool = dataclasses.field(
+        default=False, metadata=dict(static=True))
 
     @staticmethod
     def init(w_router, w_gate, w_up, w_down, *, mesh: Mesh,
              axis: str = "tp", top_k: int,
              capacity_factor: float = 2.0,
-             slice_axis: Optional[str] = None) -> "EP_MoE":
+             slice_axis: Optional[str] = None,
+             payload_int8: bool = False) -> "EP_MoE":
         packed = jnp.concatenate([jnp.asarray(w_gate), jnp.asarray(w_up)],
                                  axis=-1)               # [E, D, 2I]
         espec = (P((slice_axis, axis), None, None) if slice_axis
@@ -72,11 +84,24 @@ class EP_MoE:
         return EP_MoE(w_router=jnp.asarray(w_router), w_gate_up=packed,
                       w_down=w_down, mesh=mesh, axis=axis, top_k=top_k,
                       capacity_factor=capacity_factor,
-                      slice_axis=slice_axis)
+                      slice_axis=slice_axis, payload_int8=payload_int8)
 
     @property
     def num_experts(self) -> int:
         return self.w_router.shape[1]
+
+    def quantize_int8_experts(self) -> "EP_MoE":
+        """Expert panels -> QuantW (int8 + per-expert per-output-column
+        scales), for mode='ep_fused' — the fused kernel streams int8
+        panels and dequants after each dot (its weight stream is the
+        measured bandwidth bound at tiled shapes; reference analog: fp8
+        weights through the fused grouped GEMM, ep_all2all_fused.py:599).
+        The chain paths (fwd_ep/fwd_ep_2d/fwd_xla) do not take QuantW —
+        quantize only the EP_MoE instance you run fused."""
+        from triton_dist_tpu.kernels.quant import quantize_int8
+        return dataclasses.replace(
+            self, w_gate_up=quantize_int8(self.w_gate_up),
+            w_down=quantize_int8(self.w_down))
 
     def _caps(self, t_loc: int):
         """(pair capacity, per-expert capacity): static shapes standing in
@@ -91,15 +116,17 @@ class EP_MoE:
         group_by_expert's third output) and warned in-program."""
         n = self.mesh.shape[self.axis]
         epr = self.num_experts // n
+        # a2a kernels slice send buffers at pl.ds(p * cap, cap), which
+        # Mosaic requires sublane-tile-aligned on real TPUs: 8 rows for
+        # f32/bf16 payloads, 32 for the packed int8 wire
+        r = 32 if self.payload_int8 else 8
         if self.capacity_factor == "dropless":
-            # all of a rank's entries to one destination / one expert;
-            # rounded up to whole 8-row sublane tiles — the a2a kernels
-            # slice send buffers at pl.ds(p * cap, cap), which Mosaic
-            # requires tile-aligned on real TPUs
-            pair = -(-t_loc * self.top_k // 8) * 8
+            # all of a rank's entries to one destination / one expert
+            pair = -(-t_loc * self.top_k // r) * r
             return pair, n * pair
         pair = int(self.capacity_factor * self.top_k * t_loc / n) + 1
-        pair = min(max(8, -(-pair // 8) * 8), t_loc * self.top_k)
+        pair = min(max(r, -(-pair // r) * r),
+                   -(-t_loc * self.top_k // r) * r)
         e_cap = int(self.capacity_factor * n * pair / epr) + 1
         e_cap = min(max(8, -(-e_cap // 8) * 8), n * pair)
         return pair, e_cap
@@ -124,10 +151,24 @@ class EP_MoE:
             "disp and comb must be overridden together"
         if disp is None:
             cid = next_collective_id()
-            disp = functools.partial(dispatch_a2a, n=n, axis=axis,
+            if self.payload_int8 and n > 1:
+                D = x.shape[1]
+
+                def disp(sx, sm):
+                    rp, rm = dispatch_a2a_int8(
+                        pack_rows_int8(sx), sm, n=n, axis=axis,
+                        collective_id=cid)
+                    return unpack_rows_int8(rp, D, sx.dtype), rm
+
+                def comb(ys):
+                    yp = combine_a2a(pack_rows_int8(ys), n=n, axis=axis,
                                      collective_id=cid)
-            comb = functools.partial(combine_a2a, n=n, axis=axis,
-                                     collective_id=cid)
+                    return unpack_rows_int8(yp, D, ys.dtype)
+            else:
+                disp = functools.partial(dispatch_a2a, n=n, axis=axis,
+                                         collective_id=cid)
+                comb = functools.partial(combine_a2a, n=n, axis=axis,
+                                         collective_id=cid)
         gemm = gemm or grouped_gemm
 
         @functools.partial(
@@ -206,7 +247,10 @@ class EP_MoE:
         T = x.shape[0]
         t_loc = T // (n_s * n_c)
         D = x.shape[1]
-        r8 = lambda v: max(8, -(-v // 8) * 8)
+        q8 = self.payload_int8
+        # int8 wire: ICI slices need 32-row sublane tiles (see _caps)
+        _r = 32 if q8 else 8
+        r8 = lambda v: max(_r, -(-v // _r) * _r)
         if self.capacity_factor == "dropless":
             cap_s = r8(t_loc * k)
             cap_c = r8(n_s * cap_s)       # all arrivals to one chip
@@ -227,14 +271,22 @@ class EP_MoE:
             out_specs=(P((sax, cax), None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
+            # int8 wire (payload_int8): tokens pack ONCE here and cross
+            # BOTH hops packed — the re-plan between tiers only permutes
+            # rows, so no intermediate dequant/requant happens and the
+            # per-direction loss is a single int8 rounding (reference:
+            # the fp8 wire of low_latency_all_to_all_v2.py:55,213,
+            # applied to the inter-node tier where bytes hurt most)
+            wire_x = pack_rows_int8(x_loc) if q8 else x_loc
+            Dw = wire_x.shape[1]
             # ---- tier 1 (DCN): group by destination SLICE; the meta
             # carries the within-slice expert id for tier 2
             plan1 = plan_dispatch(topk_idx, n_s, eps_, cap_s)
             send_x, send_meta = fill_send_buffers(
-                x_loc, topk_idx, plan1, n_s, eps_, cap_s)
+                wire_x, topk_idx, plan1, n_s, eps_, cap_s)
             rx = jax.lax.all_to_all(
-                send_x.reshape(n_s, cap_s, D), sax, 0, 0
-                ).reshape(n_s * cap_s, D)
+                send_x.reshape(n_s, cap_s, Dw), sax, 0, 0
+                ).reshape(n_s * cap_s, Dw)
             rm = jax.lax.all_to_all(
                 send_meta.reshape(n_s, cap_s, 2), sax, 0, 0
                 ).reshape(n_s * cap_s, 2)
@@ -244,8 +296,13 @@ class EP_MoE:
                 e_slice, rm[:, 1] > 0, n_c, epr, cap_c)
             send2_x, send2_m = fill_send_buffers(
                 rx, e_slice[:, None], plan2, n_c, epr, cap_c)
-            recv_x, recv_m = dispatch_a2a(send2_x, send2_m, n=n_c,
-                                          axis=cax, collective_id=cid)
+            if q8:
+                recv_p, recv_m = dispatch_a2a_int8(
+                    send2_x, send2_m, n=n_c, axis=cax, collective_id=cid)
+                recv_x = unpack_rows_int8(recv_p, D, x_loc.dtype)
+            else:
+                recv_x, recv_m = dispatch_a2a(send2_x, send2_m, n=n_c,
+                                              axis=cax, collective_id=cid)
             x_e, inv_slot, r_drop = group_by_expert(recv_x, recv_m, epr,
                                                     e_cap)
             h = grouped_gemm(x_e, wgu_loc.astype(x_e.dtype))
@@ -257,7 +314,10 @@ class EP_MoE:
                                 axis=0)
             y_slots = gathered * (inv_slot < epr * e_cap)[:, None].astype(
                 gathered.dtype)
-            y_back2 = combine_a2a(y_slots, n=n_c, axis=cax,
+            # combine wire: pack once, cross ICI then DCN packed,
+            # unpack once before the weighted reduce
+            y_wire = pack_rows_int8(y_slots) if q8 else y_slots
+            y_back2 = combine_a2a(y_wire, n=n_c, axis=cax,
                                   collective_id=cid)
             # tier-2 slots -> arrived-row order (weights applied only at
             # the final tier-1 combine)
@@ -266,8 +326,10 @@ class EP_MoE:
                               axis=0)
                      * plan2.valid[:, None].astype(y_back2.dtype))
             y_back1 = jax.lax.all_to_all(
-                y_arr.reshape(n_s, cap_s, D), sax, 0, 0
-                ).reshape(n_s * cap_s, D)
+                y_arr.reshape(n_s, cap_s, Dw), sax, 0, 0
+                ).reshape(n_s * cap_s, Dw)
+            if q8:
+                y_back1 = unpack_rows_int8(y_back1, D, x_loc.dtype)
             y = combine_from_slots(y_back1, plan1, topk_w, t_loc)
             loud = (warn_drops and self.capacity_factor != "dropless")
             if loud or return_stats:
@@ -288,7 +350,9 @@ class EP_MoE:
     def fwd_ep_fused(self, x, return_stats: bool = False,
                      warn_drops: bool = True,
                      fused_block_i: Optional[int] = None,
-                     fused_weight_buffers: int = 2):
+                     fused_weight_buffers: int = 2,
+                     fused_ablate: frozenset = frozenset(),
+                     fused_straggler=None):
         """ONE-kernel EP MoE (reference: ep_all2all_fused.py:73-560,
         VERDICT r2 missing #3): dispatch puts -> per-arrival expert
         MLPs -> combine puts from the GEMM epilogue, one pallas_call
@@ -301,6 +365,7 @@ class EP_MoE:
         peer's slab arrives pre-grouped (kernels/ep_fused.py). x: [T, D]
         row-sharded over the ep axis -> same sharding."""
         from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_device
+        from triton_dist_tpu.kernels.quant import QuantW, qspec
         n = self.mesh.shape[self.axis]
         axis = self.axis
         E = self.num_experts
@@ -309,11 +374,15 @@ class EP_MoE:
         t_loc = T // n
         cap_e = self._cap_e(t_loc)
         cid = next_collective_id()
+        wq = isinstance(self.w_gate_up, QuantW)
 
         @functools.partial(
             jax.shard_map, mesh=self.mesh,
             in_specs=(P(axis, None), P(None, None),
-                      P(axis, None, None), P(axis, None, None)),
+                      qspec(self.w_gate_up, P(axis, None, None),
+                            P(axis, None)),
+                      qspec(self.w_down, P(axis, None, None),
+                            P(axis, None))),
             out_specs=(P(axis, None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
@@ -324,10 +393,13 @@ class EP_MoE:
             send_x, _ = fill_send_buffers(x_loc, topk_idx, plan, E, 1,
                                           cap_e)
             yback = ep_moe_fused_device(
-                send_x, wgu_loc.astype(x_loc.dtype),
-                wd_loc.astype(x_loc.dtype), n=n, axis=axis, cap_e=cap_e,
+                send_x,
+                wgu_loc if wq else wgu_loc.astype(x_loc.dtype),
+                wd_loc if wq else wd_loc.astype(x_loc.dtype),
+                n=n, axis=axis, cap_e=cap_e,
                 collective_id=cid, block_i=fused_block_i,
-                weight_buffers=fused_weight_buffers)
+                weight_buffers=fused_weight_buffers,
+                ablate=fused_ablate, straggler=fused_straggler)
             y_flat = yback.reshape(E * cap_e, -1)
             y = combine_from_slots(y_flat, plan, topk_w, t_loc)
             # dropless-or-loud holds on this path too
