@@ -117,3 +117,54 @@ def test_ppipeline_many_microbatches_nonsquare():
     for s in range(n):
         ref = np.tanh(ref @ ws[s] + bs[s])
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_pp_1f1b_matches_sequential_vjp():
+    """1F1B training schedule at pp=4 (VERDICT r4 next #8): forward
+    outputs, input grads and per-stage parameter grads must match the
+    sequential jax.vjp oracle, with M=12 > slots=8 proving the O(n)
+    activation buffer (slot reuse) is sound, and per-stage occupancy
+    counters proving every stage did exactly M fwd and M bwd ticks
+    (no garbage compute banked, no tick skipped)."""
+    from triton_dist_tpu.layers.pp import train_1f1b
+    n = 4
+    mesh4 = jax.make_mesh((n,), ("pp",))
+    M, B, D = 12, 4, 128
+    rng = np.random.RandomState(5)
+    w = rng.randn(n, D, D).astype(np.float32) * (D ** -0.5)
+    b = rng.randn(n, D).astype(np.float32) * 0.1
+
+    def fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    pipe = PPipeline.init({"w": w, "b": b}, fn, mesh=mesh4, axis="pp")
+    x = rng.randn(M, B, D).astype(np.float32)
+    g = rng.randn(M, B, D).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        y, dx, dp, stats = train_1f1b(pipe, jnp.asarray(x),
+                                      jnp.asarray(g))
+    # memory shape: 8 activation slots for 12 in-flight-max microbatches
+    assert stats["slots"] == min(M, 2 * n) == 8 < M
+    assert stats["ticks"] == M + 2 * (n - 1)
+    work = np.asarray(stats["work"])
+    assert work.shape == (n, 2) and (work == M).all(), work
+
+    def seq(params, xm):
+        def one(xi):
+            for s in range(n):
+                xi = fn(jax.tree.map(lambda l: l[s], params), xi)
+            return xi
+        return jax.vmap(one)(xm)
+
+    with jax.default_matmul_precision("highest"):
+        yr, vjp = jax.vjp(seq, {"w": jnp.asarray(w), "b": jnp.asarray(b)},
+                          jnp.asarray(x))
+        dpr, dxr = vjp(jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dxr),
+                               atol=1e-5, rtol=1e-5)
+    for k2 in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(dp[k2]),
+                                   np.asarray(dpr[k2]),
+                                   atol=1e-5, rtol=1e-5)

@@ -17,4 +17,4 @@ from triton_dist_tpu.layers.sp_attn import (  # noqa: F401
     SPAttn,
     UlyssesAttn,
 )
-from triton_dist_tpu.layers.pp import PPipeline  # noqa: F401
+from triton_dist_tpu.layers.pp import PPipeline, train_1f1b  # noqa: F401
