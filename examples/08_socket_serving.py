@@ -1,0 +1,110 @@
+"""Streaming socket serving: server + chat client over TCP (reference
+flow: `mega_triton_kernel/test/models/model_server.py:265` server +
+`chat.py:207` client — prompt in, sampled tokens streamed back).
+
+Run with no args to see the full two-process flow: this script spawns
+ITSELF with --serve as the server process, waits for its PORT line,
+then streams a prompt through the socket and prints chunks as they
+arrive. `--serve` runs the server alone (connect with
+triton_dist_tpu.serving.request_stream or any line-JSON TCP client).
+"""
+
+import argparse
+import os
+import select
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+
+def run_server(max_requests, port):
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+    from triton_dist_tpu.runtime import initialize_distributed
+    from triton_dist_tpu.serving import ByteTokenizer, TokenServer
+
+    ctx = initialize_distributed()
+    n = ctx.tp_size()
+    cfg = tiny_qwen3(n)
+    model = AutoLLM.from_config(cfg, ctx.mesh)
+    eng = Engine(model, max_seq=64, backend="dist", sampling="top_p",
+                 temperature=0.8)
+    srv = TokenServer(eng, ByteTokenizer(cfg.vocab_size),
+                      batch=max(n, 2), port=port, chunk=4)
+    # the client (or test) parses this line to find the socket
+    print(f"PORT {srv.port}", flush=True)
+    srv.serve_forever(max_requests=max_requests)
+
+
+def run_client(port):
+    from triton_dist_tpu.serving import request_stream
+
+    print(f"client: streaming from 127.0.0.1:{port}")
+    chunks = []
+    for msg in request_stream("127.0.0.1", port, "hello tpu",
+                              gen_len=12, seed=1):
+        if msg.get("done"):
+            print(f"client: done, {msg['n_tokens']} tokens "
+                  f"in {len(chunks)} chunks")
+        else:
+            chunks.append(msg["text"])
+            print(f"client: chunk {len(chunks)}: {msg['text']!r}")
+    # the stream must actually be incremental: gen_len=12 at chunk=4
+    # arrives as 3 separate messages, not one
+    assert len(chunks) == 3, chunks
+    assert sum(len(c) for c in chunks) == 12
+    return "".join(chunks)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--max-requests", type=int, default=1)
+    args = ap.parse_args()
+    if args.serve:
+        return run_server(args.max_requests, args.port)
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve",
+         "--max-requests", "1"],
+        stdout=subprocess.PIPE, text=True, env=dict(os.environ))
+    try:
+        port = None
+        deadline = time.time() + 600
+        while time.time() < deadline and port is None:
+            r, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not r:
+                if proc.poll() is not None:
+                    raise RuntimeError("server exited before PORT line")
+                continue
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("server closed stdout before PORT")
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+        assert port, "server never reported its port"
+        text = run_client(port)
+        print(f"streamed reply: {text!r}")
+        print("OK")
+    finally:
+        # never orphan the server: it exits after max_requests on the
+        # happy path; on any client failure, terminate it
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Socket serving: a minimal streaming token server + client over the
+Engine.
+
+TPU re-design of the reference's serving pair
+(`mega_triton_kernel/test/models/model_server.py:265` — a TCP server
+that tokenizes prompts, prefills, and streams sampled tokens — and the
+interactive `chat.py:207` client). Protocol is line-delimited JSON over
+TCP:
+
+  client -> {"prompt": str, "gen_len": int, "seed": int}\n
+  server -> {"text": str, "token_ids": [...]}\n        per decode chunk
+            {"done": true, "n_tokens": int}\n          terminator
+
+Tokens stream INCREMENTALLY: the decode runs in chunks of `chunk`
+steps (each chunk one jitted scan, carrying (logits, cache) across
+chunks), so the client renders text while the model is still
+generating — the reference's streaming UX without its per-token Python
+loop. Greedy chunked decode is token-exact vs the single-scan path
+(same argmax chain); sampled decode draws one fresh key per chunk.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Toy byte-level tokenizer capped to a vocab (examples/07's demo
+    tokenizer, importable for the serving tests)."""
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str):
+        return [b % self.vocab_size for b in text.encode()]
+
+    def decode(self, ids):
+        return bytes(int(i) % 256 for i in ids).decode("latin-1")
+
+
+def decode_stream(engine, logits, cache, gen_len: int, *, chunk: int = 4,
+                  seed: int = 0):
+    """Yield token chunks [B, <=chunk] as they are generated: each chunk
+    is one jitted decode scan, with (logits, cache) carried between
+    chunks (the cache is donated into each scan, so memory stays flat).
+    Greedy chunking is exact — the argmax chain is identical to one
+    gen_len-long scan."""
+    import jax
+    if engine.backend == "mega":
+        raise ValueError("mega decode carries no resumable logits; "
+                         "stream with the per-op backends")
+    key = jax.random.key(seed)
+    done = 0
+    while done < gen_len:
+        g = min(chunk, gen_len - done)
+        if engine.sampling == "greedy":
+            toks, logits, cache = engine._decode_scan(
+                engine.model, logits, cache, gen_len=g)
+        else:
+            key, sub = jax.random.split(key)
+            toks, logits, cache = engine._decode_scan(
+                engine.model, logits, cache, sub, gen_len=g)
+        yield np.asarray(toks)
+        done += g
+
+
+class TokenServer:
+    """Accept prompts, prefill, stream decode chunks back (reference:
+    model_server.py's request loop). One request at a time — the model
+    owns the chip; concurrency is batching, not threads."""
+
+    def __init__(self, engine, tokenizer, *, batch: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 chunk: int = 4):
+        self.engine = engine
+        self.tok = tokenizer
+        self.batch = batch
+        self.chunk = chunk
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(4)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+
+    def handle(self, conn: socket.socket) -> None:
+        conn.settimeout(60.0)     # a silent client cannot pin the loop
+        with conn, conn.makefile("rw") as f:
+            line = f.readline()
+            if not line.strip():
+                return
+            req = json.loads(line)
+            ids = self.tok.encode(req.get("prompt", "")) or [0]
+            gen_len = int(req.get("gen_len", 16))
+            seed = int(req.get("seed", 0))
+            x = np.tile(np.asarray(ids, np.int32)[None], (self.batch, 1))
+            logits, cache = self.engine.prefill(x)
+            n = 0
+            for toks in decode_stream(self.engine, logits, cache,
+                                      gen_len, chunk=self.chunk,
+                                      seed=seed):
+                row = [int(t) for t in toks[0]]
+                f.write(json.dumps(
+                    {"text": self.tok.decode(row),
+                     "token_ids": row}) + "\n")
+                f.flush()           # the stream is the point
+                n += len(row)
+            f.write(json.dumps({"done": True, "n_tokens": n}) + "\n")
+            f.flush()
+
+    def serve_forever(self, max_requests: Optional[int] = None) -> None:
+        import sys
+        served = 0
+        self._sock.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                try:
+                    self.handle(conn)
+                except (OSError, ValueError, KeyError) as e:
+                    # malformed request / client gone mid-stream: log,
+                    # keep serving (the reference server's loop survives
+                    # bad clients too)
+                    print(f"[TokenServer] request failed: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                served += 1
+                if max_requests is not None and served >= max_requests:
+                    break
+        finally:
+            self._sock.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def request_stream(host: str, port: int, prompt: str, *,
+                   gen_len: int = 16, seed: int = 0,
+                   timeout: float = 300.0) -> Iterator[dict]:
+    """Client: send one prompt, yield the server's chunk messages as
+    they arrive (the last one has {"done": true}). Reference: the
+    chat.py client's receive loop."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        with s.makefile("rw") as f:
+            f.write(json.dumps({"prompt": prompt, "gen_len": gen_len,
+                                "seed": seed}) + "\n")
+            f.flush()
+            for line in f:
+                msg = json.loads(line)
+                yield msg
+                if msg.get("done"):
+                    return
