@@ -100,3 +100,27 @@ def test_low_latency_a2a():
             np.testing.assert_allclose(
                 ll_np[d, p], np.asarray(x)[p, d],
                 atol=float(scale.max()) * 0.02 + 1e-6)
+
+
+def test_gdn_pallas_deep_decay_span():
+    """Deep-decay chunks (per-chunk span >> 60 nats): the two-level
+    outer-product decay must match the exact-exp 'ut' closed form —
+    the regression the naive clamped outer form had (factors inflating
+    to ~1 when both indices sat past the clamp horizon)."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd
+    rng = np.random.RandomState(44)
+    B, H, T, d = 1, 2, 128, 128
+    q = jnp.asarray(rng.randn(B, H, T, d), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(B, H, T, d), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(B, H, T, d), jnp.float32) * 0.3
+    # g ~ -3/token -> span ~192 over a C=64 chunk: far past the 60-nat
+    # band, with adjacent-token factors still O(e-3) (must NOT vanish
+    # or inflate)
+    g = jnp.asarray(-(2.5 + rng.rand(B, H, T)), jnp.float32)
+    b = jnp.asarray(rng.rand(B, H, T), jnp.float32)
+    o_pal, s_pal = gdn_fwd(q, k, v, g, b, mode="pallas")
+    o_ut, s_ut = gdn_fwd(q, k, v, g, b, mode="ut")
+    np.testing.assert_allclose(np.asarray(o_pal), np.asarray(o_ut),
+                               atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(s_pal), np.asarray(s_ut),
+                               atol=2e-4, rtol=2e-3)

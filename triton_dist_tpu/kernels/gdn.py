@@ -39,6 +39,14 @@ cut); state-independent U0/W2 precompute with K=2 chunks/step 1459
 (VMEM forces X=8); bf16 [C,C] elementwise + parallel head dim 1621.
 The remaining gap is the [64,64] solve chain's ~25% MXU shape
 utilization, which no tested restructuring beat.
+
+Round 5: the [X,C,C] decay exp — the largest VPU term of the step —
+is replaced by a two-level outer product of [X,C] exps (see the
+comment in _gdn_kernel): exact at EVERY decay span (the 60-nat band
+index is selected by an integer outer difference, so nothing clamps or
+cancels; sub-e-60 factors round to their underflowed-anyway 0).
+On-chip delta pending the chip's return; differential tests include a
+deep-decay chunk (span >> 60) vs the exact-exp oracle.
 """
 
 from __future__ import annotations
@@ -55,7 +63,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime import interpret_mode
 
 
-def _gdn_kernel(C: int, nc: int, last_sq: int,
+def _gdn_kernel(C: int, nc: int, last_sq: int, ablate: frozenset,
                 q_ref, k_ref, v_ref, g_ref, b_ref, s0_ref,
                 o_ref, sT_ref, S_scr):
     """One grid step = one chunk for a block of X heads; the state
@@ -108,29 +116,69 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
     # this is one MXU op instead of a VPU log-step scan)
     cum = jnp.dot(gf, (rowi <= colj).astype(f32),
                   preferred_element_type=f32)        # [X, C]
-    A = jnp.exp(cum)
-    decay = cum[:, :, None] - cum[:, None, :]        # cum_i - cum_j
-    # mask exponents BEFORE exp: unmasked upper-triangle entries are
-    # positive and overflow
-    ldec = jnp.exp(jnp.where((rowi > colj)[None], decay, -1e30))
+    # kprof ablation phases (tools/kprof.py): "exps" (all VPU
+    # transcendentals -> 1), "solve" (the doubling-product inverse),
+    # "out" (the two O dots), "state" (the chunk-exit state update).
+    # Each keeps shapes/protocol; only the timed work is removed.
+    exps_on = "exps" not in ablate
+    A = jnp.exp(cum) if exps_on else jnp.ones_like(cum)
+    # exp(cum_i - cum_j) as an OUTER PRODUCT of two [X,C]-vector exps
+    # instead of one [X,C,C]-tensor exp — the largest VPU term in the
+    # step (r5 attack on the 0.33-SOL gap). A naive outer form
+    # exp(cs_i)*exp(-cs_j) overflows/cancels once the chunk's decay
+    # span passes the f32 exp range, so the exponent splits two-level:
+    #   cs = 60*k + r,  k = floor(cs/60) <= 0 integer,  r in [0, 60)
+    #   exp(cs_i - cs_j) = exp(r_i - r_j) * exp(60*(k_i - k_j))
+    # The r-outer-product is range-safe (each factor in [e-60, e60]).
+    # In the masked region i > j, cs is non-increasing so k_i - k_j in
+    # {0, -1, -2, ...}: 0 -> factor 1 (exact), -1 -> e-60 (exact),
+    # <= -2 -> true factor < e-60, set to 0 (below f32 anyway). Cost:
+    # two [X,C] exps + one [X,C,C] int-difference select — no [C,C]
+    # transcendental, exact at every span.
+    cs = cum - jax.lax.slice_in_dim(cum, 0, 1, axis=1)
+    if exps_on:
+        kq = jnp.floor(cs * (1.0 / 60.0))            # [X, C], <= 0
+        rr = cs - 60.0 * kq                          # in [0, 60)
+        e_i = jnp.exp(rr)                            # <= e60
+        e_jinv = jnp.exp(-rr)                        # >= e-60
+        d = kq[:, :, None] - kq[:, None, :]          # k_i - k_j
+        hi = jnp.where(d > -0.5, 1.0,
+                       jnp.where(d > -1.5, jnp.float32(8.75651076e-27),
+                                 0.0))               # e-60
+        ldec = jnp.where((rowi > colj)[None],
+                         e_i[:, :, None] * e_jinv[:, None, :] * hi, 0.0)
+    else:
+        ldec = jnp.where((rowi > colj)[None],
+                         jnp.float32(1.0), 0.0) + jnp.zeros(
+                             (cum.shape[0], C, C), f32)
     eye = jnp.eye(C, dtype=f32)[None]
     idec = ldec + eye            # diag decay is exp(0)=1: one exp saved
     N = bf[..., None] * (ldec * bmmT(kf, kf))        # strictly lower
     Minv = eye - N
-    P = bmm(N, N)
-    for i in range(last_sq):
-        Minv = Minv + bmm(Minv, P)
-        if i < last_sq - 1:
-            P = bmm(P, P)
+    if "solve" not in ablate:
+        P = bmm(N, N)
+        for i in range(last_sq):
+            Minv = Minv + bmm(Minv, P)
+            if i < last_sq - 1:
+                P = bmm(P, P)
     rhs = bf[..., None] * (vf - A[..., None] * bmm(kf, S))
     U = bmm(Minv, rhs)                               # [X, C, dv]
-    O = A[..., None] * bmm(qf, S) + bmm(idec * bmmT(qf, kf), U)
+    if "out" not in ablate:
+        O = A[..., None] * bmm(qf, S) + bmm(idec * bmmT(qf, kf), U)
+    else:
+        O = U
     cum_last = jax.lax.slice_in_dim(cum, C - 1, C, axis=1)   # [X, 1]
-    w = jnp.exp(cum_last - cum)[..., None] * kf.astype(f32)  # [X, C, dk]
-    S_new = (jnp.exp(cum_last)[..., None] * S
-             + jax.lax.dot_general(w.astype(mx), U.astype(mx),
-                                   (((1,), (1,)), ((0,), (0,))),
-                                   preferred_element_type=f32))
+    if "state" not in ablate:
+        wdec = (jnp.exp(cum_last - cum) if exps_on
+                else jnp.ones_like(cum))
+        w = wdec[..., None] * kf.astype(f32)         # [X, C, dk]
+        a_last = jnp.exp(cum_last) if exps_on else jnp.ones_like(cum_last)
+        S_new = (a_last[..., None] * S
+                 + jax.lax.dot_general(w.astype(mx), U.astype(mx),
+                                       (((1,), (1,)), ((0,), (0,))),
+                                       preferred_element_type=f32))
+    else:
+        S_new = S
     o_ref[...] = O.astype(o_ref.dtype)
     S_scr[...] = S_new
 
@@ -139,7 +187,8 @@ def _gdn_kernel(C: int, nc: int, last_sq: int,
         sT_ref[...] = S_new
 
 
-def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None):
+def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None,
+                ablate: frozenset = frozenset()):
     """Pallas chunkwise GDN: grid (head blocks, chunks), state carried in
     VMEM, chunk blocks streamed by the grid pipeline."""
     B, H, T, dk = q.shape
@@ -171,7 +220,7 @@ def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None):
 
     hblk = lambda d: pl.BlockSpec((X, chunk, d), lambda i, c: (i, c, 0))
     o, sT = pl.pallas_call(
-        functools.partial(_gdn_kernel, chunk, nc, last_sq),
+        functools.partial(_gdn_kernel, chunk, nc, last_sq, ablate),
         grid=(BH // X, nc),
         in_specs=[hblk(dk), hblk(dk), hblk(dv),
                   pl.BlockSpec((1, X, chunk), lambda i, c: (c, i, 0)),
@@ -190,7 +239,9 @@ def _gdn_pallas(q, k, v, g, beta, S0, chunk: int, X: Optional[int] = None):
 
 
 def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
-            chunk: int = 64, mode: str = "pallas") -> Tuple[jax.Array, jax.Array]:
+            chunk: int = 64, mode: str = "pallas",
+            ablate: frozenset = frozenset()
+            ) -> Tuple[jax.Array, jax.Array]:
     """q, k: [B, H, T, dk]; v: [B, H, T, dv]; g (log decay, <= 0) and
     beta (write strength, in [0, 1]): [B, H, T]. Returns (o [B,H,T,dv],
     S_T [B,H,dk,dv]).
@@ -223,7 +274,7 @@ def gdn_fwd(q, k, v, g, beta, *, S0: Optional[jax.Array] = None,
     if mode == "pallas":
         # beta=0 on pad tokens leaves the state untouched, so S_T from
         # the padded run IS the state at T
-        o, S_T = _gdn_pallas(q, k, v, g, beta, S0, chunk)
+        o, S_T = _gdn_pallas(q, k, v, g, beta, S0, chunk, ablate=ablate)
         return o[:, :, :T].astype(q.dtype), S_T
 
     def to_chunks(a):
