@@ -14,12 +14,18 @@ path because the scalar-prefetch grid machinery already evaluates index
 maps ahead of the DMAs (the TPU analog of the reference's in-kernel
 `page_table[block_idx]` load).
 
-Deliberate trade (documented, measured in mega/CEILING.md): paging
-forces one (batch, head) stream per grid row (pages of different
-streams are not contiguous), so the walk runs at batch-block bx=1 —
-more grid steps than the contiguous cache's bx=64 walk. Paging buys
-allocation flexibility, not speed; use the contiguous cache when every
-sequence has the same static budget.
+Pages of different streams are not contiguous, so one BLOCK cannot
+span streams — but one GRID STEP can: the walk batches W streams per
+step by giving the kernel W separate K/V operands, each with its own
+page-resolving index map (W k-blocks + W v-blocks DMA in parallel
+under the step's compute, per-stream online-softmax accumulators in
+one scratch). This cuts the grid to X/W * max_pages steps — the
+step-count overhead that made the r3 bx=1 walk slow — while keeping
+the pure-indirection layout. W = largest of (8, 4, 2, 1) dividing
+B*Hkv. The residual gap vs the contiguous cache is the per-stream dot
+shape ([rep, page] instead of a [64*rep, page] slab): paging still
+buys allocation flexibility first, but the walk is no longer
+step-bound.
 """
 
 from __future__ import annotations
@@ -36,10 +42,17 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime import interpret_mode
 
 
-def _paged_kernel(scale: float, rep: int, page: int, len_ref, q_ref,
-                  k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
-    """Grid (X, max_pages); one (batch, kv-head) stream per grid row.
-    Same online softmax as _flash_decode_kernel, block = one page."""
+def _paged_kernel(scale: float, rep: int, page: int, W: int, len_ref,
+                  *refs):
+    """Grid (X // W, max_pages); W (batch, kv-head) streams per grid
+    step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, o, m/l/acc scratch).
+    Same online softmax as _flash_decode_kernel, block = one page; the
+    W streams' pages DMA in parallel under the step and each keeps its
+    own accumulator row."""
+    q_ref = refs[0]
+    k_refs = refs[1:1 + W]
+    v_refs = refs[1 + W:1 + 2 * W]
+    o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
     t = pl.program_id(1)
     nt = pl.num_programs(1)
     rows = q_ref.shape[1]
@@ -55,25 +68,29 @@ def _paged_kernel(scale: float, rep: int, page: int, len_ref, q_ref,
 
     @pl.when(start < kv_len)
     def _compute():
-        q = q_ref[...]                                   # [1, rows, d]
-        s = jax.lax.dot_general(
-            q, k_ref[...], (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # [1, rows, page]
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 1) + start
         mask = (col <= (row + q_off)) & (col < kv_len)
-        m_prev = m_scr[...]
-        m_new = jnp.maximum(m_prev,
-                            jnp.max(jnp.where(mask[None], s, -1e30), -1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[...],
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc_scr[...] = acc_scr[...] * alpha[..., None] + pv
-        m_scr[...] = m_new
+        for j in range(W):
+            q = q_ref[pl.ds(j, 1)]                       # [1, rows, d]
+            s = jax.lax.dot_general(
+                q, k_refs[j][...], (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32
+                ) * scale                                # [1, rows, page]
+            m_prev = m_scr[pl.ds(j, 1)]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(jnp.where(mask[None], s, -1e30), -1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
+            l_scr[pl.ds(j, 1)] = (l_scr[pl.ds(j, 1)] * alpha
+                                  + jnp.sum(p, -1))
+            pv = jax.lax.dot_general(
+                p.astype(v_refs[j].dtype), v_refs[j][...],
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            acc_scr[pl.ds(j, 1)] = (acc_scr[pl.ds(j, 1)]
+                                    * alpha[..., None] + pv)
+            m_scr[pl.ds(j, 1)] = m_new
 
     @pl.when(t == nt - 1)
     def _done():
@@ -101,6 +118,9 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         scale = d ** -0.5
     rows = rep
     qx = (q.reshape(B, Hkv, rep, d).reshape(X, rows, d))
+    # W streams per grid step (see module docstring): the largest
+    # divisor of X in (8, 4, 2, 1)
+    W = next(w for w in (8, 4, 2, 1) if X % w == 0)
     # scalars: [kv_len, q_off, table...]; the kv index map resolves the
     # logical tile through the table (clamped to the last valid tile so
     # the tail is elided like the contiguous walk)
@@ -108,35 +128,36 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         jnp.asarray([kv_len, kv_len - 1], jnp.int32),
         page_table.reshape(-1).astype(jnp.int32)])
 
-    def kv_map(x, t, s_ref):
-        last = jnp.maximum((s_ref[0] + page - 1) // page - 1, 0)
-        return (s_ref[2 + x * maxp + jnp.minimum(t, last)], 0)
+    def kv_map_j(j):
+        def kv_map(x, t, s_ref):
+            last = jnp.maximum((s_ref[0] + page - 1) // page - 1, 0)
+            return (s_ref[2 + (x * W + j) * maxp + jnp.minimum(t, last)],
+                    0, 0)
+        return kv_map
 
     def q_map(x, t, s_ref):
         return (x, 0, 0)
 
+    kv_specs = [pl.BlockSpec((1, page, d), kv_map_j(j)) for j in range(W)]
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, float(scale), rep, page),
+        functools.partial(_paged_kernel, float(scale), rep, page, W),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(X, maxp),
-            in_specs=[
-                pl.BlockSpec((1, rows, d), q_map),
-                pl.BlockSpec((1, page, d),
-                             lambda x, t, s: (kv_map(x, t, s)[0], 0, 0)),
-                pl.BlockSpec((1, page, d),
-                             lambda x, t, s: (kv_map(x, t, s)[0], 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, rows, d), q_map),
+            grid=(X // W, maxp),
+            in_specs=[pl.BlockSpec((W, rows, d), q_map)]
+                     + kv_specs + kv_specs,
+            out_specs=pl.BlockSpec((W, rows, d), q_map),
             scratch_shapes=[
-                pltpu.VMEM((1, rows), jnp.float32),
-                pltpu.VMEM((1, rows), jnp.float32),
-                pltpu.VMEM((1, rows, d), jnp.float32),
+                pltpu.VMEM((W, rows), jnp.float32),
+                pltpu.VMEM((W, rows), jnp.float32),
+                pltpu.VMEM((W, rows, d), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((X, rows, d), q.dtype),
         interpret=interpret_mode(),
-    )(scalars, qx, pages_k, pages_v)
+        # the W k (v) operands are the SAME pool array — one buffer,
+        # W per-stream index maps
+    )(scalars, qx, *([pages_k] * W), *([pages_v] * W))
     return out.reshape(B, Hkv, rep, d).reshape(B, 1, Hq, d)
 
 
