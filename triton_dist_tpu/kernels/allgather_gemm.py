@@ -126,7 +126,7 @@ def _tune_block_n(mesh: Mesh, axis: str, M: int, K: int, N_local: int,
 
 
 def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
-                    straggler, *refs):
+                    straggler, trace: bool, *refs):
     """Fused ring-AG + GEMM (consumer analog: kernel_consumer_gemm_persistent,
     allgather_gemm.py:199; producer analog: cp_engine_producer_all_gather,
     allgather.py:202 — both folded into one kernel here).
@@ -147,6 +147,19 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
     """
     if straggler is not None:
         spin_vmem, refs = refs[-1], refs[:-1]
+    if trace:
+        # progress-trace SMEM output (the implementable slice of the
+        # reference's in-kernel timestamp profiler,
+        # tools/profiler/language.py:38 — see kprof.py docstring):
+        # Mosaic exposes no device clock, but pltpu.semaphore_read
+        # samples semaphore STATE without consuming it, so each ring
+        # step stamps whether the next chunk had already landed when
+        # this step's compute finished (arrival>0: comm fully hidden;
+        # 0: the consumer wait genuinely blocked — with a straggler
+        # injected, the stalled step/peer shows up here).
+        ti = 5 if quant else 4       # trace output follows o_ref
+        trace_ref = refs[ti]
+        refs = refs[:ti] + refs[ti + 1:]
     if quant:
         (a_ref, b_ref, s_ref, ag_ref, o_ref, a_vmem, b_vmem, o_vmem,
          s_vmem, copy_sem, a_sem, b_sems, o_sems, send_sem, recv_sems,
@@ -189,6 +202,10 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
         cp_s.start()
         cp_s.wait()
     cp_ag.wait()
+    if trace:
+        for s in range(n):
+            trace_ref[s, 0] = jnp.int32(-1)   # -1 = step never stamped
+            trace_ref[s, 1] = jnp.int32(-1)
     dl.barrier_all(axis)
 
     _, right = dl.ring_neighbors(axis)
@@ -249,6 +266,21 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
             # allgather_gemm.py:209): next chunk landed from the left;
             # start its VMEM stage now, wait at the top of step s+1.
             nxt_src = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
+            if trace:
+                # pre-wait arrival state: >0 = the chunk already landed
+                # (comm hidden under this step's dots); 0 = about to
+                # block. Col 1: outstanding-send state at the same
+                # point. semaphore_read has no interpreter lowering, so
+                # off-chip the stamp is the sentinel -2 ("step reached,
+                # state unreadable") and the structure still validates.
+                if trace == "read":
+                    trace_ref[s, 0] = pltpu.semaphore_read(
+                        recv_sems.at[nxt_src]).astype(jnp.int32)
+                    trace_ref[s, 1] = pltpu.semaphore_read(
+                        send_sem).astype(jnp.int32)
+                else:
+                    trace_ref[s, 0] = jnp.int32(-2)
+                    trace_ref[s, 1] = jnp.int32(-2)
             pltpu.make_async_copy(a_ref, a_ref, recv_sems.at[nxt_src]).wait()
             pltpu.make_async_copy(
                 ag_ref.at[pl.ds(nxt_src * m_loc, m_loc)], a_vmem.at[nxt],
@@ -263,15 +295,18 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext,
-                  s_shard=None, straggler=None):
+                  s_shard=None, straggler=None, trace=False):
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]
     n = ctx.n
     quant = s_shard is not None
     block_n = _divisor_block(n_loc, ctx.block_n)
     M = n * m_loc
+    if trace:
+        from triton_dist_tpu.runtime import on_tpu
+        trace = "read" if on_tpu() else "mark"
     kernel = functools.partial(_ag_gemm_kernel, n, ctx.axis, block_n,
-                               quant, straggler)
+                               quant, straggler, trace)
     scratch = [
         pltpu.VMEM((2, m_loc, K), a_shard.dtype),
         pltpu.VMEM((1 if block_n >= n_loc else 2, K, block_n),
@@ -293,26 +328,34 @@ def _ag_gemm_call(a_shard, b_shard, ctx: AllGatherGEMMTensorParallelContext,
     if straggler is not None:
         scratch.append(pltpu.VMEM((8, 128), jnp.float32))
     args = (a_shard, b_shard) + ((s_shard,) if quant else ())
-    ag, out = pl.pallas_call(
+    out_shape = [
+        jax.ShapeDtypeStruct((M, K), a_shard.dtype),
+        jax.ShapeDtypeStruct((M, n_loc), a_shard.dtype),
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    if trace:
+        # per-ring-step semaphore-state stamps (SMEM: scalar stores);
+        # one row per ring step so no step is ever invisible
+        out_shape.append(jax.ShapeDtypeStruct((n, 2), jnp.int32))
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    res = pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((M, K), a_shard.dtype),
-            jax.ShapeDtypeStruct((M, n_loc), a_shard.dtype),
-        ),
+        out_shape=tuple(out_shape),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(args),
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
-                   pl.BlockSpec(memory_space=pl.ANY)),
+        out_specs=tuple(out_specs),
         scratch_shapes=scratch,
         compiler_params=shmem_compiler_params(ctx.collective_id, n=n),
         interpret=interpret_mode(),
     )(*args)
-    return ag, out
+    return res   # (ag, out[, trace])
 
 
 def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
             *, mesh: Optional[Mesh] = None, axis: str = "tp",
             return_ag: bool = False,
-            straggler: Optional[Tuple[int, int, int]] = None):
+            straggler: Optional[Tuple[int, int, int]] = None,
+            progress_trace: bool = False):
     """C = allgather(A) @ B with comm/compute overlap (reference: ag_gemm,
     allgather_gemm.py:568).
 
@@ -320,6 +363,16 @@ def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
     (column-parallel weight). Returns C: [M, N] sharded on cols, and
     optionally the gathered A (replicated) — the reference keeps gathered
     A in the ctx workspace for reuse by the attention path.
+
+    progress_trace=True additionally returns [n_ranks, n_ranks, 2]
+    int32 per-ring-step semaphore-state stamps (col 0: pre-wait arrival
+    count of the next chunk — >0 means the comm was fully hidden under
+    this step's dots, 0 means the consumer wait genuinely blocked;
+    col 1: send-semaphore state; -1: step not reached — only the last
+    step, which has no consumer wait). The device-timeline
+    answer to the reference's in-kernel timestamp profiler
+    (tools/profiler/language.py:38) within what Mosaic exposes — see
+    tools/kprof.py.
     """
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
@@ -332,30 +385,40 @@ def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
     mesh = ctx.mesh
     axis = ctx.axis
 
+    out_specs = (P(None, None), P(None, axis))
+    if progress_trace:
+        out_specs = out_specs + (P(axis, None),)   # per-rank stamps
     if quant:
         # int8 weight panels stream through the kernel; per-column
         # scales ride as a [1, N] side input, applied after each dot
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(P(axis, None), P(None, axis), P(None, axis)),
-            out_specs=(P(None, None), P(None, axis)),
+            out_specs=out_specs,
             check_vma=False)
         def _fq(a_shard, b_shard, s_shard):
             return _ag_gemm_call(a_shard, b_shard, ctx, s_shard,
-                                 straggler)
+                                 straggler, trace=progress_trace)
 
-        ag, out = _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
+        res = _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
     else:
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(P(axis, None), P(None, axis)),
-            out_specs=(P(None, None), P(None, axis)),
+            out_specs=out_specs,
             check_vma=False)
         def _f(a_shard, b_shard):
             return _ag_gemm_call(a_shard, b_shard, ctx,
-                                 straggler=straggler)
+                                 straggler=straggler,
+                                 trace=progress_trace)
 
-        ag, out = _f(a, bq)
+        res = _f(a, bq)
+    ag, out = res[0], res[1]
+    extras = ()
+    if progress_trace:
+        # [n, n, 2]: rank-major per-step (pre-wait recv, send) stamps
+        nr = mesh.shape[axis]
+        extras = extras + (res[2].reshape(nr, nr, 2),)
     if return_ag:
-        return out, ag
-    return out
+        extras = (ag,) + extras
+    return (out,) + extras if extras else out

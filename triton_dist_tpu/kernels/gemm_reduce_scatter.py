@@ -92,7 +92,7 @@ from triton_dist_tpu.utils import divisor_block as _divisor_block  # noqa: E402
 
 
 def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
-                    *refs):
+                    straggler, *refs):
     """Software-pipelined producer + fold (the TPU analog of the
     reference's per-tile-notify producer GEMM, gemm_reduce_scatter.py:
     125-333, which never stalls the tensor cores on memory):
@@ -104,6 +104,8 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
         of j+1 while the VPU adds tile j, and stages its writebacks the
         same way.
     """
+    if straggler is not None:
+        spin_vmem, refs = refs[-1], refs[:-1]
     if quant:
         (a_ref, b_ref, s_ref, o_ref, land_ref, send_buf,
          a_vmem, b_vmem, t_vmem, d_vmem, l_vmem, s_vmem,
@@ -148,6 +150,19 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
         last = s == n - 1
         chunk = chunk_of(s)
         dest = dest_of(s)
+        if straggler is not None and s == straggler[1]:
+            # fault injection INSIDE the ring (reference:
+            # straggler_option, allgather_gemm.py:660-661): the
+            # designated rank stalls at this step, so its producer
+            # chunk, fold and RDMA all run late — the right neighbor's
+            # recv wait and the left's credit wait must really block on
+            # the semaphores, not on schedule luck
+            @pl.when(me == jnp.int32(straggler[0]))
+            def _stall():
+                spin_vmem[...] = jax.lax.fori_loop(
+                    0, straggler[2],
+                    lambda i, a: a * 1.0000001 + 1e-9,
+                    jnp.ones((8, 128), jnp.float32))
         if s >= 2 and not last:
             # this slot's previous RDMA must finish reading send_buf
             dl.quiet(send_sems.at[slot], send_buf.at[slot], 1)
@@ -259,7 +274,7 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
 
 def _gemm_rs_call(a_shard, b_shard,
                   ctx: GEMMReduceScatterTensorParallelContext,
-                  s_shard=None):
+                  s_shard=None, straggler=None):
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
     n = ctx.n
@@ -271,7 +286,7 @@ def _gemm_rs_call(a_shard, b_shard,
     m_loc = M // n
     block_n = _divisor_block(N, ctx.block_n)
     kernel = functools.partial(_gemm_rs_kernel, n, ctx.axis, block_n,
-                               quant)
+                               quant, straggler)
     scratch = [
         pltpu.VMEM((2, m_loc, k_loc), a_shard.dtype),
         pltpu.VMEM((1 if block_n >= N else 2, k_loc, block_n),
@@ -294,6 +309,8 @@ def _gemm_rs_call(a_shard, b_shard,
     ]
     if quant:
         scratch.append(pltpu.SemaphoreType.DMA(()))
+    if straggler is not None:
+        scratch.append(pltpu.VMEM((8, 128), jnp.float32))
     args = (a_shard, b_shard) + ((s_shard,) if quant else ())
     # landing/staging HBM buffers as extra outputs (hardware forbids
     # non-vmem scratch); kernel arg order is unchanged
@@ -313,13 +330,19 @@ def _gemm_rs_call(a_shard, b_shard,
 
 
 def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
-            *, mesh: Optional[Mesh] = None, axis: str = "tp"):
+            *, mesh: Optional[Mesh] = None, axis: str = "tp",
+            straggler=None):
     """C = reduce_scatter(A @ B) with comm/compute overlap (reference:
     gemm_rs, gemm_reduce_scatter.py:723).
 
     A: [M, K] sharded on cols (row-parallel activations); B: [K, N]
     sharded on rows (row-parallel weight). Returns C: [M, N] sharded on
     rows over `axis` — the TP MLP/attention epilogue.
+
+    straggler: optional (rank, ring_step, spin_iters) fault injection —
+    the designated rank stalls INSIDE the ring at that step (reference:
+    ag_gemm's straggler_option, allgather_gemm.py:660-661; stress tests
+    only).
     """
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
@@ -337,7 +360,8 @@ def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
             out_specs=P(axis, None),
             check_vma=False)
         def _fq(a_shard, b_shard, s_shard):
-            return _gemm_rs_call(a_shard, b_shard, ctx, s_shard)
+            return _gemm_rs_call(a_shard, b_shard, ctx, s_shard,
+                                 straggler)
 
         return _fq(a, bq, b.s.astype(jnp.float32).reshape(1, -1))
 
@@ -347,6 +371,7 @@ def gemm_rs(a, b, ctx: Optional[GEMMReduceScatterTensorParallelContext] = None,
         out_specs=P(axis, None),
         check_vma=False)
     def _f(a_shard, b_shard):
-        return _gemm_rs_call(a_shard, b_shard, ctx)
+        return _gemm_rs_call(a_shard, b_shard, ctx,
+                             straggler=straggler)
 
     return _f(a, bq)

