@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Regenerate every on-chip artifact in one command, the moment the chip
+# returns (VERDICT r4 next #2). Safe to re-run; each step is
+# independent and failures don't stop the rest.
+#
+#   bash tools/onchip_regen.sh
+#
+# Produces (repo root):
+#   PERF_OPS_tpu.json            per-op SOL report (git+date stamped)
+#   PROFILE_<kernel>.json/.trace.json   ablation profiles x4
+#   BENCH_local.json             bench line (driver writes BENCH_rNN)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== backend probe =="
+if ! timeout 120 python -c "import jax; assert jax.default_backend() == 'tpu', jax.default_backend()"; then
+    echo "no TPU backend reachable - aborting (artifacts unchanged)"
+    exit 1
+fi
+
+echo "== per-op SOL report =="
+timeout 3000 python -m triton_dist_tpu.tools.perf_report \
+    --json PERF_OPS_tpu.json || echo "perf_report FAILED"
+
+echo "== kernel ablation profiles =="
+timeout 3600 python -m triton_dist_tpu.tools.kprof_run --out . \
+    || echo "kprof_run FAILED"
+
+echo "== bench =="
+timeout 3600 python bench.py | tee BENCH_local.json || echo "bench FAILED"
+
+echo "== done; diff the artifacts and update README numbers =="

@@ -15,7 +15,11 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
 import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +91,22 @@ def _time(step, x0, *, k1=64, k2=1024, reps=3, slopes=3):
 # this size/ndev — e.g. any pure collective at ndev=1 — costs nothing
 # inside the loop); no real TPU kernel dispatches faster
 _ELIDED_US = 0.05
+
+
+def chain(op):
+    """Thread a serial data dependence WITHOUT changing the carry's
+    sharding: fold the op's output into a negligible scalar
+    perturbation of the input (f32 accumulation so the bf16 sum
+    cannot overflow to inf and poison the carry). Feeding the output
+    back directly would insert a cross-device reshard inside the
+    timed loop for ops whose output sharding differs from their
+    input's, inflating the measured per-op time. Shared with
+    tools/kprof_run.py so PROFILE and PERF_OPS rows measure through
+    the identical harness."""
+    def step(v):
+        eps = jnp.sum(op(v), dtype=jnp.float32) * 1e-30
+        return v + eps.astype(v.dtype)
+    return step
 
 
 def run_report(write_json=None):
@@ -172,19 +192,6 @@ def run_report(write_json=None):
     rs_ctx = create_gemm_rs_context(mesh)
     ar_ctx = create_gemm_ar_context(mesh)
 
-    def chain(op):
-        """Thread a serial data dependence WITHOUT changing the carry's
-        sharding: fold the op's output into a negligible scalar
-        perturbation of the input (f32 accumulation so the bf16 sum
-        cannot overflow to inf and poison the carry). Feeding the output
-        back directly would insert a cross-device reshard inside the
-        timed loop for ops whose output sharding differs from their
-        input's, inflating the measured per-op time."""
-        def step(v):
-            eps = jnp.sum(op(v), dtype=jnp.float32) * 1e-30
-            return v + eps.astype(v.dtype)
-        return step
-
     # GEMM SOL terms use PER-CHIP dims: ag_gemm computes [M, K]@[K, N/n]
     # per chip, gemm_rs/gemm_ar compute [M, K/n]@[K/n, N]
     add("ag_gemm",
@@ -210,6 +217,20 @@ def run_report(write_json=None):
     add("flash_decode",
         lambda u: flash_decode(u, k, v, jnp.int32(T)), q,
         kv_bytes / (spec.hbm_gbps * 1e9) * 1e6)
+
+    # paged decode: same KV bytes through the page-table walk (W
+    # streams per grid step); the row exists to keep the paged/contig
+    # gap measured (target: within 15%)
+    from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+    pg = 128 if on_tpu else 64
+    Xs, maxp = B * Hkv, T // pg
+    pk = k.reshape(Xs * maxp, pg, d)
+    pv = v.reshape(Xs * maxp, pg, d)
+    ptab = jnp.arange(Xs * maxp, dtype=jnp.int32).reshape(Xs, maxp)
+    add("flash_decode_paged",
+        lambda u: flash_decode_paged(u, pk, pv, ptab, jnp.int32(T)), q,
+        kv_bytes / (spec.hbm_gbps * 1e9) * 1e6,
+        note="same bytes as flash_decode; gap = page-walk overhead")
 
     # MoE ring kernels (resident-B path at these sizes)
     from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
@@ -285,6 +306,30 @@ def run_report(write_json=None):
         gemm_sol_us(Bu * Su // n, Nu, Du, itemsize=isz, spec=spec)
         + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n, spec=spec))
 
+    # PP: GPipe forward at pp=ndev. SOL = (M + n - 1) ticks x the
+    # per-stage GEMM bound (the schedule's ideal span; the gap above it
+    # is handoff + bank overhead). At ndev=1 the ring degenerates but
+    # the tick loop still runs — the row then measures pure schedule
+    # overhead per tick.
+    from triton_dist_tpu.layers.pp import PPipeline
+    Mp, Bp, Dp = 4 * max(n, 2), (64 if on_tpu else 8), (1024 if on_tpu
+                                                        else 64)
+    wp = jnp.asarray(rng.randn(n, Dp, Dp), dt) * (Dp ** -0.5)
+    bp = jnp.asarray(rng.randn(n, Dp), dt) * 0.1
+    pp_mesh = jax.make_mesh((n,), ("pp",))
+    pipe = PPipeline.init(
+        {"w": wp, "b": bp},
+        lambda p, xx: jnp.tanh(xx @ p["w"] + p["b"]),
+        mesh=pp_mesh, axis="pp")
+    xpp = jnp.asarray(rng.randn(Mp, Bp, Dp), dt) * 0.3
+    add("pp_gpipe_fwd",
+        lambda v: v + 1e-30 * jnp.sum(pipe(v),
+                                      dtype=jnp.float32).astype(v.dtype),
+        xpp,
+        (Mp + n - 1) * gemm_sol_us(Bp, Dp, Dp, itemsize=isz, spec=spec),
+        note=f"M={Mp} microbatches, {Mp + n - 1} ticks; SOL = ideal "
+             "schedule span")
+
     # GDN chunkwise forward, Pallas kernel (gdn_fwd default; roofline:
     # qkv/g/beta/o traffic vs the chunk matmul FLOPs)
     from triton_dist_tpu.kernels.gdn import gdn_fwd
@@ -332,8 +377,24 @@ def run_report(write_json=None):
             note="latency-bound at this size; SOL is the pure-FLOPs "
                  "bound (compare the two modes, not the fraction)")
 
+    # provenance stamp: a perf artifact must say WHICH code it measured
+    # (r4 verdict: stale rows were indistinguishable from current ones)
+    import datetime
+    import subprocess
+    try:
+        git = subprocess.run(
+            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        dirty = bool(subprocess.run(
+            ["git", "-C", _REPO, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10).stdout.strip())
+    except Exception:
+        git, dirty = "unknown", False
     header = {"backend": jax.default_backend(), "ndev": ndev,
-              "chip": spec.name, "interpreted": not on_tpu}
+              "chip": spec.name, "interpreted": not on_tpu,
+              "git": git + ("+dirty" if dirty else ""),
+              "date": datetime.datetime.now(
+                  datetime.timezone.utc).isoformat(timespec="seconds")}
     out = {"env": header, "ops": rows}
     if write_json:
         with open(write_json, "w") as f:
