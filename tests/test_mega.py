@@ -102,14 +102,125 @@ def test_mega_engine_backend_matches_flash():
     np.testing.assert_array_equal(toks_f, toks_m)
 
 
-def test_mega_engine_rejects_tp():
+def test_mega_engine_tp_decode_matches_dist():
+    """backend='mega' at TP=4 (r5): one megakernel per layer per chip
+    with in-kernel AR tasks — greedy tokens must match the per-op
+    'dist' backend on the same bf16 model (the reference's flagship
+    e2e, model_builder.py:86 TP=8 Qwen3)."""
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    mesh = jax.make_mesh((4,), ("tp",))
+    # local widths (D, I/n, Hq*hd/n) must be 128-multiples
+    cfg = tiny_qwen3(4, hidden_size=128, intermediate_size=512,
+                     num_heads=8, num_kv_heads=4, head_dim=64,
+                     dtype="bfloat16", max_position_embeddings=256)
+    model = AutoLLM.from_config(cfg, mesh)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(4, 8)).astype(np.int32)  # B % tp == 0
+    toks_d = np.asarray(
+        Engine(model, max_seq=64, backend="dist").serve(ids, 5))
+    toks_m = np.asarray(
+        Engine(model, max_seq=64, backend="mega").serve(ids, 5))
+    # the two backends are numerically different-but-correct (bf16
+    # dots, different reduction orders), so CHAINED greedy equality is
+    # not a sound invariant — one near-tie flips the rest of the row
+    # (the layer-level test above holds the tight numeric bound). The
+    # first two steps must agree exactly; the full sequences must agree
+    # on the overwhelming majority of positions.
+    np.testing.assert_array_equal(toks_d[:, :2], toks_m[:, :2])
+    agree = (toks_d == toks_m).mean()
+    assert agree >= 0.75, (agree, toks_d, toks_m)
+
+
+def test_mega_engine_rejects_indivisible_tp():
     from triton_dist_tpu.models import AutoLLM, Engine
     from triton_dist_tpu.models.config import tiny_qwen3
 
     n = len(jax.devices())
-    if n == 1:
+    if n < 2:
         pytest.skip("needs a multi-device mesh")
     mesh = jax.make_mesh((n,), ("tp",))
-    model = AutoLLM.from_config(tiny_qwen3(n), mesh)
-    with pytest.raises(ValueError, match="single-chip"):
+    # heads NOT divisible by the mesh: num_heads = n + 1
+    model = AutoLLM.from_config(
+        tiny_qwen3(n, num_heads=n + 1, num_kv_heads=n + 1), mesh)
+    with pytest.raises(ValueError, match="divisible"):
         Engine(model, backend="mega")
+
+
+def test_mega_decode_layer_tp_vs_oracle():
+    """TP megakernel (r5, the reference's FLAGSHIP composition —
+    model_builder.py:86 TP=8 Qwen3 with allreduce tasks inside the
+    kernel): the layer stays ONE kernel per chip with the two
+    cross-chip reductions (o-proj / down-proj partials) as in-kernel
+    one-shot AR sections. tp=4 over head/ffn shards vs the full-weight
+    oracle."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    n = 4
+    mesh4 = jax.make_mesh((n,), ("tp",))
+    B, D, Hq, Hkv, hd, F, T = 4, 256, 8, 4, 64, 512, 256
+    pos = 37
+    x, w, ck, cv = _mk_layer(B, D, Hq, Hkv, hd, F, T, seed=3)
+    inv = 1.0 / (1e6 ** (np.arange(0, hd, 2) / hd))
+    w = dict(w)
+    w["cos_row"] = jnp.asarray(np.cos(pos * inv)[None], jnp.float32)
+    w["sin_row"] = jnp.asarray(np.sin(pos * inv)[None], jnp.float32)
+
+    with jax.default_matmul_precision("highest"):
+        ry, rck, rcv = mega_decode_layer_ref(
+            x, pos, w, ck, cv, n_heads=Hq, n_kv_heads=Hkv, head_dim=hd)
+
+    # rearrange packed weights so a contiguous column split gives each
+    # rank its own [q_loc | k_loc | v_loc] / [gate_loc | up_loc] block
+    Hq_l, Hkv_l, F_l = Hq // n, Hkv // n, F // n
+    wq = np.asarray(w["w_qkv"])
+    qs, ks, vs = (wq[:, :Hq * hd], wq[:, Hq * hd:(Hq + Hkv) * hd],
+                  wq[:, (Hq + Hkv) * hd:])
+    blocks = []
+    for r in range(n):
+        blocks += [qs[:, r * Hq_l * hd:(r + 1) * Hq_l * hd],
+                   ks[:, r * Hkv_l * hd:(r + 1) * Hkv_l * hd],
+                   vs[:, r * Hkv_l * hd:(r + 1) * Hkv_l * hd]]
+    wq_tp = jnp.asarray(np.concatenate(blocks, 1))
+    wgu = np.asarray(w["w_gu"])
+    g_, u_ = wgu[:, :F], wgu[:, F:]
+    gu_blocks = []
+    for r in range(n):
+        gu_blocks += [g_[:, r * F_l:(r + 1) * F_l],
+                      u_[:, r * F_l:(r + 1) * F_l]]
+    wgu_tp = jnp.asarray(np.concatenate(gu_blocks, 1))
+    w_tp = dict(w, w_qkv=wq_tp, w_gu=wgu_tp)
+
+    layer = MegaDecodeLayer(d_model=D, n_heads=Hq_l, n_kv_heads=Hkv_l,
+                            head_dim=hd, ffn=F_l, T=T, tp=n,
+                            block_n=128)
+    rep2 = P(None, None)
+    w_specs = {"w_ln1": rep2, "w_qkv": P(None, "tp"), "q_norm": rep2,
+               "k_norm": rep2, "w_o": P("tp", None), "w_ln2": rep2,
+               "w_gu": P(None, "tp"), "w_d": P("tp", None),
+               "cos_row": rep2, "sin_row": rep2}
+    cspec = P("tp", None, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh4,
+        in_specs=(rep2, w_specs, cspec, cspec),
+        out_specs=(rep2, cspec, cspec), check_vma=False)
+    def run(x_, wd, ck_, cv_):
+        return layer(x_, jnp.int32(pos), wd, ck_, cv_)
+
+    with jax.default_matmul_precision("highest"):
+        y, ck2, cv2 = jax.jit(run)(x, w_tp, ck, cv)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               atol=0.05, rtol=0.05)
+    np.testing.assert_allclose(np.asarray(ck2, dtype=np.float32),
+                               np.asarray(rck, dtype=np.float32),
+                               atol=1e-2, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(cv2, dtype=np.float32),
+                               np.asarray(rcv, dtype=np.float32),
+                               atol=1e-2, rtol=1e-2)

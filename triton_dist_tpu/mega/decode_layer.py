@@ -16,15 +16,23 @@ for the entire layer (zero HBM round-trips between ops), weights stream
 through a single staging tile, and the per-op pipeline
 prologue/epilogue cost of nine kernels collapses into one.
 
-Decode-only (S=1), single chip (`models/engine.py` rejects
-mesh.size != 1 for backend="mega"). There is deliberately no TP
-composition: the one-kernel-per-layer structure would have to split at
-the two cross-chip reduction points (o-proj and down-proj partials need
-an all-reduce BEFORE their residual adds), i.e. two kernels + two AR
-epilogues per layer — exactly the per-op "flash"+"gemm_ar" path that
-already exists and that CEILING.md measures as faster than the
-megakernel even single-chip. Use backend="dist"/"gemm_ar" for TP
-decode.
+Decode-only (S=1). tp=1 runs the single-chip layer. tp>1 (r5) is the
+reference's FLAGSHIP composition — TP=8 Qwen3 decode inside the
+megakernel (`model_builder.py:86`, allreduce as an in-kernel task over
+nvshmem multimem): the layer stays ONE kernel per chip and the two
+cross-chip reduction points (o-proj and down-proj partials, which need
+an all-reduce BEFORE their residual adds) run as in-kernel one-shot
+AR sections — stage the partial to HBM, push it to every peer over
+ICI, wait the n arrivals, fold on the VPU, add the residual — the
+gemm_allreduce kernel's protocol inlined as tasks. Weights arrive as
+the LOCAL TP shards (heads / ffn columns sharded; construct the layer
+with local head/ffn counts) and activations stay replicated, exactly
+the per-op gemm_ar decode sharding. Perf stance unchanged
+(CEILING.md): the per-op scan remains the fast path on TPU; tp>1 mega
+exists for architecture parity with the reference's flagship,
+numerically close to the sharded oracle (bf16 dots + a deterministic
+f32 AR fold — chained greedy tokens can diverge from other backends at
+near-ties, which the tests treat as expected, not a regression).
 """
 
 from __future__ import annotations
@@ -38,8 +46,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu import language as dl
 from triton_dist_tpu.mega.builder import MegaKernelBuilder
-from triton_dist_tpu.runtime import interpret_mode, shmem_compiler_params
+from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+                                     shmem_compiler_params)
 
 
 def _pick_bn(total: int, want: int) -> int:
@@ -115,6 +125,13 @@ class MegaDecodeLayer:
     # (matching the other backends' `if q_norm is not None` gate)
     qk_norm: bool = dataclasses.field(default=True,
                                       metadata=dict(static=True))
+    # TP composition (see module docstring): tp > 1 adds the two
+    # in-kernel AR sections; geometry fields are then the LOCAL shards
+    # (n_heads = Hq/tp etc.) and the call must run inside shard_map
+    # over `axis`
+    tp: int = dataclasses.field(default=1, metadata=dict(static=True))
+    axis: str = dataclasses.field(default="tp",
+                                  metadata=dict(static=True))
 
     def __call__(self, x, pos, weights: Dict[str, jax.Array], cache_k,
                  cache_v):
@@ -141,6 +158,8 @@ class MegaDecodeLayer:
                                                   (Hkv, B, T, hd))
         assert T % bt == 0
 
+        ntp = self.tp
+        ax = self.axis
         b = MegaKernelBuilder()
         b.inputs("xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
                  "w_ln2", "w_gu", "w_d", "cos", "sin", "ck", "cv",
@@ -157,6 +176,44 @@ class MegaDecodeLayer:
         # the online-softmax update of tile t
         b.buffer("kt", (2, B, bt, hd), jnp.bfloat16)
         b.buffer("vt", (2, B, bt, hd), jnp.bfloat16)
+        if ntp > 1:
+            # in-kernel AR plumbing (module docstring): landing/staging
+            # HBM buffers are kernel outputs, fold tile in VMEM
+            b.inputs("land1", "stage1", "land2", "stage2",
+                     "recv1", "recv2")
+            b.buffer("fold", (B, D), jnp.float32)
+            b.buffer("ores_p", (B, D), jnp.float32)
+            b.buffer("y_p", (B, D), jnp.float32)
+
+            b.add_task("tp_barrier", lambda env: dl.barrier_all(ax),
+                       reads=(), writes=())
+
+        def ar_section(env, src, stage, land, recv, dst, add):
+            """One-shot in-kernel all-reduce of a [B, D] partial (the
+            gemm_allreduce protocol as a mega task; reference: the
+            megakernel's allreduce task over nvshmem multimem):
+            stage -> n pushes -> n arrival waits -> VPU fold + residual.
+            """
+            me = dl.my_pe(ax)
+            sem = env["copy_sem"]
+            cp = pltpu.make_async_copy(env[src], env[stage], sem)
+            cp.start()
+            cp.wait()
+            for p in range(ntp):
+                dl.putmem_nbi(env[land].at[me], env[stage], sem,
+                              env[recv], jnp.int32(p), ax)
+            for _ in range(ntp):
+                pltpu.make_async_copy(env[stage], env[stage],
+                                      env[recv]).wait()
+            dl.quiet(sem, env[stage], ntp)
+            acc = env[add][...]
+            for i in range(ntp):
+                cpf = pltpu.make_async_copy(env[land].at[i], env["fold"],
+                                            sem)
+                cpf.start()
+                cpf.wait()
+                acc = acc + env["fold"][...]
+            env[dst][...] = acc
 
         b.add_task("ln1", functools.partial(_rmsnorm, dst="xn", src="xv",
                                             w_name="w_ln1", eps=eps),
@@ -290,11 +347,29 @@ class MegaDecodeLayer:
 
         b.add_task("flash", flash, reads=("qkv", "ck", "cv"),
                    writes=("attn",))
-        b.add_task("o_proj",
-                   functools.partial(_mm_tiles, dst="ores", src="attn",
-                                     w="w_o", rows=Hq * hd, cols=D,
-                                     bn=bn, wt_name="wt", add="xv"),
-                   reads=("attn", "w_o", "xv"), writes=("ores", "wt"))
+        if ntp > 1:
+            # partial o-proj (no residual: the AR must see the bare
+            # partial), then the in-kernel AR adds the residual
+            b.add_task("o_proj",
+                       functools.partial(_mm_tiles, dst="ores_p",
+                                         src="attn", w="w_o",
+                                         rows=Hq * hd, cols=D, bn=bn,
+                                         wt_name="wt"),
+                       reads=("attn", "w_o"), writes=("ores_p", "wt"))
+            b.add_task("o_allreduce",
+                       functools.partial(ar_section, src="ores_p",
+                                         stage="stage1", land="land1",
+                                         recv="recv1", dst="ores",
+                                         add="xv"),
+                       reads=("ores_p", "xv"), writes=("ores", "fold"))
+        else:
+            b.add_task("o_proj",
+                       functools.partial(_mm_tiles, dst="ores",
+                                         src="attn", w="w_o",
+                                         rows=Hq * hd, cols=D, bn=bn,
+                                         wt_name="wt", add="xv"),
+                       reads=("attn", "w_o", "xv"),
+                       writes=("ores", "wt"))
         b.add_task("ln2", functools.partial(_rmsnorm, dst="on",
                                             src="ores", w_name="w_ln2",
                                             eps=eps),
@@ -329,29 +404,42 @@ class MegaDecodeLayer:
 
         b.add_task("gate_up_swiglu", gate_up, reads=("on", "w_gu"),
                    writes=("h", "wt"))
-        b.add_task("down_proj",
-                   functools.partial(_mm_tiles, dst="y", src="h",
-                                     w="w_d", rows=F, cols=D, bn=bn,
-                                     wt_name="wt", add="ores"),
-                   reads=("h", "w_d", "ores"), writes=("y", "wt"))
+        if ntp > 1:
+            b.add_task("down_proj",
+                       functools.partial(_mm_tiles, dst="y_p", src="h",
+                                         w="w_d", rows=F, cols=D, bn=bn,
+                                         wt_name="wt"),
+                       reads=("h", "w_d"), writes=("y_p", "wt"))
+            b.add_task("d_allreduce",
+                       functools.partial(ar_section, src="y_p",
+                                         stage="stage2", land="land2",
+                                         recv="recv2", dst="y",
+                                         add="ores"),
+                       reads=("y_p", "ores"), writes=("y", "fold"))
+        else:
+            b.add_task("down_proj",
+                       functools.partial(_mm_tiles, dst="y", src="h",
+                                         w="w_d", rows=F, cols=D, bn=bn,
+                                         wt_name="wt", add="ores"),
+                       reads=("h", "w_d", "ores"), writes=("y", "wt"))
 
-        def kernel(pos_ref, x_ref, w_ln1, w_qkv, q_norm, k_norm, w_o,
-                   w_ln2, w_gu, w_d, cos_ref, sin_ref, ck, cv,
-                   y_ref, ck_out, cv_out,
-                   xn, qkvb, attn, ores, on, h, wt, kvst, kt, vt,
-                   copy_sem, copy_sems):
-            env = {
-                "pos": pos_ref[0], "xv": x_ref, "w_ln1": w_ln1,
-                "w_qkv": w_qkv, "q_norm": q_norm, "k_norm": k_norm,
-                "w_o": w_o, "w_ln2": w_ln2, "w_gu": w_gu, "w_d": w_d,
-                "cos": cos_ref, "sin": sin_ref, "ck": ck_out,
-                "cv": cv_out, "y": y_ref, "xn": xn, "qkv": qkvb,
-                "attn": attn, "ores": ores, "on": on, "h": h, "wt": wt,
-                "kvst": kvst, "kt": kt, "vt": vt, "copy_sem": copy_sem,
-                "copy_sems": copy_sems,
-            }
-            del ck, cv   # aliased to ck_out/cv_out
-            b.emit_all(env)
+        in_names = ["xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
+                    "w_ln2", "w_gu", "w_d", "cos", "sin",
+                    "ck_in", "cv_in"]
+        out_names = ["y", "ck", "cv"]
+        if ntp > 1:
+            out_names += ["land1", "stage1", "land2", "stage2"]
+        buf_names = list(b.buffers)
+        sem_names = ["copy_sem", "copy_sems"]
+        if ntp > 1:
+            sem_names += ["recv1", "recv2"]
+
+        def kernel(pos_ref, *refs):
+            env = {"pos": pos_ref[0]}
+            for i, nm in enumerate(in_names + out_names + buf_names
+                                   + sem_names):
+                env[nm] = refs[i]
+            b.emit_all(env)   # ck/cv resolve to the ALIASED outputs
 
         vm = pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM)
         anym = pl.BlockSpec(memory_space=pl.ANY)
@@ -359,27 +447,36 @@ class MegaDecodeLayer:
                    for (shape, dt) in b.buffers.values()]
         scratch.append(pltpu.SemaphoreType.DMA(()))
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
-        y, ck2, cv2 = pl.pallas_call(
+        out_shape = [jax.ShapeDtypeStruct((B, D), jnp.float32),
+                     jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),
+                     jax.ShapeDtypeStruct(cache_v.shape, cache_v.dtype)]
+        out_specs = [vm, anym, anym]
+        if ntp > 1:
+            scratch.append(pltpu.SemaphoreType.DMA(()))
+            scratch.append(pltpu.SemaphoreType.DMA(()))
+            for _ in range(2):   # (land, stage) x 2 AR sections
+                out_shape += [
+                    jax.ShapeDtypeStruct((ntp, B, D), jnp.float32),
+                    jax.ShapeDtypeStruct((B, D), jnp.float32)]
+                out_specs += [anym, anym]
+        res = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(1,),
                 in_specs=[vm, vm, anym, vm, vm, anym, vm, anym, anym,
                           vm, vm, anym, anym],
-                out_specs=(vm, anym, anym),
+                out_specs=tuple(out_specs),
                 scratch_shapes=scratch,
             ),
-            out_shape=(jax.ShapeDtypeStruct((B, D), jnp.float32),
-                       jax.ShapeDtypeStruct(cache_k.shape,
-                                            cache_k.dtype),
-                       jax.ShapeDtypeStruct(cache_v.shape,
-                                            cache_v.dtype)),
+            out_shape=tuple(out_shape),
             input_output_aliases={12: 1, 13: 2},
             # the megakernel deliberately holds a whole layer's
             # activations + staging tiles in VMEM; lift the default 16MB
             # scoped-vmem ceiling (v5e has 128MB physical VMEM)
             compiler_params=shmem_compiler_params(
-                None, vmem_limit_bytes=100 << 20),
+                next_collective_id() if ntp > 1 else None, n=ntp,
+                vmem_limit_bytes=100 << 20),
             interpret=interpret_mode(),
         )(jnp.asarray(pos, jnp.int32)[None],
           x.astype(jnp.float32),
@@ -390,7 +487,7 @@ class MegaDecodeLayer:
           weights["w_d"].astype(jnp.bfloat16),
           weights["cos_row"], weights["sin_row"],
           cache_k, cache_v)
-        return y, ck2, cv2
+        return res[0], res[1], res[2]
 
 
 def mega_decode_layer_ref(x, pos, weights, cache_k, cache_v, *,

@@ -87,11 +87,15 @@ class Engine:
                 raise ValueError(
                     "backend='mega' reads the KV cache directly and has "
                     "no dequant path; use the default bf16 cache")
-            if model.mesh.size != 1:
+            n_mega = model.mesh.shape[model.mesh.axis_names[0]]
+            if n_mega > 1 and (
+                    model.config.num_heads % n_mega
+                    or model.config.num_kv_heads % n_mega
+                    or model.config.intermediate_size % n_mega):
                 raise ValueError(
-                    "backend='mega' is the single-chip megakernel decode "
-                    "path (mega/decode_layer.py); use 'dist'/'gemm_ar' "
-                    "for TP decode")
+                    "backend='mega' TP needs heads/kv-heads/ffn "
+                    "divisible by the mesh size (single-chip decode "
+                    "has no such constraint)")
             if not all(hasattr(l, "mlp") for l in model.layers):
                 raise ValueError(
                     "backend='mega' supports dense (attention + MLP) "
@@ -202,12 +206,13 @@ def _sampled_scan_decode_fn(backend, sampling, params, model, logits0,
     return toks.T, logits, cache                     # [B, gen_len]
 
 
-def _pick_mega_bn(cfg) -> int:
-    """Largest 128-multiple weight tile dividing the projection widths
-    the megakernel asserts on (D, ffn, Hq*hd); the qkv matmul down-tiles
-    its own width independently (decode_layer.py _pick_bn)."""
-    widths = (cfg.hidden_size, cfg.intermediate_size,
-              cfg.num_heads * cfg.head_dim)
+def _pick_mega_bn(cfg, n: int = 1) -> int:
+    """Largest 128-multiple weight tile dividing the LOCAL projection
+    widths the megakernel asserts on (D, ffn/n, Hq*hd/n); the qkv
+    matmul down-tiles its own width independently (decode_layer.py
+    _pick_bn)."""
+    widths = (cfg.hidden_size, cfg.intermediate_size // n,
+              cfg.num_heads * cfg.head_dim // n)
     for bn in (512, 384, 256, 128):
         if all(w % bn == 0 for w in widths):
             return bn
@@ -229,12 +234,21 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
     cfg = model.config
     hd = cfg.head_dim
     T = cache.k[0].shape[2]
+    # TP (n > 1): the layer runs on LOCAL head/ffn shards with the two
+    # cross-chip reductions as in-kernel AR tasks (decode_layer.py
+    # module docstring — the reference's flagship TP megakernel). The
+    # model's packed weights are already per-rank-block layouts
+    # ([q_r|k_r|v_r], [gate_r|up_r]), so a contiguous column split IS
+    # the right shard.
+    ax_mega = model.mesh.axis_names[0]
+    n_mega = model.mesh.shape[ax_mega]
     mega = MegaDecodeLayer(
-        d_model=cfg.hidden_size, n_heads=cfg.num_heads,
-        n_kv_heads=cfg.num_kv_heads, head_dim=hd,
-        ffn=cfg.intermediate_size, T=T, eps=cfg.rms_norm_eps,
-        block_n=_pick_mega_bn(cfg),
-        qk_norm=model.layers[0].attn.q_norm is not None)
+        d_model=cfg.hidden_size, n_heads=cfg.num_heads // n_mega,
+        n_kv_heads=cfg.num_kv_heads // n_mega, head_dim=hd,
+        ffn=cfg.intermediate_size // n_mega, T=T, eps=cfg.rms_norm_eps,
+        block_n=_pick_mega_bn(cfg, n_mega),
+        qk_norm=model.layers[0].attn.q_norm is not None,
+        tp=n_mega, axis=ax_mega)
     ones = jnp.ones((1, hd), jnp.float32)
     bf = jnp.bfloat16
     weights = []
@@ -262,18 +276,35 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
             return jax.sharding.reshard(a, NamedSharding(model.mesh, _P()))
         return a
 
-    ks = tuple(_replicate(jnp.transpose(k, (1, 0, 2, 3))) for k in cache.k)
-    vs = tuple(_replicate(jnp.transpose(v, (1, 0, 2, 3))) for v in cache.v)
+    ks = tuple(jnp.transpose(k, (1, 0, 2, 3)) for k in cache.k)
+    vs = tuple(jnp.transpose(v, (1, 0, 2, 3)) for v in cache.v)
+    if n_mega == 1:
+        ks = tuple(_replicate(k) for k in ks)
+        vs = tuple(_replicate(v) for v in vs)
 
     # pallas_call needs Manual mesh axes: run each layer's megakernel
-    # under a fully-replicated shard_map over the (size-1) mesh, with
-    # every array an ARGUMENT (closures over sharded arrays are
-    # rejected in explicit-sharding mode)
+    # under a shard_map, with every array an ARGUMENT (closures over
+    # sharded arrays are rejected in explicit-sharding mode). tp=1:
+    # fully replicated; tp>1: head/ffn-sharded weights + head-sharded
+    # cache, replicated activations (the TP mega layout).
     from jax.sharding import PartitionSpec as P
+    if n_mega > 1:
+        ax = ax_mega
+        rep2 = P(None, None)
+        cspec = P(ax, None, None, None)
+        wspec = {"w_ln1": rep2, "w_qkv": P(None, ax), "q_norm": rep2,
+                 "k_norm": rep2, "w_o": P(ax, None), "w_ln2": rep2,
+                 "w_gu": P(None, ax), "w_d": P(ax, None),
+                 "cos_row": rep2, "sin_row": rep2}
+        in_specs = (rep2, P(), wspec, cspec, cspec)
+        out_specs = (rep2, cspec, cspec)
+    else:
+        in_specs = (P(), P(), P(), P(), P())
+        out_specs = (P(), P(), P())
     mega_call = jax.shard_map(
         lambda x, pos, wd, ck, cv: mega(x, pos, wd, ck, cv),
-        mesh=model.mesh, in_specs=(P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()), check_vma=False)
+        mesh=model.mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)
 
     def step(carry, _):
         tok, pos, ks, vs = carry
