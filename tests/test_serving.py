@@ -1,0 +1,47 @@
+"""In-process serving-layer tests (the socket pair is exercised as a
+real two-process flow by test_examples.py::test_socket_serving_two_
+process; these cover the decode-streaming invariants directly)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models import AutoLLM, Engine
+from triton_dist_tpu.models.config import tiny_qwen3
+from triton_dist_tpu.serving import ByteTokenizer, decode_stream
+
+mesh = None
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+def test_decode_stream_greedy_token_exact():
+    """Chunked greedy streaming must equal the single-scan decode bit
+    for bit (the argmax chain is identical — the invariant the
+    TokenServer's incremental protocol rests on), including a chunk
+    size that does NOT divide gen_len (remainder scan)."""
+    n = mesh.shape["tp"]
+    cfg = tiny_qwen3(n)
+    model = AutoLLM.from_config(cfg, mesh)
+    eng = Engine(model, max_seq=48, backend="dist")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(max(n, 2), 6)).astype(
+        np.int32)
+    gen = 10
+    logits, cache = eng.prefill(ids)
+    want = np.asarray(eng.decode(logits, cache, gen))
+    logits, cache = eng.prefill(ids)
+    chunks = list(decode_stream(eng, logits, cache, gen, chunk=4))
+    assert [c.shape[1] for c in chunks] == [4, 4, 2]
+    got = np.concatenate(chunks, axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(256)
+    s = "hello tpu"
+    assert tok.decode(tok.encode(s)) == s

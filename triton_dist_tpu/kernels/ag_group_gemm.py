@@ -222,12 +222,14 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
         collective_id = next_collective_id()
     isz = jnp.dtype(x_e.dtype).itemsize
     wsz = jnp.dtype(w.dtype).itemsize
+    from triton_dist_tpu.tools.tune import contextual_choice
+    prof = contextual_choice("ag_group_gemm") or {}
+    if resident_b is None and "resident_b" in prof:
+        resident_b = prof["resident_b"]
+    if wb_depth is None and "wb_depth" in prof:
+        wb_depth = prof["wb_depth"]       # chip-tuned staging depth
     if block_n is None:
-        from triton_dist_tpu.tools.tune import contextual_choice
-        prof = contextual_choice("ag_group_gemm") or {}
         block_n = prof.get("block_n", 0)
-        if resident_b is None and "resident_b" in prof:
-            resident_b = prof["resident_b"]
         if not block_n:
             # largest tile whose double-buffered scratch (a, b, o) fits
             # a 10MB budget: bigger tiles = contiguous B panel DMAs and

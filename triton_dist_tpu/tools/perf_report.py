@@ -53,7 +53,7 @@ def _repeat(step, x0, k):
     return functools.partial(prog, x0)
 
 
-def _time(step, x0, *, k1=64, k2=1024, reps=3, slopes=3):
+def _time(step, x0, *, k1=None, k2=None, reps=3, slopes=3):
     """Two-point amortized timing: per-op time is the slope between a
     k1-iteration and a k2-iteration loop program, cancelling the
     (large, on tunneled backends) constant dispatch/readback overhead.
@@ -62,7 +62,13 @@ def _time(step, x0, *, k1=64, k2=1024, reps=3, slopes=3):
     The tunneled chip shows +-30% run-to-run noise (shared host, clock
     drift), so take the MIN over `slopes` interleaved slope estimates —
     the best pair is the least-contended measurement of the same
-    program."""
+    program. Off-chip (the interpreter smoke, where per-iteration cost
+    is ~1000x and the numbers only guard against breakage) the loop
+    counts shrink so the full report stays runnable."""
+    if k1 is None or k2 is None:
+        on_tpu = jax.default_backend() == "tpu"
+        k1 = k1 if k1 is not None else (64 if on_tpu else 4)
+        k2 = k2 if k2 is not None else (1024 if on_tpu else 36)
     f1, f2 = _repeat(step, x0, k1), _repeat(step, x0, k2)
     # float() forces a host readback: block_until_ready does not
     # reliably block on tunneled backends (same workaround as bench.py)
