@@ -67,8 +67,8 @@ def _time(step, x0, *, k1=None, k2=None, reps=3, slopes=3):
     counts shrink so the full report stays runnable."""
     if k1 is None or k2 is None:
         on_tpu = jax.default_backend() == "tpu"
-        k1 = k1 if k1 is not None else (64 if on_tpu else 4)
-        k2 = k2 if k2 is not None else (1024 if on_tpu else 36)
+        k1 = k1 if k1 is not None else (64 if on_tpu else 2)
+        k2 = k2 if k2 is not None else (1024 if on_tpu else 10)
     f1, f2 = _repeat(step, x0, k1), _repeat(step, x0, k2)
     # float() forces a host readback: block_until_ready does not
     # reliably block on tunneled backends (same workaround as bench.py)
@@ -164,10 +164,11 @@ def run_report(write_json=None):
                      "note": note})
         print(sol_report(name, t, sol_us) + (f"  [{note}]" if note else ""))
 
-    # Each step threads its output back into its input (same shape;
-    # XLA inserts a free reshard where the sharding differs) so the
-    # fori_loop chain is serial. The feed itself costs bandwidth for
-    # the AR/RS partials rebuild — noted per row.
+    # AG rows feed their output back directly (the carry's reshard is
+    # free); AR/RS rows use chain()'s scalar-perturbation feed — their
+    # output sharding differs from the carry's on a DIFFERENT dim, and
+    # a broadcast feed would produce an illegally double-sharded add at
+    # ndev > 1.
     # collective_sol_us expects FULL-tensor bytes (its (n-1)/n factor is
     # the per-device share of the total payload)
     full_bytes = n * M * N * isz
@@ -178,20 +179,20 @@ def run_report(write_json=None):
     add("all_gather(ring)",
         lambda v: all_gather(v, mesh=mesh, method=AllGatherMethod.RING),
         xs, collective_sol_us("ag", full_bytes, n, spec=spec))
+    # scalar-chained feed (chain()): the broadcast feed `v*0 + out[None]`
+    # produces an illegally double-sharded add at ndev > 1 (the carry is
+    # partial-sharded on dim 0, the output on dim 1)
     add("all_reduce(one_shot)",
-        lambda v: v * 0 + all_reduce(v, mesh=mesh,
-                                     method=AllReduceMethod.ONE_SHOT)[None],
-        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec),
-        note="includes partials rebuild")
+        chain(lambda v: all_reduce(v, mesh=mesh,
+                                   method=AllReduceMethod.ONE_SHOT)),
+        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec))
     add("all_reduce(two_shot)",
-        lambda v: v * 0 + all_reduce(v, mesh=mesh,
-                                     method=AllReduceMethod.TWO_SHOT)[None],
-        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec),
-        note="includes partials rebuild")
+        chain(lambda v: all_reduce(v, mesh=mesh,
+                                   method=AllReduceMethod.TWO_SHOT)),
+        xp, collective_sol_us("ar", n * M * N * isz, n, spec=spec))
     add("reduce_scatter",
-        lambda v: v * 0 + reduce_scatter(v, mesh=mesh)[None],
-        xp, collective_sol_us("rs", n * M * N * isz, n, spec=spec),
-        note="includes partials rebuild")
+        chain(lambda v: reduce_scatter(v, mesh=mesh)),
+        xp, collective_sol_us("rs", n * M * N * isz, n, spec=spec))
     a_rows = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
     b_cols = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
     ag_ctx = create_ag_gemm_context(mesh)
