@@ -52,15 +52,23 @@ def _a2a_kernel(n: int, axis: str, x_ref, o_ref, send_sem, recv_sem):
 
 def _a2a_pallas(x_local, *, n: int, axis: str, collective_id: int):
     rows, cols = x_local.shape
-    # Mosaic requires sliced DMAs 128-aligned in the minor dim; pad the
-    # lane dim so chunk slices stay legal on hardware.
+    # Mosaic alignment for the kernel's per-destination slices: lane
+    # dim to 128-multiples, and each row CHUNK (rows/n) to the dtype's
+    # sublane tile (8 f32 / 16 bf16 / 32 int8) — the interpreter
+    # accepts unaligned slices that real-chip Mosaic rejects. Pads are
+    # zeros and stripped after the exchange.
     colsp = -(-cols // 128) * 128
-    if colsp != cols:
-        x_local = jnp.pad(x_local, ((0, 0), (0, colsp - cols)))
+    sub = {1: 32, 2: 16}.get(jnp.dtype(x_local.dtype).itemsize, 8)
+    C = rows // n
+    Cp = -(-C // sub) * sub
+    if colsp != cols or Cp != C:
+        xw = x_local.reshape(n, C, cols)
+        xw = jnp.pad(xw, ((0, 0), (0, Cp - C), (0, colsp - cols)))
+        x_local = xw.reshape(n * Cp, colsp)
     kernel = functools.partial(_a2a_kernel, n, axis)
     y = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, colsp), x_local.dtype),
+        out_shape=jax.ShapeDtypeStruct((n * Cp, colsp), x_local.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA(()),
@@ -68,7 +76,9 @@ def _a2a_pallas(x_local, *, n: int, axis: str, collective_id: int):
         compiler_params=shmem_compiler_params(collective_id, n=n),
         interpret=interpret_mode(),
     )(x_local)
-    return y[:, :cols] if colsp != cols else y
+    if colsp != cols or Cp != C:
+        y = y.reshape(n, Cp, colsp)[:, :C, :cols].reshape(rows, cols)
+    return y
 
 
 def low_latency_all_to_all(x, *, mesh: Mesh, axis: str = "ep",
@@ -103,7 +113,7 @@ def low_latency_all_to_all(x, *, mesh: Mesh, axis: str = "ep",
         # its row's payload (the reference LL protocol packs the fp8
         # scale into the same message for the same reason) — the shared
         # wire format of kernels/ep_a2a.py, also used by the EP layers'
-        # payload_int8 mode
+        # payload_int8 mode. _a2a_pallas handles the lane/sublane pads.
         from triton_dist_tpu.kernels.ep_a2a import (pack_rows_int8,
                                                     unpack_rows_int8)
         packed = pack_rows_int8(x_loc.reshape(n2 * C, D))
