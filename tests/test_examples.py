@@ -34,6 +34,10 @@ def test_serving_example_runs():
     _run_example("07_serving.py")
 
 
+def test_continuous_batching_example_runs():
+    _run_example("09_continuous_batching.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

@@ -123,6 +123,56 @@ def test_flash_decode_int8_kv_vs_dequant_oracle():
                                atol=2e-3, rtol=2e-3)
 
 
+def test_flash_decode_per_stream_kv_lens():
+    """Per-slot lengths (continuous batching): one launch with kv_lens
+    [B] must equal (a) the jnp oracle with vector lengths and (b) — row
+    by row, BITWISE — a uniform launch at that row's length: tiles past
+    a short slot's length are masked to an exact no-op of its
+    accumulator, so mixed-length batches cost nothing in accuracy."""
+    B, Hq, Hkv, d, T = 4, 4, 2, 128, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.5
+    k = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    lens = np.asarray([17, 33, 1, 64], np.int32)
+    out = jax.jit(lambda q, k, v, l: flash_decode(
+        q, k, v, jnp.max(l), kv_lens=l, block_t=16))(
+            q, k, v, jnp.asarray(lens))
+    ref = attention_cached_ref(q, k, v, jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    f_uni = jax.jit(lambda q, k, v, n: flash_decode(q, k, v, n,
+                                                    block_t=16))
+    for b in range(B):
+        uni = f_uni(q, k, v, jnp.int32(int(lens[b])))
+        assert np.array_equal(np.asarray(out[b]), np.asarray(uni[b])), b
+
+
+def test_flash_decode_per_stream_int8():
+    """kv_lens composes with the int8 KV cache (the slot scheduler's
+    bandwidth configuration): per-stream masks and in-kernel dequant."""
+    B, Hq, Hkv, d, T = 2, 4, 2, 128, 64
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.3
+    kf = rng.randn(B, Hkv, T, d) * 0.5
+    vf = rng.randn(B, Hkv, T, d) * 0.5
+    ks = np.abs(kf).max(-1) / 127.0 + 1e-9
+    vs = np.abs(vf).max(-1) / 127.0 + 1e-9
+    k8 = jnp.asarray(np.round(kf / ks[..., None]), jnp.int8)
+    v8 = jnp.asarray(np.round(vf / vs[..., None]), jnp.int8)
+    lens = jnp.asarray([13, 52], jnp.int32)
+    out = jax.jit(lambda *a: flash_decode(
+        a[0], a[1], a[2], jnp.max(a[5]), k_scale=a[3], v_scale=a[4],
+        kv_lens=a[5], block_t=16))(
+            q, k8, v8, jnp.asarray(ks, jnp.float32),
+            jnp.asarray(vs, jnp.float32), lens)
+    ref = attention_cached_ref(
+        q, jnp.asarray(k8, jnp.float32) * ks[..., None],
+        jnp.asarray(v8, jnp.float32) * vs[..., None], lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
 def test_kv_update_inplace():
     """Aliased tile-aligned cache insert == dynamic_update_slice."""
     from triton_dist_tpu.kernels.flash_attn import kv_update

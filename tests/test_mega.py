@@ -102,6 +102,9 @@ def test_mega_engine_backend_matches_flash():
     np.testing.assert_array_equal(toks_f, toks_m)
 
 
+# tier-1 budget: the tp=4 megakernel e2e cases are among the suite's
+# heaviest (ISSUE 1 satellite)
+@pytest.mark.slow
 def test_mega_engine_tp_decode_matches_dist():
     """backend='mega' at TP=4 (r5): one megakernel per layer per chip
     with in-kernel AR tasks — greedy tokens must match the per-op
@@ -150,6 +153,7 @@ def test_mega_engine_rejects_indivisible_tp():
         Engine(model, backend="mega")
 
 
+@pytest.mark.slow
 def test_mega_decode_layer_tp_vs_oracle():
     """TP megakernel (r5, the reference's FLAGSHIP composition —
     model_builder.py:86 TP=8 Qwen3 with allreduce tasks inside the

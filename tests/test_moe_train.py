@@ -12,6 +12,9 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# tier-1 budget: MoE training differentials over both compositions (ISSUE 1 satellite; pytest.ini registers the marker)
+pytestmark = pytest.mark.slow
+
 from triton_dist_tpu.layers.tp_moe import TP_MoE
 
 mesh = None

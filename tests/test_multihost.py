@@ -19,6 +19,9 @@ import textwrap
 
 import pytest
 
+
+# tier-1 budget: spawns real OS processes joining a coordination service (ISSUE 1 satellite; pytest.ini registers the marker)
+pytestmark = pytest.mark.slow
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD = textwrap.dedent("""

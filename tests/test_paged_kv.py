@@ -1,12 +1,14 @@
 """Paged KV cache + paged flash decode vs the contiguous oracle
-(reference analog: mega_triton_kernel paged_kv_cache.py tests)."""
+(reference analog: mega_triton_kernel paged_kv_cache.py tests), and
+the continuous-batching slot paths: free-list page allocation, per-slot
+writes/appends, per-slot attention lengths."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
-from triton_dist_tpu.kernels.paged_kv import (PagedKVCache,
+from triton_dist_tpu.kernels.paged_kv import (PageAllocator, PagedKVCache,
                                               flash_decode_paged)
 
 
@@ -93,3 +95,113 @@ def test_paged_decode_stream_batch_widths():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4,
                                    err_msg=f"B={B} Hkv={Hkv}")
+
+
+def _fill_contiguous(lens, ks, vs, Hkv, T, d):
+    B = len(lens)
+    kc = np.zeros((B, Hkv, T, d), np.float32)
+    vc = np.zeros((B, Hkv, T, d), np.float32)
+    for b, L in enumerate(lens):
+        kc[b, :, :L] = ks[b]
+        vc[b, :, :L] = vs[b]
+    return jnp.asarray(kc), jnp.asarray(vc)
+
+
+def test_paged_slots_mixed_lengths_share_pool():
+    """Continuous-batching slot contract: slots of very different
+    lengths draw pages from ONE free-list pool (PageAllocator), write
+    their prompts through their own table rows (write_slot), append
+    decode rows at per-slot positions (append_slots), and attend with
+    per-slot lengths (kv_lens) — all matching the contiguous oracle."""
+    B, Hq, Hkv, d, page, T = 3, 4, 2, 128, 16, 64
+    rng = np.random.RandomState(0)
+    cache = PagedKVCache.create(B, Hkv, T, d, page=page,
+                                dtype=jnp.float32)
+    alloc = PageAllocator(cache.pages_k.shape[0])
+    lens = [37, 9, 50]
+    for b, L in enumerate(lens):
+        cache = cache.set_slot_table(
+            b, alloc.alloc_slot(Hkv, L + 1, page))
+    ks = [rng.randn(Hkv, L, d).astype(np.float32) * 0.5 for L in lens]
+    vs = [rng.randn(Hkv, L, d).astype(np.float32) * 0.5 for L in lens]
+    for b in range(B):
+        cache = cache.write_slot(b, jnp.asarray(ks[b]),
+                                 jnp.asarray(vs[b]))
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.5
+    kvl = jnp.asarray(lens, jnp.int32)
+    out = jax.jit(lambda q, l: flash_decode_paged(
+        q, cache.pages_k, cache.pages_v, cache.table, jnp.max(l),
+        kv_lens=l))(q, kvl)
+    kc, vc = _fill_contiguous(lens, ks, vs, Hkv, T, d)
+    ref = attention_cached_ref(q, kc, vc, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    # one decode append per slot, each at its own position
+    kn = rng.randn(B, Hkv, 1, d).astype(np.float32) * 0.5
+    vn = rng.randn(B, Hkv, 1, d).astype(np.float32) * 0.5
+    cache = cache.append_slots(jnp.asarray(kn), jnp.asarray(vn), kvl)
+    kc2 = np.asarray(kc).copy()
+    vc2 = np.asarray(vc).copy()
+    for b, L in enumerate(lens):
+        kc2[b, :, L] = kn[b, :, 0]
+        vc2[b, :, L] = vn[b, :, 0]
+    out2 = jax.jit(lambda q, l: flash_decode_paged(
+        q, cache.pages_k, cache.pages_v, cache.table, jnp.max(l),
+        kv_lens=l))(q, kvl + 1)
+    ref2 = attention_cached_ref(q, jnp.asarray(kc2), jnp.asarray(vc2),
+                                kvl + 1)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_paged_retire_returns_pages_to_free_list():
+    """Retiring a slot frees its pages; the next admission REUSES them
+    (physically) while live slots' data stays intact — the allocator
+    half of the continuous-batching story."""
+    B, Hq, Hkv, d, page, T = 2, 2, 1, 128, 8, 48
+    rng = np.random.RandomState(1)
+    cache = PagedKVCache.create(B, Hkv, T, d, page=page,
+                                dtype=jnp.float32)
+    alloc = PageAllocator(cache.pages_k.shape[0])
+    # slot 0: long-lived; slot 1: short request that retires
+    blk0 = alloc.alloc_slot(Hkv, 33, page)
+    blk1 = alloc.alloc_slot(Hkv, 10, page)
+    cache = cache.set_slot_table(0, blk0).set_slot_table(1, blk1)
+    k0 = rng.randn(Hkv, 30, d).astype(np.float32) * 0.5
+    v0 = rng.randn(Hkv, 30, d).astype(np.float32) * 0.5
+    cache = cache.write_slot(0, jnp.asarray(k0), jnp.asarray(v0))
+    cache = cache.write_slot(
+        1, jnp.asarray(rng.randn(Hkv, 9, d), jnp.float32),
+        jnp.asarray(rng.randn(Hkv, 9, d), jnp.float32))
+    # retire slot 1 -> its pages go back; a bigger request reuses them
+    freed = blk1.ravel().tolist()
+    alloc.free(freed)
+    blk2 = alloc.alloc_slot(Hkv, 25, page)
+    assert set(blk2.ravel()) & set(freed), \
+        "readmission must draw from the freed pages"
+    cache = cache.set_slot_table(1, blk2)
+    k2 = rng.randn(Hkv, 24, d).astype(np.float32) * 0.5
+    v2 = rng.randn(Hkv, 24, d).astype(np.float32) * 0.5
+    cache = cache.write_slot(1, jnp.asarray(k2), jnp.asarray(v2))
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.5
+    lens = jnp.asarray([30, 24], jnp.int32)
+    out = jax.jit(lambda q, l: flash_decode_paged(
+        q, cache.pages_k, cache.pages_v, cache.table, jnp.max(l),
+        kv_lens=l))(q, lens)
+    kc, vc = _fill_contiguous([30, 24], [k0, k2], [v0, v2], Hkv, T, d)
+    ref = attention_cached_ref(q, kc, vc, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_page_allocator_exhaustion():
+    alloc = PageAllocator(4)
+    alloc.alloc(3)
+    try:
+        alloc.alloc(2)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("over-allocation must raise")
+    alloc.free([0, 1])
+    assert alloc.available == 3

@@ -11,6 +11,9 @@ import pytest
 
 from triton_dist_tpu.models import AutoLLM, tiny_qwen3
 
+# tier-1 budget: full kernel-path training step differentials — the heaviest e2e cases of the suite (ISSUE 1 satellite; pytest.ini registers the marker)
+pytestmark = pytest.mark.slow
+
 mesh = None
 model = None
 

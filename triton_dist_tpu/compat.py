@@ -1,0 +1,80 @@
+"""Back-compat shims for older jax releases (the container's baked
+toolchain may lag the APIs this repo targets).
+
+The codebase is written against the modern surface — `jax.shard_map`,
+`jax.sharding.AxisType`, `Mesh.axis_types`, `pltpu.CompilerParams`,
+`pltpu.InterpretParams` — and this module maps each one back onto its
+older spelling when the installed jax predates the rename, so the
+oracle ("xla") and basic Pallas paths run on a jax-0.4.x stack too.
+Installed once from the package __init__; every shim is a no-op on a
+modern jax. The TPU-interpreter-specific features (remote DMA,
+semaphores, race detection) have NO pre-0.5 equivalent — kernels that
+need them still require a modern jax; `interpret_mode()` degrades to
+the generic `interpret=True` (see runtime/bootstrap.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+
+def install() -> None:
+    import jax
+
+    # --- jax.shard_map (top-level since ~0.6; check_vma renamed from
+    # check_rep) -------------------------------------------------------
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, **kw):
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            return _shard_map(f, **kw)
+
+        jax.shard_map = shard_map
+
+    # --- jax.lax.axis_size (newer convenience; psum of a literal folds
+    # to the same concrete size under tracing) --------------------------
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = lambda axis: jax.lax.psum(1, axis)
+
+    # --- jax.sharding.AxisType + Mesh.axis_types (explicit-sharding
+    # meshes don't exist pre-0.6: report every axis as Auto) ------------
+    if not hasattr(jax.sharding, "AxisType"):
+        class AxisType(enum.Enum):
+            Auto = "auto"
+            Explicit = "explicit"
+            Manual = "manual"
+
+        jax.sharding.AxisType = AxisType
+        # (0.4.x Mesh instances already carry an `axis_types` attribute
+        # — a dict of its own AxisTypes enum; comparisons against the
+        # stub are simply False, i.e. "not Explicit", which is right)
+
+    # --- pltpu.CompilerParams (renamed from TPUCompilerParams; older
+    # field sets lack e.g. has_side_effects — drop unknown kwargs, the
+    # flag only guards DCE of pure-side-effect comm kernels, which need
+    # the modern interpreter anyway) ------------------------------------
+    from jax.experimental.pallas import tpu as pltpu
+    if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"):
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(pltpu.TPUCompilerParams)}
+
+        def CompilerParams(**kw):
+            return pltpu.TPUCompilerParams(
+                **{k: v for k, v in kw.items() if k in known})
+
+        pltpu.CompilerParams = CompilerParams
+
+
+def has_tpu_interpreter() -> bool:
+    """True when this jax ships the full Pallas TPU interpreter
+    (semaphores/remote-DMA simulation; jax >= ~0.5). Without it the CPU
+    substrate can only run single-buffer kernels under the generic
+    interpreter, and the comm-kernel tests must skip."""
+    from jax.experimental.pallas import tpu as pltpu
+    return hasattr(pltpu, "InterpretParams") or hasattr(
+        pltpu, "TPUInterpretParams")

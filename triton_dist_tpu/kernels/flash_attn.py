@@ -33,8 +33,8 @@ from triton_dist_tpu.runtime import interpret_mode
 
 
 def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
-                         partial: bool, quant: bool, len_ref, q_ref,
-                         k_ref, v_ref, *rest):
+                         partial: bool, quant: bool, per_stream: bool,
+                         len_ref, q_ref, k_ref, v_ref, *rest):
     """Grid (X/bx, T/bt); X = B*Hkv. Online softmax over KV tiles.
 
     partial=False: rest = (o_ref, m_scr, l_scr, acc_scr); writes the
@@ -47,11 +47,25 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
     matmuls: K's scale multiplies the logits column-wise, V's scale
     folds into p before the PV contraction — the int8->bf16 convert
     happens in VMEM, so KV HBM traffic is halved (the decode regime is
-    KV-bandwidth-bound at long context)."""
+    KV-bandwidth-bound at long context).
+
+    per_stream=True (the continuous-batching decode path, S == 1): rest
+    is prefixed by a [bx, 1] int32 block of per-stream kv lengths (its
+    BlockSpec walks the [X, 1] lens operand with the x grid axis) and
+    each stream masks to its OWN kv length — slots of different
+    sequence lengths share one kernel launch. Tiles past a stream's
+    length are masked to a BITWISE no-op of the accumulator update
+    (alpha == 1, p == 0), so a short slot's output is exactly what a
+    uniform-length launch at its length produces; the grid/DMA walk
+    still runs to max_len (len_ref[0])."""
     if quant:
         ks_ref, vs_ref, *rest = rest
     else:
         ks_ref = vs_ref = None
+    if per_stream:
+        lens_ref, *rest = rest
+    else:
+        lens_ref = None
     if partial:
         o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -86,15 +100,21 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
             s = s * ks_ref[...][:, None, :]
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
-        # col < T guards the last block's padding when a caller shifts
-        # the causal frontier past the buffer (kv_len > T, e.g. the
-        # non-causal mode of sp_ring_attention)
-        mask = (col <= (row + q_off)) & (col < jnp.minimum(kv_len, T))
+        if per_stream:
+            # each stream masks to its own length (S == 1, so the
+            # causal frontier col <= len_j - 1 IS the length mask)
+            mask = (col[None] < lens_ref[...][:, :, None]) & (col[None] < T)
+        else:
+            # col < T guards the last block's padding when a caller
+            # shifts the causal frontier past the buffer (kv_len > T,
+            # e.g. the non-causal mode of sp_ring_attention)
+            mask = ((col <= (row + q_off))
+                    & (col < jnp.minimum(kv_len, T)))[None]
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev,
-                            jnp.max(jnp.where(mask[None], s, -1e30), -1))
+                            jnp.max(jnp.where(mask, s, -1e30), -1))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1)
         vt = v_ref[...]
         if quant:
@@ -168,7 +188,7 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
 def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
                  block_x: Optional[int] = None,
                  block_t: Optional[int] = None,
-                 k_scale=None, v_scale=None):
+                 k_scale=None, v_scale=None, kv_lens=None):
     """Cached GQA attention (decode and prefill-into-cache).
 
     q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] (T = static cache capacity);
@@ -180,6 +200,12 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     int8 KV cache (k/v int8); dequant folds into the logits / the P
     matrix inside the kernel (exact), halving KV HBM traffic.
 
+    kv_lens: optional per-BATCH-ROW valid lengths [B] int32 (S must be
+    1; kv_len must then be their max) — the continuous-batching decode
+    path, where each slot of the batch is a different request at a
+    different sequence position (models/scheduler.py). Row b attends
+    exactly its own kv_lens[b] positions.
+
     Reference: flash_decode.py:130 (split-KV GQA kernel) + :308
     (combine); here split-KV partial results live in VMEM scratch and
     combine is the online-softmax update, so nothing round-trips HBM.
@@ -189,6 +215,11 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     rep = Hq // Hkv
     if scale is None:
         scale = d ** -0.5
+    if kv_lens is not None:
+        assert S == 1, "per-slot kv_lens is the decode path (S == 1)"
+        # the scalar kv_len becomes the walk bound (max over slots);
+        # callers may pass anything — it is recomputed here
+        kv_len = jnp.max(jnp.asarray(kv_lens, jnp.int32))
     if block_x is None or block_t is None:
         # callers that do not pin the blocks take the installed
         # contextual profile (tools/tune.contextual_autotune) or the
@@ -209,9 +240,11 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     vx = v.reshape(X, T, d)
     ks = None if k_scale is None else k_scale.reshape(X, T)
     vs = None if v_scale is None else v_scale.reshape(X, T)
+    lens_x = (None if kv_lens is None
+              else jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv))
     out = _flash_call(qx, kx, vx, kv_len, kv_len - S, scale=float(scale),
                       rep=rep, S=S, T=T, partial=False, block_x=block_x,
-                      block_t=block_t, ks=ks, vs=vs)
+                      block_t=block_t, ks=ks, vs=vs, lens=lens_x)
     return (out.reshape(B, Hkv, S, rep, d)
                .transpose(0, 2, 1, 3, 4)
                .reshape(B, S, Hq, d))
@@ -269,7 +302,7 @@ def lse_combine(accs, ms, ls, dtype=None):
 
 def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
                 S: int, T: int, partial: bool, block_x: int, block_t: int,
-                ks=None, vs=None):
+                ks=None, vs=None, lens=None):
     X, rows, d = qx.shape
     quant = ks is not None
     bt = min(block_t, T)
@@ -277,7 +310,7 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
                   kv_itemsize=jnp.dtype(kx.dtype).itemsize,
                   partial=partial)
     kernel = functools.partial(_flash_decode_kernel, scale, rep, S, T,
-                               partial, quant)
+                               partial, quant, lens is not None)
 
     # KV-tile index map clamps t to the last block containing valid keys:
     # grid steps past kv_len re-request the same block, and the Pallas
@@ -306,6 +339,12 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
         in_specs += [pl.BlockSpec((bx, bt), kvs_map),
                      pl.BlockSpec((bx, bt), kvs_map)]
         args += [ks, vs]
+    if lens is not None:
+        # per-stream kv lengths ride as a [X, 1] operand whose block
+        # walks the x grid axis — each bx-slab sees its own lengths
+        in_specs += [pl.BlockSpec((bx, 1),
+                                  lambda x, t, len_ref: (x, 0))]
+        args += [lens.reshape(X, 1)]
 
     if partial:
         out_shape = (jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
@@ -381,7 +420,9 @@ def kv_update(cache, new, tile_pos):
 def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
     """jnp oracle for flash_decode (same layout/contract): masked f32
     softmax over the full static T — the role the torch attention plays
-    for the reference's differential tests."""
+    for the reference's differential tests. kv_len may be a scalar
+    (uniform batch) or a [B] vector (per-slot lengths, the
+    continuous-batching contract of flash_decode(kv_lens=...))."""
     B, S, Hq, d = q.shape
     _, Hkv, T, _ = k.shape
     rep = Hq // Hkv
@@ -392,8 +433,12 @@ def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
                         k.astype(jnp.float32)) * scale
     si = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
     ti = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
-    mask = ti <= (si + (kv_len - S))
-    logits = jnp.where(mask[None, None, :, None], logits, -jnp.inf)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    if kv_len.ndim == 0:
+        mask = (ti <= (si + (kv_len - S)))[None]              # [1, S, T]
+    else:
+        mask = ti[None] <= (si[None] + (kv_len[:, None, None] - S))
+    logits = jnp.where(mask[:, None, :, None], logits, -jnp.inf)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgsrt,bgtd->bsgrd", p, v.astype(jnp.float32))
     return out.reshape(B, S, Hq, d).astype(q.dtype)

@@ -42,17 +42,28 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime import interpret_mode
 
 
-def _paged_kernel(scale: float, rep: int, page: int, W: int, len_ref,
-                  *refs):
+def _paged_kernel(scale: float, rep: int, page: int, W: int,
+                  per_stream: bool, len_ref, *refs):
     """Grid (X // W, max_pages); W (batch, kv-head) streams per grid
-    step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, o, m/l/acc scratch).
-    Same online softmax as _flash_decode_kernel, block = one page; the
-    W streams' pages DMA in parallel under the step and each keeps its
-    own accumulator row."""
+    step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, [lens], o, m/l/acc
+    scratch). Same online softmax as _flash_decode_kernel, block = one
+    page; the W streams' pages DMA in parallel under the step and each
+    keeps its own accumulator row.
+
+    per_stream=True (continuous batching): a [W, 1] int32 lens block
+    rides as the last input and stream j masks to its OWN kv length
+    (S == 1), so slots at different sequence positions share one
+    launch; tiles past a stream's length are a bitwise no-op of its
+    accumulator (and its index map clamps to its own last page, so the
+    surplus DMAs re-request the same block and are elided)."""
     q_ref = refs[0]
     k_refs = refs[1:1 + W]
     v_refs = refs[1 + W:1 + 2 * W]
-    o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
+    if per_stream:
+        lens_ref, o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
+    else:
+        lens_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
     t = pl.program_id(1)
     nt = pl.num_programs(1)
     rows = q_ref.shape[1]
@@ -70,8 +81,12 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int, len_ref,
     def _compute():
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 1) + start
-        mask = (col <= (row + q_off)) & (col < kv_len)
+        if not per_stream:
+            mask = (col <= (row + q_off)) & (col < kv_len)
         for j in range(W):
+            if per_stream:
+                # S == 1: col <= len_j - 1 is the whole causal story
+                mask = col < lens_ref[j, 0]
             q = q_ref[pl.ds(j, 1)]                       # [1, rows, d]
             s = jax.lax.dot_general(
                 q, k_refs[j][...], (((2,), (2,)), ((0,), (0,))),
@@ -100,13 +115,22 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int, len_ref,
 
 
 def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
-                       scale: Optional[float] = None):
+                       scale: Optional[float] = None, kv_lens=None):
     """Cached GQA decode attention through a page table.
 
     q: [B, 1, Hq, d]; pages_k/v: [NP, page, d]; page_table:
     [B*Hkv, max_pages] int32 (physical page of each logical tile; rows
     beyond ceil(kv_len/page) may hold anything); kv_len: traced scalar
     — valid positions INCLUDING the current query. Returns [B, 1, Hq, d].
+
+    kv_lens: optional per-BATCH-ROW lengths [B] int32 (continuous
+    batching: each slot is a different request at a different sequence
+    position). Row b attends exactly kv_lens[b] positions of its own
+    streams; kv_len is recomputed as their max (the walk bound). Each
+    stream's index map clamps to ITS OWN last valid page, so the tail
+    of a short slot's walk re-requests one block and its DMAs are
+    elided — a mixed-length batch pays max_len grid steps but only
+    sum(len_b) page traffic.
     """
     B, S, Hq, d = q.shape
     assert S == 1, "paged walk is the decode path (S == 1)"
@@ -121,31 +145,50 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     # W streams per grid step (see module docstring): the largest
     # divisor of X in (8, 4, 2, 1)
     W = next(w for w in (8, 4, 2, 1) if X % w == 0)
-    # scalars: [kv_len, q_off, table...]; the kv index map resolves the
-    # logical tile through the table (clamped to the last valid tile so
-    # the tail is elided like the contiguous walk)
-    scalars = jnp.concatenate([
-        jnp.asarray([kv_len, kv_len - 1], jnp.int32),
-        page_table.reshape(-1).astype(jnp.int32)])
+    per_stream = kv_lens is not None
+    if per_stream:
+        lens_x = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv)  # [X]
+        kv_len = jnp.max(lens_x)
+    # scalars: [kv_len, q_off, lens..., table...]; the kv index map
+    # resolves the logical tile through the table (clamped to the last
+    # valid tile so the tail is elided like the contiguous walk). The
+    # per-stream lens appear TWICE on purpose: in the scalars for the
+    # index-map clamp, and as a [X, 1] operand for the in-kernel mask
+    # (kernel bodies avoid dynamic scalar-table indexing, which the
+    # generic interpreter of older jax cannot evaluate).
+    n_lens = X if per_stream else 0
+    scalars = jnp.concatenate(
+        ([jnp.asarray([kv_len, kv_len - 1], jnp.int32)]
+         + ([lens_x] if per_stream else [])
+         + [page_table.reshape(-1).astype(jnp.int32)]))
 
     def kv_map_j(j):
         def kv_map(x, t, s_ref):
-            last = jnp.maximum((s_ref[0] + page - 1) // page - 1, 0)
-            return (s_ref[2 + (x * W + j) * maxp + jnp.minimum(t, last)],
+            own = (s_ref[2 + x * W + j] if per_stream else s_ref[0])
+            last = jnp.maximum((own + page - 1) // page - 1, 0)
+            return (s_ref[2 + n_lens + (x * W + j) * maxp
+                          + jnp.minimum(t, last)],
                     0, 0)
         return kv_map
 
     def q_map(x, t, s_ref):
         return (x, 0, 0)
 
+    def lens_map(x, t, s_ref):
+        return (x, 0)
+
     kv_specs = [pl.BlockSpec((1, page, d), kv_map_j(j)) for j in range(W)]
+    in_specs = ([pl.BlockSpec((W, rows, d), q_map)] + kv_specs + kv_specs
+                + ([pl.BlockSpec((W, 1), lens_map)] if per_stream else []))
+    args = ([qx] + [pages_k] * W + [pages_v] * W
+            + ([lens_x.reshape(X, 1)] if per_stream else []))
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, float(scale), rep, page, W),
+        functools.partial(_paged_kernel, float(scale), rep, page, W,
+                          per_stream),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(X // W, maxp),
-            in_specs=[pl.BlockSpec((W, rows, d), q_map)]
-                     + kv_specs + kv_specs,
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((W, rows, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((W, rows), jnp.float32),
@@ -157,7 +200,7 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         interpret=interpret_mode(),
         # the W k (v) operands are the SAME pool array — one buffer,
         # W per-stream index maps
-    )(scalars, qx, *([pages_k] * W), *([pages_v] * W))
+    )(scalars, *args)
     return out.reshape(B, Hkv, rep, d).reshape(B, 1, Hq, d)
 
 
@@ -222,3 +265,104 @@ class PagedKVCache:
         return dataclasses.replace(
             self, pages_k=scat(self.pages_k, rows),
             pages_v=scat(self.pages_v, vrows), offset=self.offset + 1)
+
+    # ------------------------------------------------------------------
+    # continuous-batching slot paths (models/scheduler.py design): the
+    # batch rows of the table are independent SLOTS at their own
+    # per-slot positions; a real allocator (PageAllocator) owns the
+    # physical pages, so slots of very different lengths share the pool
+    # and a retired slot's pages go back on the free list.
+    # ------------------------------------------------------------------
+
+    def write_slot(self, slot: int, k, v) -> "PagedKVCache":
+        """Prefill-into-slot: write a new request's whole prompt KV
+        (k/v [Hkv, n, d]) through the slot's table rows — positions
+        0..n-1 of streams slot*Hkv..slot*Hkv+Hkv-1. Touches only the
+        slot's own (allocator-assigned) pages, so live slots are
+        undisturbed. The shared offset is NOT advanced — per-slot
+        lengths live with the scheduler."""
+        Hkv, n, d = k.shape
+        X, maxp = self.table.shape
+        p = jnp.arange(n)
+        streams = slot * Hkv + jnp.arange(Hkv)
+        pidx = self.table[streams][:, p // self.page]      # [Hkv, n]
+        r = p % self.page                                  # [n]
+
+        def scat(pages, rows):
+            return pages.at[pidx, r[None]].set(rows.astype(pages.dtype))
+
+        return dataclasses.replace(
+            self, pages_k=scat(self.pages_k, k),
+            pages_v=scat(self.pages_v, v))
+
+    def append_slots(self, k_new, v_new, pos) -> "PagedKVCache":
+        """Per-slot decode append: k/v_new [B, Hkv, 1, d], pos [B] —
+        slot b's new row lands at ITS position pos[b] (page
+        table[b*Hkv+h, pos[b]//page], row pos[b]%page). One scatter for
+        the whole batch; the shared offset is untouched."""
+        B, Hkv, _, d = k_new.shape
+        X, maxp = self.table.shape
+        pos_x = jnp.repeat(jnp.asarray(pos, jnp.int32), Hkv)   # [X]
+        pidx = self.table[jnp.arange(X), pos_x // self.page]
+        r = pos_x % self.page
+
+        def scat(pages, rows):
+            return pages.at[pidx, r].set(rows.astype(pages.dtype))
+
+        return dataclasses.replace(
+            self, pages_k=scat(self.pages_k, k_new.reshape(X, d)),
+            pages_v=scat(self.pages_v, v_new.reshape(X, d)))
+
+    def set_slot_table(self, slot: int, rows) -> "PagedKVCache":
+        """Install allocator-assigned table rows for a slot:
+        rows [Hkv, <=max_pages] int32 physical page ids (shorter rows
+        pad with their own last entry — never attended past the slot's
+        length, but the index map must stay in range)."""
+        Hkv, npg = rows.shape
+        X, maxp = self.table.shape
+        rows = jnp.asarray(rows, jnp.int32)
+        if npg < maxp:
+            rows = jnp.concatenate(
+                [rows, jnp.broadcast_to(rows[:, -1:],
+                                        (Hkv, maxp - npg))], axis=1)
+        table = jax.lax.dynamic_update_slice(self.table, rows,
+                                             (slot * Hkv, 0))
+        return dataclasses.replace(self, table=table)
+
+
+class PageAllocator:
+    """Host-side free-list over the physical page pool (the POLICY the
+    trivial static table deliberately leaves out — reference:
+    paged_kv_cache.py's block allocator). Slots of very different
+    lengths draw from one pool; retiring a slot returns its pages for
+    the next admission. Pure host bookkeeping: allocation changes the
+    page TABLE (data), never the kernel (program)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, -1, -1))  # pop() -> page 0 first
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int):
+        """Take n pages off the free list (raises when the pool is
+        exhausted — the scheduler's admission check)."""
+        if n > len(self._free):
+            raise ValueError(
+                f"page pool exhausted: want {n}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        self._free.extend(pages)
+
+    def alloc_slot(self, Hkv: int, n_positions: int, page: int):
+        """Pages for one slot: Hkv streams x ceil(n_positions/page)
+        pages each. Returns an [Hkv, n_pages] int32 table block (feed
+        to PagedKVCache.set_slot_table); free a retired slot with
+        free(block.ravel())."""
+        import numpy as np
+        npg = -(-n_positions // page)
+        return np.asarray([self.alloc(npg) for _ in range(Hkv)],
+                          np.int32)

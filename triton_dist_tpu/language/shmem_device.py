@@ -123,6 +123,19 @@ def _device_id(pe, axis: Optional[str]):
     """
     if axis is None:
         return pe, pltpu.DeviceIdType.LOGICAL
+    from triton_dist_tpu.compat import has_tpu_interpreter
+    if not has_tpu_interpreter():
+        # pre-TPU-interpreter jax: the interpret discharge rule for
+        # remote DMA addresses MESH peers as a bare scalar coordinate
+        # (one per mesh axis), not by the {axis: pe} dict — correct
+        # only on 1-D meshes, which is all that substrate can simulate
+        # anyway. The peer must reach the discharge rule as a TRACED
+        # scalar: a constant folds to a 0-d numpy literal which that
+        # rule can neither isinstance(jax.Array) nor len() — anchoring
+        # on axis_index (free inside the kernel) keeps it symbolic.
+        if not isinstance(pe, jax.core.Tracer):
+            pe = jax.lax.axis_index(axis) * 0 + jnp.int32(pe)
+        return pe, pltpu.DeviceIdType.MESH
     return {axis: pe}, pltpu.DeviceIdType.MESH
 
 
@@ -249,8 +262,9 @@ def barrier_all(axis: str, barrier_sem=None) -> None:
     for k in range(rounds):
         dist = 1 << k
         dst = jax.lax.rem(me + dist, n)
-        pltpu.semaphore_signal(sem, inc=1, device_id={axis: dst},
-                               device_id_type=pltpu.DeviceIdType.MESH)
+        did, dtype = _device_id(dst, axis)
+        pltpu.semaphore_signal(sem, inc=1, device_id=did,
+                               device_id_type=dtype)
         pltpu.semaphore_wait(sem, 1)
 
 

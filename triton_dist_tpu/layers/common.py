@@ -48,6 +48,22 @@ def apply_rope(x, cos, sin, positions):
         [x1f * c - x2f * s, x2f * c + x1f * s], axis=-1).astype(dt)
 
 
+def apply_rope_slots(x, cos, sin, pos):
+    """Per-slot RoPE: x [B, S, H, D]; pos [B] int32 — row b rotates at
+    positions pos[b] .. pos[b]+S-1. The continuous-batching decode path
+    (models/scheduler.py), where every batch row is a different request
+    at a different sequence position."""
+    B, S = x.shape[0], x.shape[1]
+    p = pos[:, None] + jnp.arange(S)            # [B, S]
+    c = cos[p][:, :, None, :]                   # [B, S, 1, D/2]
+    s = sin[p][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    dt = x.dtype
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [x1f * c - x2f * s, x2f * c + x1f * s], axis=-1).astype(dt)
+
+
 def shard_cols_packed(mats, n: int):
     """Pack several column-parallel weights into one matrix whose global
     column layout is n per-rank blocks, each the concat of every input's

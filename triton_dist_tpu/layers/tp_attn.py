@@ -26,8 +26,8 @@ from triton_dist_tpu.kernels import (ag_gemm, all_reduce,
                                      create_gemm_ar_context,
                                      create_gemm_rs_context, gemm_allreduce,
                                      gemm_rs)
-from triton_dist_tpu.layers.common import (apply_rope, rms_norm,
-                                           shard_cols_packed)
+from triton_dist_tpu.layers.common import (apply_rope, apply_rope_slots,
+                                           rms_norm, shard_cols_packed)
 
 
 def causal_attention(q, k, v, scale: float):
@@ -443,6 +443,145 @@ class TP_Attn:
         out = f(qkv, *kv, jnp.asarray(kv_start, jnp.int32))
         return out[0], tuple(out[1:])
 
+    def _attend_cached_slots(self, qkv, cos, sin, batch: int, kv, pos,
+                             impl: str = "flash"):
+        """Slot-variant of _attend_cached for the continuous-batching
+        decode step (S == 1, per-row positions).
+
+        qkv: [B, qkv_cols] sharded P(None, tp); pos: [B] int32 — row b
+        writes its K/V at column pos[b] of ITS cache row (a per-row
+        scatter; rows are independent (batch, head) streams, so a row's
+        write never touches another slot's data) and attends its own
+        columns [0, pos[b]] via the kernel's per-stream length mask
+        (flash_decode kv_lens / attention_cached_ref vector kv_len).
+        RoPE rotates row b at angle pos[b]. Returns (o, updated kv).
+        """
+        from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
+                                                        flash_decode)
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        scale = hd ** -0.5
+        quant = len(kv) == 4
+        cache_spec = P(None, self.axis, None, None)
+        scale_spec = P(None, self.axis, None)
+        kv_specs = ((cache_spec, cache_spec, scale_spec, scale_spec)
+                    if quant else (cache_spec, cache_spec))
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis),) + kv_specs + (P(None),),
+            out_specs=((P(None, self.axis),) + kv_specs),
+            check_vma=False)
+        def f(qkv_loc, ck_loc, cv_loc, *rest):
+            *scales, pos = rest
+            B = qkv_loc.shape[0]               # S == 1: one row per slot
+            q = qkv_loc[:, :hq * hd].reshape(B, 1, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(B, 1, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(B, 1, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope_slots(q, cos, sin, pos)
+            k = apply_rope_slots(k, cos, sin, pos)
+            kT = k.transpose(0, 2, 1, 3)        # [B, hkv, 1, hd]
+            vT = v.transpose(0, 2, 1, 3)
+            rows = jnp.arange(B)
+            lens = pos + 1
+
+            def scat(c, u):
+                # one row per (slot, head) stream at that slot's column
+                return c.at[rows, :, pos].set(u[:, :, 0].astype(c.dtype))
+
+            if quant:
+                ks_loc, vs_loc = scales
+
+                def q8(x):   # per-(b, head, position) symmetric int8
+                    xf = x.astype(jnp.float32)
+                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
+                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
+                            s)
+
+                k8, k_s = q8(kT)
+                v8, v_s = q8(vT)
+                ck_loc = scat(ck_loc, k8)
+                cv_loc = scat(cv_loc, v8)
+                ks_loc = ks_loc.at[rows, :, pos].set(k_s[:, :, 0])
+                vs_loc = vs_loc.at[rows, :, pos].set(v_s[:, :, 0])
+                if impl == "flash":
+                    bt = min(ck_loc.shape[2], 2048)
+                    o = flash_decode(q.astype(jnp.bfloat16), ck_loc,
+                                     cv_loc, jnp.max(lens), scale=scale,
+                                     k_scale=ks_loc, v_scale=vs_loc,
+                                     block_t=bt, kv_lens=lens)
+                else:
+                    o = attention_cached_ref(
+                        q.astype(jnp.float32),
+                        ck_loc.astype(jnp.float32) * ks_loc[..., None],
+                        cv_loc.astype(jnp.float32) * vs_loc[..., None],
+                        lens, scale=scale)
+                return (o.reshape(B, hq * hd).astype(qkv_loc.dtype),
+                        ck_loc, cv_loc, ks_loc, vs_loc)
+
+            ck_loc = scat(ck_loc, kT)
+            cv_loc = scat(cv_loc, vT)
+            if impl == "flash":
+                o = flash_decode(q.astype(ck_loc.dtype), ck_loc, cv_loc,
+                                 jnp.max(lens), scale=scale, kv_lens=lens)
+            else:
+                o = attention_cached_ref(q.astype(ck_loc.dtype), ck_loc,
+                                         cv_loc, lens, scale=scale)
+            return o.reshape(B, hq * hd), ck_loc, cv_loc
+
+        out = f(qkv, *kv, jnp.asarray(pos, jnp.int32))
+        return out[0], tuple(out[1:])
+
+    def _qkv_proj(self, x, mode: str):
+        """Mode-dispatched QKV projection (the prologue both cached
+        forwards share): "dist" = AG-GEMM on row-sharded x; every other
+        mode = local qmm on replicated x."""
+        if mode == "dist":
+            ag_ctx = create_ag_gemm_context(self.mesh, self.axis)
+            return ag_gemm(x, self.w_qkv, ag_ctx)
+        from triton_dist_tpu.kernels.quant import qmm, qspec
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=(P(None, None),
+                                     qspec(self.w_qkv, P(None, self.axis),
+                                           P(self.axis))),
+                           out_specs=P(None, self.axis), check_vma=False)
+        def qkv_local(x_r, w_loc):
+            return qmm(x_r, w_loc)
+
+        return qkv_local(x, self.w_qkv)
+
+    def _o_proj(self, o, mode: str):
+        """Mode-dispatched O projection epilogue (shared by both cached
+        forwards): "dist" = GEMM-RS, "gemm_ar" = fused GEMM+AR, "ar" =
+        partial GEMM + AR kernel, "xla"/"flash" = partial GEMM + psum."""
+        axis = self.axis
+        if mode == "dist":
+            rs_ctx = create_gemm_rs_context(self.mesh, axis)
+            return gemm_rs(o, self.w_o, rs_ctx)
+        if mode == "gemm_ar":
+            ctx = create_gemm_ar_context(self.mesh, axis)
+            return gemm_allreduce(o, self.w_o, ctx)
+        if mode == "ar":
+            from triton_dist_tpu.kernels.quant import qmm, qspec
+
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=(P(None, axis),
+                                         qspec(self.w_o, P(axis, None),
+                                               P(None))),
+                               out_specs=P(axis, None, None),
+                               check_vma=False)
+            def o_partial(o_loc, wo_loc):
+                return qmm(o_loc, wo_loc)[None]
+
+            return all_reduce(o_partial(o, self.w_o), mesh=self.mesh,
+                              axis=axis)
+        # "xla" oracle and "flash": psum epilogue
+        return self._down_psum(o)
+
     def fwd_cached(self, x, cos, sin, batch: int, kv, kv_start,
                    mode: str = "dist"):
         """Full attention block with KV cache: QKV proj -> cached attend
@@ -455,46 +594,22 @@ class TP_Attn:
         flash-decode attention + psum — the single-chip framework path),
         "dist"/"ar"/"gemm_ar" (overlapped comm kernels + flash-decode).
         """
-        axis = self.axis
         impl = "ref" if mode == "xla" else "flash"
-        if mode == "dist":
-            ag_ctx = create_ag_gemm_context(self.mesh, axis)
-            qkv = ag_gemm(x, self.w_qkv, ag_ctx)
-        else:
-            from triton_dist_tpu.kernels.quant import qmm, qspec
-
-            @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, None),
-                                         qspec(self.w_qkv, P(None, axis),
-                                               P(axis))),
-                               out_specs=P(None, axis), check_vma=False)
-            def qkv_local(x_r, w_loc):
-                return qmm(x_r, w_loc)
-
-            qkv = qkv_local(x, self.w_qkv)
-
+        qkv = self._qkv_proj(x, mode)
         o, kv = self._attend_cached(qkv, cos, sin, batch, kv,
                                     kv_start, impl)
+        return self._o_proj(o, mode), kv
 
-        if mode == "dist":
-            rs_ctx = create_gemm_rs_context(self.mesh, axis)
-            y = gemm_rs(o, self.w_o, rs_ctx)
-        elif mode == "gemm_ar":
-            ctx = create_gemm_ar_context(self.mesh, axis)
-            y = gemm_allreduce(o, self.w_o, ctx)
-        elif mode == "ar":
-            from triton_dist_tpu.kernels.quant import qmm, qspec
-
-            @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=(P(None, axis),
-                                         qspec(self.w_o, P(axis, None),
-                                               P(None))),
-                               out_specs=P(axis, None, None),
-                               check_vma=False)
-            def o_partial(o_loc, wo_loc):
-                return qmm(o_loc, wo_loc)[None]
-
-            y = all_reduce(o_partial(o, self.w_o), mesh=self.mesh, axis=axis)
-        else:  # "xla" oracle and "flash": psum epilogue
-            y = self._down_psum(o)
-        return y, kv
+    def fwd_cached_slots(self, x, cos, sin, batch: int, kv, pos,
+                         mode: str = "dist"):
+        """Slot-masked decode attention block (continuous batching,
+        models/scheduler.py): one token per batch row, each row at its
+        OWN sequence position. x: [B, D]; pos: [B] int32 — row b's KV
+        goes to column pos[b] of its cache row and it attends columns
+        [0, pos[b]]. Same mode dispatch as fwd_cached; the decode step
+        stays ONE program regardless of the per-slot position mix."""
+        impl = "ref" if mode == "xla" else "flash"
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_cached_slots(qkv, cos, sin, batch, kv,
+                                          pos, impl)
+        return self._o_proj(o, mode), kv

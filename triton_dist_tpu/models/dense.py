@@ -167,13 +167,18 @@ class DenseLLM:
     # ------------------------------------------------------------------
 
     def forward_tokens(self, ids, cache: KVCache, mode: str = "dist",
-                       mlp_mode: Optional[str] = None):
+                       mlp_mode: Optional[str] = None, last_pos=None):
         """One forward pass over `ids` [B, S] starting at cache.offset;
         fills the cache and returns (last-position logits [B, V], cache).
 
         mode: attention forward mode; mlp_mode defaults to mode. For
         "dist", B*S must be divisible by the TP size (reference contract:
         max_M-padded symmetric workspaces, allgather_gemm.py:447).
+
+        last_pos: optional traced scalar — take the logits at THIS
+        sequence position instead of S-1 (the bucketed prefill-into-slot
+        path pads prompts to a fixed S and reads the last REAL position,
+        engine.prefill_into_slot).
         """
         B, S = ids.shape
         mlp_mode = mlp_mode or mode
@@ -193,7 +198,9 @@ class DenseLLM:
         if mode == "dist":
             # activations are row-sharded; gather for the LM head tail
             x = self._gather_rows(x)
-        last = x.reshape(B, S, -1)[:, -1]
+        xr = x.reshape(B, S, -1)
+        last = xr[:, -1] if last_pos is None else jnp.take(
+            xr, last_pos, axis=1)
         # bf16 x bf16 -> f32 on the MXU; casting the [D, V] weight to f32
         # would materialize (and re-read) gigabytes per decode step.
         # lm_head may be int8-quantized (the single biggest weight read
@@ -201,6 +208,35 @@ class DenseLLM:
         from triton_dist_tpu.kernels.quant import qmm
         logits = qmm(last, self.lm_head,
                      preferred_element_type=jnp.float32)
+        return logits, cache
+
+    def forward_tokens_slots(self, ids, cache: KVCache, pos,
+                             mode: str = "dist",
+                             mlp_mode: Optional[str] = None):
+        """Slot-masked decode forward (continuous batching): one token
+        per batch row, row b at its OWN position pos[b] (models/
+        scheduler.py). ids: [B, 1]; pos: [B] int32. Writes each row's
+        K/V at its own cache column and attends per-row lengths; the
+        shared cache.offset is NOT advanced — per-slot positions live
+        with the scheduler. Returns (logits [B, V], cache)."""
+        B, S = ids.shape
+        assert S == 1, "slot decode feeds one token per slot"
+        mlp_mode = mlp_mode or mode
+        x = self.embed[ids].reshape(B, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            kv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots(
+                h, self.cos, self.sin, B, kv, pos, mode)
+            cache = cache.set_layer(li, kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp(h, mlp_mode)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "dist":
+            x = self._gather_rows(x)
+        from triton_dist_tpu.kernels.quant import qmm
+        logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
         return logits, cache
 
     def forward_train(self, ids, mode: str = "train"):

@@ -123,6 +123,25 @@ class Engine:
                                         sampling, self._sample_params)
         self._decode_scan = jax.jit(
             scan_fn, static_argnames=("gen_len",), donate_argnums=(2,))
+        # slot-masked chunked decode (continuous batching,
+        # models/scheduler.py): compiled lazily on first admit — the
+        # uniform-batch paths never pay for it
+        if backend != "mega":
+            slot_fn = (functools.partial(_slot_scan_decode_fn, backend)
+                       if sampling == "greedy" else
+                       functools.partial(_sampled_slot_scan_decode_fn,
+                                         backend, sampling,
+                                         self._sample_params))
+            self._slot_scan = jax.jit(
+                slot_fn, static_argnames=("gen_len",), donate_argnums=(2,))
+            self._prefill_slot = jax.jit(
+                functools.partial(_prefill_slot_fn,
+                                  mode=self.prefill_backend),
+                donate_argnums=(2,))
+            self._write_slot = jax.jit(_write_slot_fn, donate_argnums=(0,))
+            # persistent 1-row scratch for prefill_into_slot, donated
+            # through each admission instead of reallocated per request
+            self._slot_scratch = None
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -141,7 +160,7 @@ class Engine:
             toks, _, _ = self._decode_scan(self.model, logits, cache,
                                            gen_len=gen_len)
         else:
-            toks, _, _ = self._decode_scan(
+            toks, _, _, _ = self._decode_scan(
                 self.model, logits, cache, jax.random.key(seed),
                 gen_len=gen_len)
         return toks
@@ -153,9 +172,169 @@ class Engine:
         logits, cache = self.prefill(input_ids)
         return self.decode(logits, cache, gen_len, seed=seed)
 
+    # ------------------------------------------------------------------
+    # continuous-batching slot decode (models/scheduler.py drives these)
+    # ------------------------------------------------------------------
+
+    def make_slot_cache(self, batch: int):
+        """Fresh cache whose batch rows are independent decode SLOTS."""
+        return self.model.make_cache(batch, self.max_seq,
+                                     dtype=self.kv_dtype)
+
+    def prefill_into_slot(self, cache, slot, ids, *, pad_to: int = 8):
+        """Prefill ONE new request and write its KV into batch row
+        `slot` of the shared cache without touching live slots.
+
+        The prompt runs as a batch-1 forward into a persistent 1-row
+        scratch cache (allocated once per engine, donated through the
+        jitted prefill each admission), padded up to a multiple of
+        `pad_to` — clamped to max_seq — so the number of prefill
+        programs is bounded by the bucket count, not the number of
+        distinct prompt lengths (padded positions write garbage KV
+        past the real length — never attended, because the slot's
+        per-row length masks them, and overwritten as decode advances;
+        the same masking makes scratch reuse across admissions safe).
+        The scratch row is then copied over the slot's row — ONE
+        dynamic-update-slice per layer buffer on the donated cache.
+        Returns (next-token logits [V], cache).
+        """
+        ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        n = ids.shape[0]
+        if n > self.max_seq:
+            raise ValueError(
+                f"prompt length {n} exceeds slot capacity {self.max_seq}")
+        # the pad bucket must never write past the cache capacity
+        # (max_seq need not be a pad_to multiple)
+        P = min(-(-n // pad_to) * pad_to, self.max_seq)
+        padded = jnp.zeros((1, P), jnp.int32).at[0, :n].set(ids)
+        if self._slot_scratch is None:
+            self._slot_scratch = self.model.make_cache(
+                1, self.max_seq, dtype=self.kv_dtype)
+        logits, self._slot_scratch = self._prefill_slot(
+            self.model, padded, self._slot_scratch, jnp.int32(n - 1))
+        cache = self._write_slot(cache, self._slot_scratch,
+                                 jnp.int32(slot))
+        return logits[0], cache
+
+    def slot_chunk(self, logits, cache, pos, active, *, chunk: int,
+                   keys=None):
+        """One chunk of slot-masked decode: `chunk` scan steps where
+        row b samples from its own logits, appends KV at its own
+        pos[b], and advances only if active[b] (inactive slots write
+        into their own dead rows — harmless, overwritten on admit).
+        ONE XLA program per chunk length; admission/retirement happen
+        between chunks on the host. keys: per-slot PRNG keys [B]
+        (typed key array) for the sampled modes; None under greedy.
+        Returns (toks [B, chunk], logits, cache, pos, keys)."""
+        if self.backend == "mega":
+            raise ValueError("backend='mega' carries no resumable "
+                             "slot state; use the per-op backends")
+        if self.sampling == "greedy":
+            assert keys is None
+            toks, logits, cache, pos = self._slot_scan(
+                self.model, logits, cache, pos, active, gen_len=chunk)
+            return toks, logits, cache, pos, None
+        toks, logits, cache, pos, keys = self._slot_scan(
+            self.model, logits, cache, pos, active, keys, gen_len=chunk)
+        return toks, logits, cache, pos, keys
+
 
 def _prefill_fn(model, ids, cache, *, mode):
     return model.forward_tokens(ids, cache, mode=mode)
+
+
+def _prefill_slot_fn(model, ids, cache, last_pos, *, mode):
+    """Bucketed batch-1 prefill: logits taken at the last REAL prompt
+    position (the pad tail's logits are garbage and discarded). The
+    scratch cache is REUSED across admissions (donated through), so its
+    offset must restart at 0 every time."""
+    import dataclasses
+    cache = dataclasses.replace(cache, offset=jnp.int32(0))
+    return model.forward_tokens(ids, cache, mode=mode, last_pos=last_pos)
+
+
+def _write_slot_fn(cache, scratch, slot):
+    """Copy a 1-row scratch cache over batch row `slot` of the shared
+    slot cache (donated): one DUS per layer buffer. The whole row is
+    replaced — including the zero tail — so stale KV from a retired
+    request cannot leak into the new occupant's masked-out columns."""
+    import dataclasses
+
+    def put(bufs, rows):
+        return tuple(
+            jax.lax.dynamic_update_slice(
+                b, r.astype(b.dtype), (slot,) + (0,) * (b.ndim - 1))
+            for b, r in zip(bufs, rows))
+
+    out = dataclasses.replace(
+        cache, k=put(cache.k, scratch.k), v=put(cache.v, scratch.v))
+    if cache.ks:
+        out = dataclasses.replace(out, ks=put(cache.ks, scratch.ks),
+                                  vs=put(cache.vs, scratch.vs))
+    return out
+
+
+def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
+                         gen_len: int):
+    """Slot-masked greedy decode chunk (continuous batching): same
+    shape as _scan_decode_fn, but each batch row is an independent
+    request at its own position. Inactive rows still flow through the
+    program (masking keeps it ONE executable for every occupancy mix);
+    their writes land in their own dead cache rows and their tokens are
+    discarded by the scheduler."""
+    act = active.astype(jnp.int32)
+
+    def step(carry, _):
+        logits, cache, pos = carry
+        tok = jnp.argmax(logits, axis=-1)           # greedy [B]
+        tok = jnp.where(active, tok, 0)
+        logits, cache = model.forward_tokens_slots(tok[:, None], cache,
+                                                   pos, mode=backend)
+        # clamp: a slot that finished mid-chunk keeps stepping until the
+        # chunk boundary; its surplus writes stay inside its own row
+        pos = jnp.minimum(pos + act, cache.k[0].shape[2] - 1)
+        return (logits, cache, pos), tok
+
+    (logits, cache, pos), toks = jax.lax.scan(
+        step, (logits0, cache, pos), None, length=gen_len)
+    return toks.T, logits, cache, pos                # [B, gen_len]
+
+
+def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
+                                 logits0, cache, pos, active, keys, *,
+                                 gen_len: int):
+    """Sampled slot decode chunk: per-slot PRNG keys split once per
+    step, so each slot's sampled chain equals a single-request
+    Engine.serve() at that slot's seed — and is invariant to chunk
+    boundaries and to whatever the other slots are doing."""
+    from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
+
+    temp = max(params["temperature"], 0.0)
+    act = active.astype(jnp.int32)
+
+    def sample_one(k, logits):
+        if temp == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        if sampling == "top_k":
+            return sample_top_k(k, logits, k=params["k"],
+                                temperature=temp)
+        return sample_top_p(k, logits, p=params["p"], temperature=temp)
+
+    def step(carry, _):
+        logits, cache, pos, keys = carry
+        split = jax.vmap(functools.partial(jax.random.split, num=2))
+        ks = split(keys)
+        keys, subs = ks[:, 0], ks[:, 1]
+        tok = jax.vmap(sample_one)(subs, logits)    # [B]
+        tok = jnp.where(active, tok, 0)
+        logits, cache = model.forward_tokens_slots(tok[:, None], cache,
+                                                   pos, mode=backend)
+        pos = jnp.minimum(pos + act, cache.k[0].shape[2] - 1)
+        return (logits, cache, pos, keys), tok
+
+    (logits, cache, pos, keys), toks = jax.lax.scan(
+        step, (logits0, cache, pos, keys), None, length=gen_len)
+    return toks.T, logits, cache, pos, keys          # [B, gen_len]
 
 
 def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):
@@ -180,7 +359,10 @@ def _sampled_scan_decode_fn(backend, sampling, params, model, logits0,
     PRNG key in the carry, split once per step (reference: the sampling
     loop of the chat server, model_server.py + models/utils.py).
     temperature=0 degenerates to argmax so servers can flip modes
-    without recompiling a separate greedy engine."""
+    without recompiling a separate greedy engine. The evolved key is
+    RETURNED so chunked callers (serving.decode_stream) continue the
+    exact chain — a resumed scan samples the same tokens as one long
+    scan at the same seed."""
     from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
 
     temp = max(params["temperature"], 0.0)
@@ -201,9 +383,9 @@ def _sampled_scan_decode_fn(backend, sampling, params, model, logits0,
                                              mode=backend)
         return (logits, cache, key), tok
 
-    (logits, cache, _), toks = jax.lax.scan(
+    (logits, cache, key), toks = jax.lax.scan(
         step, (logits0, cache, key), None, length=gen_len)
-    return toks.T, logits, cache                     # [B, gen_len]
+    return toks.T, logits, cache, key                # [B, gen_len]
 
 
 def _pick_mega_bn(cfg, n: int = 1) -> int:

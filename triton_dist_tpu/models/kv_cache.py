@@ -15,6 +15,14 @@ Two deliberate layout choices:
 - head-major [Hkv, T, hd]: each head's KV is contiguous, which is the
   read order of the flash-decode kernel (kernels/flash_attn.py) — no
   transpose on the hot path.
+
+Slot mode (continuous batching, models/scheduler.py): each batch row
+is an independent decode SLOT holding a different request. The shared
+`offset` is then meaningless and stays untouched — per-slot positions
+live in the scheduler's carry, rows are written by per-row scatter
+(TP_Attn._attend_cached_slots) and admission replaces a whole row
+(engine._write_slot_fn), so one row's request can never read another's
+KV (per-row attention lengths mask the rest).
 """
 
 from __future__ import annotations

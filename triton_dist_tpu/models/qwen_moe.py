@@ -167,13 +167,18 @@ class Qwen3MoE:
     # forward (mirrors DenseLLM.forward_tokens)
     # ------------------------------------------------------------------
 
-    def forward_tokens(self, ids, cache: KVCache, mode: str = "dist"):
-        B, S = ids.shape
+    def _moe_modes(self, mode: str):
         attn_mode = "dist" if mode == "ep" else mode
         if self.moe_impl == "ep":
             moe_mode = "ep" if mode == "ep" else "xla"
         else:
             moe_mode = "dist" if mode == "ep" else mode
+        return attn_mode, moe_mode
+
+    def forward_tokens(self, ids, cache: KVCache, mode: str = "dist",
+                       last_pos=None):
+        B, S = ids.shape
+        attn_mode, moe_mode = self._moe_modes(mode)
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         kv_start = cache.offset
         for li, layer in enumerate(self.layers):
@@ -189,8 +194,35 @@ class Qwen3MoE:
         x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
         if mode in ("dist", "ep"):
             x = self._gather_rows(x)
-        last = x.reshape(B, S, -1)[:, -1]
+        xr = x.reshape(B, S, -1)
+        last = xr[:, -1] if last_pos is None else jnp.take(
+            xr, last_pos, axis=1)
         logits = jnp.dot(last, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits, cache
+
+    def forward_tokens_slots(self, ids, cache: KVCache, pos,
+                             mode: str = "dist"):
+        """Slot-masked decode forward (continuous batching; mirrors
+        DenseLLM.forward_tokens_slots): ids [B, 1], pos [B] int32 —
+        row b decodes at its own position. cache.offset is untouched."""
+        B, S = ids.shape
+        assert S == 1, "slot decode feeds one token per slot"
+        attn_mode, moe_mode = self._moe_modes(mode)
+        x = self.embed[ids].reshape(B, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            kv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots(
+                h, self.cos, self.sin, B, kv, pos, attn_mode)
+            cache = cache.set_layer(li, kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.moe(h, moe_mode).astype(x.dtype)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode in ("dist", "ep"):
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
                          preferred_element_type=jnp.float32)
         return logits, cache
 

@@ -1,0 +1,191 @@
+"""Continuous-batching decode scheduler: slot-based multi-request
+serving over the shared KV pool.
+
+The reference's serving loop (`mega_triton_kernel/test/models/
+model_server.py:265`) handles one prompt at a time, and the old
+TokenServer tiled that single prompt across every decode row — B-1 of
+B slots doing duplicate work in a regime that is weight-bandwidth
+bound, where tok/s/chip scales with the number of DISTINCT occupied
+slots. This module is the Orca-style iteration-level scheduler (the
+role vLLM's continuous batching plays over paged attention —
+PAPERS.md): up to `batch` concurrent requests occupy distinct decode
+slots, a freed slot is refilled from the queue between chunked decode
+scans, and the decode hot loop stays ONE XLA program per chunk shape
+regardless of the occupancy mix — admission changes DATA (masks,
+positions, per-slot keys), never the program.
+
+Mechanics (engine.py slot path):
+- each batch row of the cache is an independent slot; a new request
+  prefills into a scratch row and is copied over its slot
+  (Engine.prefill_into_slot) without touching live slots;
+- decode chunks run Engine.slot_chunk: per-row sampling keyed by
+  per-slot PRNG chains, per-row KV append at per-slot positions, and
+  per-row attention lengths (flash_decode kv_lens) — so every slot's
+  token chain is exactly a single-request Engine.serve() at its seed;
+- between chunks the host trims each slot's tokens to its remaining
+  budget, retires finished slots, and admits queued requests into the
+  freed rows while the other slots keep decoding mid-stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (the scheduler's admission unit)."""
+    rid: object                    # caller's id (any hashable)
+    ids: np.ndarray                # prompt token ids [S]
+    gen_len: int
+    seed: int = 0
+
+
+class DecodeSlots:
+    """Per-slot decode state: device-side carry (last logits, per-slot
+    position, active mask, per-slot PRNG keys) + host-side bookkeeping
+    (remaining gen budget, owning request). The device arrays are the
+    slot scan's carry — admission and retirement edit rows of them
+    between chunks."""
+
+    def __init__(self, engine, batch: int):
+        import jax
+        import jax.numpy as jnp
+        self.engine = engine
+        self.batch = batch
+        V = engine.model.config.vocab_size
+        self.cache = engine.make_slot_cache(batch)
+        self.logits = jnp.zeros((batch, V), jnp.float32)
+        self.pos = jnp.zeros((batch,), jnp.int32)
+        self.active = jnp.zeros((batch,), bool)
+        self.keys = (None if engine.sampling == "greedy"
+                     else jax.random.split(jax.random.key(0), batch))
+        # host mirrors (scheduling is host-side; the model never syncs)
+        self.remaining = np.zeros((batch,), np.int64)
+        self.rids: List[Optional[object]] = [None] * batch
+
+    @property
+    def free(self) -> List[int]:
+        return [b for b in range(self.batch) if self.rids[b] is None]
+
+    @property
+    def occupied(self) -> List[int]:
+        return [b for b in range(self.batch) if self.rids[b] is not None]
+
+    def admit(self, slot: int, req: Request) -> None:
+        """Prefill req into `slot` and arm its row of the carry. Only
+        the slot's rows change — live slots decode on, unaware."""
+        import jax
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        n = len(req.ids)
+        cap = self.cache.k[0].shape[2]
+        if n + req.gen_len > cap:
+            raise ValueError(
+                f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
+                f"exceeds slot capacity {cap}")
+        row, self.cache = self.engine.prefill_into_slot(
+            self.cache, slot, req.ids)
+        self.logits = self.logits.at[slot].set(row)
+        self.pos = self.pos.at[slot].set(n)
+        self.active = self.active.at[slot].set(True)
+        if self.keys is not None:
+            self.keys = self.keys.at[slot].set(jax.random.key(req.seed))
+        self.remaining[slot] = req.gen_len
+        self.rids[slot] = req.rid
+
+    def retire(self, slot: int) -> None:
+        """Free a slot: mask it out of the scan. Its cache row and
+        carry rows stay as dead data until the next admit overwrites
+        them."""
+        self.active = self.active.at[slot].set(False)
+        self.remaining[slot] = 0
+        self.rids[slot] = None
+
+    def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
+                                              List[Tuple[int, object]]]:
+        """Run one `chunk`-step slot scan. Returns ({slot: kept tokens
+        (trimmed to the slot's remaining budget)}, [(slot, rid) of
+        requests that just finished]). Finished slots are NOT retired
+        here — the caller streams their tail first, then retires."""
+        toks, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.slot_chunk(self.logits, self.cache, self.pos,
+                                   self.active, chunk=chunk,
+                                   keys=self.keys)
+        toks = np.asarray(toks)
+        out: Dict[int, np.ndarray] = {}
+        finished: List[Tuple[int, object]] = []
+        for b in self.occupied:
+            keep = int(min(self.remaining[b], chunk))
+            if keep:
+                out[b] = toks[b, :keep]
+                self.remaining[b] -= keep
+            if self.remaining[b] == 0:
+                finished.append((b, self.rids[b]))
+        return out, finished
+
+
+class ContinuousScheduler:
+    """Admit-from-queue / step_chunk / retire loop over DecodeSlots
+    (Orca iteration-level scheduling). Single-threaded on the model:
+    callers enqueue requests from any thread; one driver thread calls
+    poll() (or run()) and owns every jax dispatch."""
+
+    def __init__(self, engine, *, batch: int, chunk: int = 4):
+        self.slots = DecodeSlots(engine, batch)
+        self.chunk = chunk
+        self._queue: deque = deque()
+
+    def submit(self, req: Request) -> None:
+        self._queue.append(req)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self.slots.occupied
+
+    def poll(self) -> Tuple[Dict[object, np.ndarray], List[object]]:
+        """One scheduling iteration: refill free slots from the queue,
+        run one decode chunk, retire what finished. Returns
+        ({rid: new tokens}, [rids finished this chunk]). A request the
+        slots REJECT (e.g. prompt + gen beyond capacity) is reported as
+        finished with no tokens — one bad request must never take down
+        the serving loop (the old per-request server survived bad
+        clients too)."""
+        rejected: List[object] = []
+        for slot in self.slots.free:
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            try:
+                self.slots.admit(slot, req)
+            except ValueError as e:
+                import sys
+                print(f"[scheduler] rejected request {req.rid!r}: {e}",
+                      file=sys.stderr)
+                rejected.append(req.rid)
+        if not self.slots.occupied:
+            return {}, rejected
+        by_slot, finished = self.slots.step_chunk(self.chunk)
+        rid_of = self.slots.rids
+        out = {rid_of[b]: t for b, t in by_slot.items()}
+        done = rejected
+        for b, rid in finished:
+            self.slots.retire(b)
+            done.append(rid)
+        return out, done
+
+    def run(self, requests) -> Dict[object, np.ndarray]:
+        """Drive a batch of requests to completion (the test/bench
+        harness loop; a server calls poll() itself to interleave
+        streaming I/O). Returns {rid: tokens [gen_len]}."""
+        for r in requests:
+            self.submit(r)
+        acc: Dict[object, list] = {r.rid: [] for r in requests}
+        while not self.idle:
+            out, _ = self.poll()
+            for rid, toks in out.items():
+                acc[rid].extend(toks.tolist())
+        return {rid: np.asarray(t, np.int64) for rid, t in acc.items()}

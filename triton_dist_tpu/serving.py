@@ -1,5 +1,5 @@
-"""Socket serving: a minimal streaming token server + client over the
-Engine.
+"""Socket serving: a continuously-batched streaming token server +
+client over the Engine.
 
 TPU re-design of the reference's serving pair
 (`mega_triton_kernel/test/models/model_server.py:265` — a TCP server
@@ -12,11 +12,15 @@ TCP:
             {"done": true, "n_tokens": int}\n          terminator
 
 Tokens stream INCREMENTALLY: the decode runs in chunks of `chunk`
-steps (each chunk one jitted scan, carrying (logits, cache) across
-chunks), so the client renders text while the model is still
-generating — the reference's streaming UX without its per-token Python
-loop. Greedy chunked decode is token-exact vs the single-scan path
-(same argmax chain); sampled decode draws one fresh key per chunk.
+steps (each chunk one jitted scan), so clients render text while the
+model is still generating. The server is MULTI-CLIENT (continuous
+batching, models/scheduler.py): up to `batch` concurrent requests
+decode in distinct slots of one slot scan — distinct prompts, per-slot
+positions and PRNG chains — and a finished client's slot is refilled
+from the accept queue between chunks while the other streams keep
+flowing. Chunked decode is token-exact vs Engine.serve() in BOTH
+sampling modes (greedy: same argmax chain; sampled: the scan's evolved
+key chains across chunks).
 """
 
 from __future__ import annotations
@@ -48,8 +52,11 @@ def decode_stream(engine, logits, cache, gen_len: int, *, chunk: int = 4,
     """Yield token chunks [B, <=chunk] as they are generated: each chunk
     is one jitted decode scan, with (logits, cache) carried between
     chunks (the cache is donated into each scan, so memory stays flat).
-    Greedy chunking is exact — the argmax chain is identical to one
-    gen_len-long scan."""
+    Chunking is exact in BOTH modes: greedy because the argmax chain is
+    identical to one gen_len-long scan, and sampled because the scan
+    returns its evolved PRNG key and the next chunk resumes the chain —
+    the sampled stream equals Engine.serve() at the same seed for every
+    chunk size (it used to re-split a fresh key per chunk and diverge)."""
     import jax
     if engine.backend == "mega":
         raise ValueError("mega decode carries no resumable logits; "
@@ -62,80 +69,165 @@ def decode_stream(engine, logits, cache, gen_len: int, *, chunk: int = 4,
             toks, logits, cache = engine._decode_scan(
                 engine.model, logits, cache, gen_len=g)
         else:
-            key, sub = jax.random.split(key)
-            toks, logits, cache = engine._decode_scan(
-                engine.model, logits, cache, sub, gen_len=g)
+            toks, logits, cache, key = engine._decode_scan(
+                engine.model, logits, cache, key, gen_len=g)
         yield np.asarray(toks)
         done += g
 
 
 class TokenServer:
-    """Accept prompts, prefill, stream decode chunks back (reference:
-    model_server.py's request loop). One request at a time — the model
-    owns the chip; concurrency is batching, not threads."""
+    """Accept prompts, stream decode chunks back (reference:
+    model_server.py's request loop), now CONTINUOUSLY BATCHED: up to
+    `batch` clients decode concurrently, each in its own slot of the
+    scheduler (models/scheduler.py) — distinct requests, distinct KV
+    rows, one jitted slot scan per chunk. A freed slot is refilled
+    from the connection queue between chunks while the other clients'
+    streams keep flowing. Still single-threaded ON THE MODEL: socket
+    threads only parse requests and write replies; every jax dispatch
+    happens on the serve_forever thread (concurrency is batching, not
+    model threads — the discipline the old one-request loop had, kept)."""
 
     def __init__(self, engine, tokenizer, *, batch: int,
                  host: str = "127.0.0.1", port: int = 0,
                  chunk: int = 4):
+        from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
         self.batch = batch
         self.chunk = chunk
+        self.sched = ContinuousScheduler(engine, batch=batch, chunk=chunk)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(4)
+        self._sock.listen(max(4, batch))
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
+        self._next_rid = 0
+        self._conns: dict = {}          # rid -> _ClientStream
+        self._lock = threading.Lock()   # guards scheduler submit + _conns
 
-    def handle(self, conn: socket.socket) -> None:
-        conn.settimeout(60.0)     # a silent client cannot pin the loop
-        with conn, conn.makefile("rw") as f:
+    class _ClientStream:
+        """Per-connection state: the socket + reply file handle + token
+        count. Owned by the model loop after admission; the reader
+        thread only hands it over."""
+
+        def __init__(self, conn, fh):
+            self.conn = conn
+            self.fh = fh
+            self.n = 0
+            self.dead = False
+
+    def _reader(self, conn: socket.socket) -> None:
+        """Connection thread: parse ONE request line, enqueue it for
+        the model loop, leave the socket open for streaming replies."""
+        import sys
+        from triton_dist_tpu.models.scheduler import Request
+        try:
+            conn.settimeout(60.0)   # a silent client cannot hold a slot
+            f = conn.makefile("rw")
             line = f.readline()
             if not line.strip():
+                conn.close()
                 return
             req = json.loads(line)
             ids = self.tok.encode(req.get("prompt", "")) or [0]
             gen_len = int(req.get("gen_len", 16))
+            # clamp to slot capacity (prompt + gen must fit max_seq);
+            # a prompt with no room for even one token is refused here
+            # with a visible error instead of occupying a slot
+            cap = self.engine.max_seq - len(ids)
+            if cap < 1:
+                f.write(json.dumps({
+                    "done": True, "n_tokens": 0,
+                    "error": f"prompt of {len(ids)} tokens exceeds "
+                             f"capacity {self.engine.max_seq - 1}"}) + "\n")
+                f.flush()
+                conn.close()
+                return
+            gen_len = max(1, min(gen_len, cap))
             seed = int(req.get("seed", 0))
-            x = np.tile(np.asarray(ids, np.int32)[None], (self.batch, 1))
-            logits, cache = self.engine.prefill(x)
-            n = 0
-            for toks in decode_stream(self.engine, logits, cache,
-                                      gen_len, chunk=self.chunk,
-                                      seed=seed):
-                row = [int(t) for t in toks[0]]
-                f.write(json.dumps(
-                    {"text": self.tok.decode(row),
-                     "token_ids": row}) + "\n")
-                f.flush()           # the stream is the point
-                n += len(row)
-            f.write(json.dumps({"done": True, "n_tokens": n}) + "\n")
-            f.flush()
+            with self._lock:
+                rid = self._next_rid
+                self._next_rid += 1
+                self._conns[rid] = self._ClientStream(conn, f)
+                self.sched.submit(Request(
+                    rid=rid, ids=np.asarray(ids, np.int32),
+                    gen_len=gen_len, seed=seed))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"[TokenServer] bad request: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            conn.close()
+
+    def _emit(self, rid, toks) -> None:
+        """Stream one chunk's tokens to the owning client; a dead
+        socket marks the stream dead (its slot keeps decoding to
+        gen_len — simplest correct policy; the tokens fall on the
+        floor)."""
+        cs = self._conns.get(rid)
+        if cs is None or cs.dead:
+            return
+        row = [int(t) for t in toks]
+        try:
+            cs.fh.write(json.dumps({"text": self.tok.decode(row),
+                                    "token_ids": row}) + "\n")
+            cs.fh.flush()           # the stream is the point
+            cs.n += len(row)
+        except OSError:
+            cs.dead = True
+
+    def _finish(self, rid) -> None:
+        cs = self._conns.pop(rid, None)
+        if cs is None:
+            return
+        try:
+            if not cs.dead:
+                cs.fh.write(json.dumps({"done": True,
+                                        "n_tokens": cs.n}) + "\n")
+                cs.fh.flush()
+        except OSError:
+            pass
+        for closer in (cs.fh.close, cs.conn.close):
+            try:
+                closer()
+            except OSError:
+                pass
 
     def serve_forever(self, max_requests: Optional[int] = None) -> None:
-        import sys
-        served = 0
-        self._sock.settimeout(0.5)
+        """Model loop: accept connections (handing each to a reader
+        thread), then run the scheduler — admit, one chunk, stream each
+        slot's tokens to its client. max_requests counts COMPLETED
+        requests (so a test can serve N concurrent clients and exit)."""
+        done_count = 0
+        self._sock.settimeout(0.02)
         try:
             while not self._stop.is_set():
-                try:
-                    conn, _ = self._sock.accept()
-                except socket.timeout:
-                    continue
-                try:
-                    self.handle(conn)
-                except (OSError, ValueError, KeyError) as e:
-                    # malformed request / client gone mid-stream: log,
-                    # keep serving (the reference server's loop survives
-                    # bad clients too)
-                    print(f"[TokenServer] request failed: "
-                          f"{type(e).__name__}: {e}", file=sys.stderr)
-                served += 1
-                if max_requests is not None and served >= max_requests:
+                # drain the accept queue without blocking the decode
+                # loop (reader threads are daemonic and short-lived:
+                # one request line each, no tracking needed)
+                while True:
+                    try:
+                        conn, _ = self._sock.accept()
+                    except socket.timeout:
+                        break
+                    threading.Thread(target=self._reader, args=(conn,),
+                                     daemon=True).start()
+                with self._lock:
+                    out, finished = self.sched.poll()
+                for rid, toks in out.items():
+                    self._emit(rid, toks)
+                for rid in finished:
+                    self._finish(rid)
+                    done_count += 1
+                if max_requests is not None and done_count >= max_requests:
                     break
+                if self.sched.idle:
+                    # nothing in flight: sleep on accept instead of
+                    # spinning the poll loop
+                    self._stop.wait(0.05)
         finally:
             self._sock.close()
+            for rid in list(self._conns):
+                self._finish(rid)
 
     def stop(self) -> None:
         self._stop.set()
