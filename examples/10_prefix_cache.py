@@ -1,0 +1,78 @@
+"""Shared-prefix KV cache: N clients sharing a system prompt reuse its
+cached KV pages instead of each re-prefilling it.
+
+The radix tree (triton_dist_tpu/models/prefix_cache.py) keys cached KV
+pages by token ids: the first admission prefills the whole prompt and
+inserts its pages; every later prompt sharing the system-prompt head
+maps those pages READ-ONLY into its slot's page table (refcount +1),
+copy-on-writes the partially-matched boundary page, and computes only
+its own suffix (Engine.admit_slot_paged's prefill-from-offset). Token
+streams are bitwise identical to running with the cache disabled — the
+demo asserts it — while the printed counters show most prefill work
+disappearing.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from triton_dist_tpu.models import (AutoLLM, ContinuousScheduler,
+                                        Engine, Request)
+    from triton_dist_tpu.models.config import tiny_qwen3
+    from triton_dist_tpu.runtime import initialize_distributed
+    from triton_dist_tpu.serving import ByteTokenizer
+
+    ctx = initialize_distributed()
+    n = ctx.tp_size()
+    cfg = tiny_qwen3(n)
+    model = AutoLLM.from_config(cfg, ctx.mesh)
+    eng = Engine(model, max_seq=96, backend="xla")
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    system = "System: you are a terse, helpful TPU assistant. "
+    questions = ["What is a page table?", "Why radix trees?",
+                 "Who refcounts the refcounters?", "Evict me, maybe",
+                 "One more, shared", "And the last one."]
+    reqs = [Request(rid=i, ids=np.asarray(tok.encode(system + q),
+                                          np.int32), gen_len=10)
+            for i, q in enumerate(questions)]
+
+    runs = {}
+    for pc_on in (False, True):
+        sched = ContinuousScheduler(eng, batch=3, chunk=4, paged=True,
+                                    prefix_cache=pc_on, page=16)
+        t0 = time.perf_counter()
+        runs[pc_on] = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        if pc_on:
+            st = sched.stats()
+            print(f"{len(reqs)} clients sharing a "
+                  f"{len(tok.encode(system))}-token system prompt "
+                  f"({dt:.2f}s):")
+            print(f"  hit rate          {st['hit_rate']:.2f} "
+                  f"({st['hits']}/{st['admissions']} admissions)")
+            print(f"  prefill skipped   {st['prefill_tokens_skipped']} "
+                  f"of {st['prompt_tokens']} prompt tokens "
+                  f"({st['prefill_skip_frac']:.0%})")
+            print(f"  pages in use      {st['pages_in_use']} "
+                  f"(+{st['pages_free']} free), "
+                  f"{st['evictions']} evictions")
+
+    # the whole point: sharing must be invisible in the tokens
+    for r in reqs:
+        assert np.array_equal(runs[True][r.rid], runs[False][r.rid]), (
+            f"client {r.rid}: cache-on stream diverged from cache-off")
+    print("token streams bitwise identical with the cache on and off")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

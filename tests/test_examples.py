@@ -38,6 +38,10 @@ def test_continuous_batching_example_runs():
     _run_example("09_continuous_batching.py")
 
 
+def test_prefix_cache_example_runs():
+    _run_example("10_prefix_cache.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

@@ -205,3 +205,59 @@ def test_page_allocator_exhaustion():
         raise AssertionError("over-allocation must raise")
     alloc.free([0, 1])
     assert alloc.available == 3
+
+
+def test_page_allocator_rejects_double_free():
+    """A double-freed page would be handed to two slots and silently
+    corrupt the pool — the allocator must refuse, both for a page
+    already on the free list and for a duplicate within one call."""
+    alloc = PageAllocator(4)
+    pages = alloc.alloc(2)
+    alloc.free([pages[0]])
+    for bad in ([pages[0]],                 # already free
+                [pages[1], pages[1]]):      # duplicate in one call
+        try:
+            alloc.free(bad)
+        except ValueError as e:
+            assert "double free" in str(e)
+        else:
+            raise AssertionError(f"double free {bad} must raise")
+    # the failed calls must not have corrupted the pool
+    assert alloc.available + alloc.outstanding == alloc.num_pages
+    alloc.free([pages[1]])
+    assert alloc.available == 4
+
+
+def test_page_allocator_rejects_out_of_range_free():
+    alloc = PageAllocator(4)
+    alloc.alloc(1)
+    for bad in (-1, 4, 7):
+        try:
+            alloc.free([bad])
+        except ValueError as e:
+            assert "out-of-range" in str(e)
+        else:
+            raise AssertionError(f"free({bad}) must raise")
+    assert alloc.available + alloc.outstanding == alloc.num_pages
+
+
+def test_page_allocator_in_use_invariant():
+    """available + outstanding == num_pages through a mixed
+    alloc/free workload (the conservation law a corrupted free list
+    breaks first)."""
+    rng = np.random.RandomState(0)
+    alloc = PageAllocator(32)
+    held = []
+    for _ in range(200):
+        if held and rng.rand() < 0.5:
+            k = rng.randint(1, len(held) + 1)
+            back, held = held[:k], held[k:]
+            alloc.free(back)
+        else:
+            want = int(rng.randint(1, 5))
+            if want <= alloc.available:
+                held.extend(alloc.alloc(want))
+        assert alloc.available + alloc.outstanding == alloc.num_pages
+        assert alloc.outstanding == len(held)
+    alloc.free(held)
+    assert alloc.available == 32 and alloc.outstanding == 0

@@ -131,3 +131,116 @@ def test_token_server_multi_client_concurrent():
                     and spans[j][0] < spans[i][1]), (
                 f"clients {i},{j} did not stream concurrently: "
                 f"{spans[i]} vs {spans[j]}")
+
+
+def _tiny_engine_1dev(**kw):
+    m = jax.make_mesh((1,), ("tp",))
+    cfg = tiny_qwen3(1)
+    model = AutoLLM.from_config(cfg, m)
+    return cfg, Engine(model, **kw)
+
+
+def test_token_server_paged_prefix_cache():
+    """The paged server with the shared-prefix radix cache: N clients
+    sharing one system prompt stream token-exact greedy outputs, the
+    done message reports the cache counters, and the skip counter shows
+    the shared prefix was prefilled once, not N times."""
+    import threading
+
+    from triton_dist_tpu.serving import TokenServer, request_stream
+
+    cfg, eng = _tiny_engine_1dev(max_seq=64, backend="xla")
+    tok = ByteTokenizer(cfg.vocab_size)
+    system = "You are a helpful tpu. "
+    prompts = [system + t for t in ("alpha", "beta!", "gamma?")]
+    N, gen = len(prompts), 12
+    srv = TokenServer(eng, tok, batch=2, chunk=4, paged=True,
+                      prefix_cache=True, page=8)
+    server_thread = threading.Thread(
+        target=srv.serve_forever, kwargs=dict(max_requests=N),
+        daemon=True)
+    server_thread.start()
+
+    results = {}
+    dones = {}
+
+    def client(i):
+        toks = []
+        for msg in request_stream("127.0.0.1", srv.port, prompts[i],
+                                  gen_len=gen):
+            if msg.get("done"):
+                dones[i] = msg
+                break
+            toks.extend(msg["token_ids"])
+        results[i] = toks
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    srv.stop()
+    server_thread.join(timeout=60)
+
+    for i in range(N):
+        ids = np.asarray(tok.encode(prompts[i]), np.int32)
+        want = np.asarray(eng.serve(np.tile(ids[None], (2, 1)), gen))[0]
+        np.testing.assert_array_equal(np.asarray(results[i]), want,
+                                      err_msg=f"client {i}")
+        assert "cache" in dones[i], dones[i]
+    st = srv.stats()
+    # the system prompt is len(system)=23 tokens; 2 of 3 admissions
+    # must have reused it (>= 23 - page each)
+    assert st["hits"] >= 2, st
+    assert st["prefill_tokens_skipped"] >= 2 * (len(system) - 8), st
+
+
+def test_token_server_cancel_on_disconnect():
+    """A client that hangs up mid-stream must have its slot CANCELLED
+    (not decoded to gen_len with tokens falling on the floor): with a
+    single slot, a second client can only ever complete if the dead
+    first client's slot was retired early."""
+    import threading
+
+    from triton_dist_tpu.serving import TokenServer, request_stream
+
+    cfg, eng = _tiny_engine_1dev(max_seq=256, backend="xla")
+    tok = ByteTokenizer(cfg.vocab_size)
+    srv = TokenServer(eng, tok, batch=1, chunk=2, paged=True,
+                      prefix_cache=True, page=8)
+    server_thread = threading.Thread(
+        target=srv.serve_forever, kwargs=dict(max_requests=2),
+        daemon=True)
+    server_thread.start()
+
+    # client 1: asks for a very long generation, reads ONE chunk, hangs up
+    import json
+    import socket
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=60)
+    f = s.makefile("rw")
+    f.write(json.dumps({"prompt": "doomed client", "gen_len": 200}) + "\n")
+    f.flush()
+    first = json.loads(f.readline())
+    assert first.get("token_ids"), first
+    f.close()
+    s.close()                       # hang up mid-stream
+
+    # client 2: must get a complete stream through the SAME single slot
+    got = []
+    for msg in request_stream("127.0.0.1", srv.port, "second client",
+                              gen_len=8, timeout=120):
+        if msg.get("done"):
+            break
+        got.extend(msg["token_ids"])
+    srv.stop()
+    server_thread.join(timeout=60)
+    ids = np.asarray(tok.encode("second client"), np.int32)
+    want = np.asarray(eng.serve(ids[None], 8))[0]
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # the dead stream was cancelled, not decoded to gen_len=200: the
+    # prefix tree holds its prompt + the few tokens generated before
+    # the hangup, nowhere near the ~27 pages a full 200-token run
+    # would have inserted
+    st = srv.stats()
+    assert st["pages_in_use"] < 15, st

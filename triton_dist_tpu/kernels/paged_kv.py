@@ -341,10 +341,24 @@ class PageAllocator:
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, -1, -1))  # pop() -> page 0 first
+        self._in_use = set()
 
     @property
     def available(self) -> int:
         return len(self._free)
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._in_use)
+
+    def _check(self) -> None:
+        """Pool conservation invariant: every page is on the free list
+        XOR outstanding. A violation means the bookkeeping corrupted
+        the pool (the failure mode a double-free used to cause
+        silently: one physical page handed to two slots)."""
+        assert len(self._free) + len(self._in_use) == self.num_pages, (
+            f"page pool corrupted: {len(self._free)} free + "
+            f"{len(self._in_use)} in use != {self.num_pages}")
 
     def alloc(self, n: int):
         """Take n pages off the free list (raises when the pool is
@@ -352,10 +366,30 @@ class PageAllocator:
         if n > len(self._free):
             raise ValueError(
                 f"page pool exhausted: want {n}, have {len(self._free)}")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._in_use.update(out)
+        self._check()
+        return out
 
     def free(self, pages) -> None:
-        self._free.extend(pages)
+        """Return pages to the free list. Rejects out-of-range ids and
+        double-frees BEFORE touching the pool — a double-freed page
+        would be handed to two slots, and the second slot's writes
+        would silently corrupt the first's KV."""
+        pages = [int(p) for p in pages]
+        seen = set()
+        for p in pages:
+            if not 0 <= p < self.num_pages:
+                raise ValueError(
+                    f"free of out-of-range page {p} "
+                    f"(pool has {self.num_pages})")
+            if p not in self._in_use or p in seen:
+                raise ValueError(f"double free of page {p}")
+            seen.add(p)
+        for p in pages:
+            self._in_use.remove(p)
+            self._free.append(p)
+        self._check()
 
     def alloc_slot(self, Hkv: int, n_positions: int, page: int):
         """Pages for one slot: Hkv streams x ceil(n_positions/page)

@@ -535,6 +535,87 @@ class TP_Attn:
         out = f(qkv, *kv, jnp.asarray(pos, jnp.int32))
         return out[0], tuple(out[1:])
 
+    def _split_qkv_global(self, qkv, S: int = 1):
+        """Unpack a GLOBAL packed [q|k|v] projection into per-head q/k/v
+        [B, S, H, d]. The packed column layout is n per-rank blocks
+        [q_r | k_r | v_r] (shard_cols_packed), so the global split
+        de-interleaves the rank blocks; heads come out rank-major —
+        exactly the global head order the column-parallel w_o expects."""
+        n = self.mesh.shape[self.axis]
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        B = qkv.shape[0] // S
+        blk = (hq + 2 * hkv) * hd
+        r = qkv.reshape(B, S, n, blk)
+        q = r[..., :hq * hd].reshape(B, S, n * hq, hd)
+        k = r[..., hq * hd:(hq + hkv) * hd].reshape(B, S, n * hkv, hd)
+        v = r[..., (hq + hkv) * hd:].reshape(B, S, n * hkv, hd)
+        return q, k, v
+
+    def _attend_paged_slots(self, qkv, cos, sin, batch: int, kv, table,
+                            pos, impl: str = "flash"):
+        """Paged-pool variant of _attend_cached_slots (prefix-cache
+        serving, models/prefix_cache.py): row b's new K/V lands in the
+        physical page its table row maps for position pos[b], and
+        attention walks the pool through the table (flash_decode_paged,
+        or a gather + contiguous oracle under impl="ref").
+
+        kv: (pages_k, pages_v) [NP, page, d] — ONE layer's pool;
+        table: [B*Hkv, max_pages] int32 shared by all layers. The pool
+        is REPLICATED and this attend runs at the global level (GSPMD
+        partitions it; a head-sharded pool with per-rank allocators is
+        an open item), so on multi-chip meshes the paged path trades
+        the hand-overlapped comm kernels for allocation flexibility —
+        the single-chip serving regime is where paging earns its keep.
+        """
+        from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
+        from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+        hd = self.head_dim
+        Hkv = self.n_kv_heads
+        scale = hd ** -0.5
+        ck, cv = kv
+        page = ck.shape[1]
+        B = qkv.shape[0]
+        q, k, v = self._split_qkv_global(qkv)        # [B, 1, H, d]
+        if self.q_norm is not None:
+            q = rms_norm(q, self.q_norm)
+        if self.k_norm is not None:
+            k = rms_norm(k, self.k_norm)
+        pos = jnp.asarray(pos, jnp.int32)
+        q = apply_rope_slots(q, cos, sin, pos)
+        k = apply_rope_slots(k, cos, sin, pos)
+        X = B * Hkv
+        pos_x = jnp.repeat(pos, Hkv)                     # [X]
+        pidx = table[jnp.arange(X), pos_x // page]
+        r = pos_x % page
+        ck = ck.at[pidx, r].set(k.reshape(X, hd).astype(ck.dtype))
+        cv = cv.at[pidx, r].set(v.reshape(X, hd).astype(cv.dtype))
+        lens = pos + 1
+        if impl == "flash":
+            o = flash_decode_paged(q.astype(ck.dtype), ck, cv, table,
+                                   jnp.max(lens), scale=scale,
+                                   kv_lens=lens)
+        else:
+            T = table.shape[1] * page
+            kfull = ck[table].reshape(B, Hkv, T, hd)
+            vfull = cv[table].reshape(B, Hkv, T, hd)
+            o = attention_cached_ref(q.astype(ck.dtype), kfull, vfull,
+                                     lens, scale=scale)
+        return o.reshape(B, self.n_heads * hd), (ck, cv)
+
+    def fwd_cached_slots_paged(self, x, cos, sin, batch: int, kv, table,
+                               pos, mode: str = "flash"):
+        """Slot-masked decode attention block over the PAGED pool
+        (shared-prefix serving): same contract as fwd_cached_slots, but
+        row b's KV cache is whatever physical pages its table row maps
+        — possibly pages shared read-only with other slots' prefixes.
+        Decode only ever writes at pos[b] (past any shared prefix), so
+        read-only sharing needs no device-side enforcement."""
+        impl = "ref" if mode == "xla" else "flash"
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_paged_slots(qkv, cos, sin, batch, kv,
+                                         table, pos, impl)
+        return self._o_proj(o, mode), kv
+
     def _qkv_proj(self, x, mode: str):
         """Mode-dispatched QKV projection (the prologue both cached
         forwards share): "dist" = AG-GEMM on row-sharded x; every other

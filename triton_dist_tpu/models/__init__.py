@@ -8,9 +8,11 @@ from triton_dist_tpu.models.config import (ModelConfig, qwen3_30b_a3b,  # noqa: 
                                            tiny_qwen3_moe)
 from triton_dist_tpu.models.dense import DenseLLM  # noqa: F401
 from triton_dist_tpu.models.engine import Engine  # noqa: F401
-from triton_dist_tpu.models.kv_cache import KVCache  # noqa: F401
+from triton_dist_tpu.models.kv_cache import KVCache, PagedSlotCache  # noqa: F401
+from triton_dist_tpu.models.prefix_cache import PrefixCache  # noqa: F401
 from triton_dist_tpu.models.scheduler import (ContinuousScheduler,  # noqa: F401
-                                              DecodeSlots, Request)
+                                              DecodeSlots,
+                                              PagedDecodeSlots, Request)
 
 
 class AutoLLM:

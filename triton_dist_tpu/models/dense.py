@@ -239,6 +239,36 @@ class DenseLLM:
         logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
         return logits, cache
 
+    def forward_tokens_slots_paged(self, ids, pcache, pos,
+                                   mode: str = "flash",
+                                   mlp_mode: Optional[str] = None):
+        """Slot-masked decode forward over the PAGED KV pool
+        (shared-prefix serving, models/prefix_cache.py): identical math
+        to forward_tokens_slots, but each layer's KV lives in physical
+        pages behind the shared page table — slot b attends whatever
+        pages its table row maps, including pages shared read-only with
+        other slots' cached prefixes. ids: [B, 1]; pos: [B] int32;
+        pcache: PagedSlotCache. Returns (logits [B, V], pcache)."""
+        B, S = ids.shape
+        assert S == 1, "slot decode feeds one token per slot"
+        mlp_mode = mlp_mode or mode
+        x = self.embed[ids].reshape(B, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, (ck, cv) = layer.attn.fwd_cached_slots_paged(
+                h, self.cos, self.sin, B, pcache.layer(li),
+                pcache.table, pos, mode)
+            pcache = pcache.set_layer(li, ck, cv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp(h, mlp_mode)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "dist":
+            x = self._gather_rows(x)
+        from triton_dist_tpu.kernels.quant import qmm
+        logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
+        return logits, pcache
+
     def forward_train(self, ids, mode: str = "train"):
         """Training forward (no KV cache): full-causal attention over
         each sequence, all-position logits [B, S, V].

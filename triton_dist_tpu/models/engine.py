@@ -142,6 +142,26 @@ class Engine:
             # persistent 1-row scratch for prefill_into_slot, donated
             # through each admission instead of reallocated per request
             self._slot_scratch = None
+            # paged slot path (shared-prefix serving,
+            # models/prefix_cache.py): admission program (table install
+            # + copy-on-write + prefix gather + suffix prefill-from-
+            # offset + KV scatter), chunked slot scan over the paged
+            # pool, and the retire-time table reset. All lazy-compiled.
+            paged_fn = (functools.partial(_paged_slot_scan_decode_fn,
+                                          backend)
+                        if sampling == "greedy" else
+                        functools.partial(_sampled_paged_slot_scan_fn,
+                                          backend, sampling,
+                                          self._sample_params))
+            self._paged_slot_scan = jax.jit(
+                paged_fn, static_argnames=("gen_len",), donate_argnums=(2,))
+            self._paged_admit = jax.jit(
+                functools.partial(_paged_admit_fn,
+                                  mode=self.prefill_backend),
+                donate_argnums=(2, 3))
+            self._paged_set_table = jax.jit(_paged_set_table_fn,
+                                            donate_argnums=(0,))
+            self._paged_scratch = None
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -237,6 +257,114 @@ class Engine:
         toks, logits, cache, pos, keys = self._slot_scan(
             self.model, logits, cache, pos, active, keys, gen_len=chunk)
         return toks, logits, cache, pos, keys
+
+
+    # ------------------------------------------------------------------
+    # paged slot path (shared-prefix serving; models/prefix_cache.py
+    # owns the policy — radix tree, refcounts, eviction — and drives
+    # these device-side entry points through the scheduler)
+    # ------------------------------------------------------------------
+
+    def make_paged_slot_cache(self, batch: int, *, page: int = 16,
+                              num_pages: Optional[int] = None):
+        """Paged slot cache: per-layer physical pools behind ONE shared
+        page table (kv_cache.PagedSlotCache). num_pages defaults to the
+        no-sharing worst case (every slot full) + the reserved trash
+        page; pass fewer to let prefix sharing carry the load (and the
+        LRU evictor handle the pressure)."""
+        from triton_dist_tpu.models.kv_cache import PagedSlotCache
+        if self.backend == "mega":
+            raise ValueError("backend='mega' has no resumable slot "
+                             "state; paged serving uses the per-op "
+                             "backends")
+        if self.kv_dtype is not None and \
+                jnp.dtype(self.kv_dtype) == jnp.int8:
+            raise ValueError(
+                "paged slot serving stores the raw-dtype pool; paging "
+                "the int8 cache's per-position scales is an open item")
+        if not hasattr(self.model, "forward_tokens_slots_paged"):
+            raise ValueError(
+                f"{type(self.model).__name__} has no paged slot decode "
+                "path (dense models only)")
+        cfg = self.model.config
+        maxp = -(-self.max_seq // page)
+        if num_pages is None:
+            num_pages = batch * cfg.num_kv_heads * maxp + 1
+        return PagedSlotCache.create(
+            cfg.num_layers, batch, self.max_seq, cfg.num_kv_heads,
+            cfg.head_dim, page=page, num_pages=num_pages,
+            mesh=self.model.mesh,
+            dtype=self.kv_dtype or cfg.jax_dtype)
+
+    def admit_slot_paged(self, pcache, slot: int, ids, rows,
+                         kv_start: int, cow_src, cow_dst, cow_rows: int,
+                         *, pad_to: int = 8):
+        """Admit one request into paged slot `slot`, reusing a cached
+        prefix of `kv_start` tokens (prefill-from-offset: ONLY the
+        n - kv_start uncached suffix tokens are computed, bucketed to
+        `pad_to` like prefill_into_slot).
+
+        rows: [Hkv, max_pages] int32 — the slot's full table row block
+        (shared prefix pages + fresh writable pages, trash-padded).
+        cow_src/cow_dst: [Hkv] page groups for the copy-on-write of a
+        partially-matched boundary page (cow_rows valid rows are copied
+        src -> dst before anything reads the slot's table; pass the
+        trash page for both when kv_start is page-aligned).
+
+        Returns (next-token logits [V], pcache). One XLA program per
+        suffix bucket; kv_start/slot/cow are traced data.
+        """
+        ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        n = int(ids.shape[0])
+        m = int(kv_start)
+        if not 0 <= m < n:
+            raise ValueError(f"kv_start {m} out of range for prompt {n}"
+                             " (the last token is always recomputed)")
+        T_pool = pcache.capacity
+        if n > T_pool:
+            raise ValueError(
+                f"prompt length {n} exceeds slot capacity {T_pool}")
+        s = n - m
+        P = -(-s // pad_to) * pad_to
+        padded = jnp.zeros((1, P), jnp.int32).at[0, :s].set(ids[m:])
+        scr = self._paged_scratch
+        if scr is None or scr.k[0].shape[2] != T_pool + pad_to:
+            # scratch holds [prefix | suffix bucket]; the + pad_to tail
+            # keeps the bucketed DUS in range at every kv_start
+            self._paged_scratch = self.model.make_cache(
+                1, T_pool + pad_to, dtype=self.kv_dtype)
+        logits, self._paged_scratch, pcache = self._paged_admit(
+            self.model, padded, self._paged_scratch, pcache,
+            jnp.asarray(rows, jnp.int32), jnp.int32(slot),
+            jnp.int32(m), jnp.int32(n),
+            jnp.asarray(cow_src, jnp.int32),
+            jnp.asarray(cow_dst, jnp.int32), jnp.int32(cow_rows))
+        return logits[0], pcache
+
+    def paged_slot_chunk(self, logits, pcache, pos, active, *,
+                         chunk: int, keys=None):
+        """slot_chunk over the paged pool: identical contract, but each
+        row's KV scatter resolves through the page table (a retired
+        row's table maps the trash page, so its masked-out writes can
+        never touch a live or cached page)."""
+        if self.sampling == "greedy":
+            assert keys is None
+            toks, logits, pcache, pos = self._paged_slot_scan(
+                self.model, logits, pcache, pos, active, gen_len=chunk)
+            return toks, logits, pcache, pos, None
+        toks, logits, pcache, pos, keys = self._paged_slot_scan(
+            self.model, logits, pcache, pos, active, keys, gen_len=chunk)
+        return toks, logits, pcache, pos, keys
+
+    def retire_slot_paged(self, pcache, slot: int):
+        """Point the whole table row block of a retired slot at the
+        trash page (the write sink): the slot scan keeps stepping
+        masked rows, and their scatters must never land on a page the
+        allocator may have handed to someone else."""
+        Hkv = self.model.config.num_kv_heads
+        rows = jnp.full((Hkv, pcache.table.shape[1]), pcache.trash,
+                        jnp.int32)
+        return self._paged_set_table(pcache, rows, jnp.int32(slot))
 
 
 def _prefill_fn(model, ids, cache, *, mode):
@@ -335,6 +463,127 @@ def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
     (logits, cache, pos, keys), toks = jax.lax.scan(
         step, (logits0, cache, pos, keys), None, length=gen_len)
     return toks.T, logits, cache, pos, keys          # [B, gen_len]
+
+
+def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
+                    cow_src, cow_dst, cow_r, *, mode):
+    """Paged admission program (one per suffix bucket): install the
+    slot's table rows, copy-on-write the partially-matched boundary
+    page, gather the slot's mapped pages into the contiguous scratch,
+    run the suffix forward from offset m (the prefill-from-offset —
+    positions [m, n) only), and scatter the computed suffix KV back
+    into the slot's writable pages (pad-bucket tail rows are redirected
+    to the trash page)."""
+    import dataclasses
+    page = pcache.page
+    Hkv, maxp = rows.shape
+    T_pool = maxp * page
+    d = pcache.pages_k[0].shape[2]
+    table = jax.lax.dynamic_update_slice(pcache.table, rows,
+                                         (slot * Hkv, 0))
+    rowmask = (jnp.arange(page) < cow_r)[None, :, None]
+    S_pad = ids.shape[1]
+    p = m + jnp.arange(S_pad)
+    valid = p < n
+    pi = jnp.minimum(p // page, maxp - 1)
+    ri = p % page
+    dest = jnp.where(valid[None], rows[:, pi], pcache.trash)  # [Hkv, S_pad]
+    pk, pv = list(pcache.pages_k), list(pcache.pages_v)
+    sk, sv = list(scratch.k), list(scratch.v)
+    for li in range(len(pk)):
+        pk[li] = pk[li].at[cow_dst].set(
+            jnp.where(rowmask, pk[li][cow_src], pk[li][cow_dst]))
+        pv[li] = pv[li].at[cow_dst].set(
+            jnp.where(rowmask, pv[li][cow_src], pv[li][cow_dst]))
+        kf = pk[li][rows].reshape(1, Hkv, T_pool, d)
+        vf = pv[li][rows].reshape(1, Hkv, T_pool, d)
+        sk[li] = jax.lax.dynamic_update_slice(
+            sk[li], kf.astype(sk[li].dtype), (0, 0, 0, 0))
+        sv[li] = jax.lax.dynamic_update_slice(
+            sv[li], vf.astype(sv[li].dtype), (0, 0, 0, 0))
+    scratch = dataclasses.replace(scratch, k=tuple(sk), v=tuple(sv),
+                                  offset=m)
+    logits, scratch = model.forward_tokens(ids, scratch, mode=mode,
+                                           last_pos=(n - 1) - m)
+    pk2, pv2 = [], []
+    for li in range(len(pk)):
+        ks = jax.lax.dynamic_slice(scratch.k[li], (0, 0, m, 0),
+                                   (1, Hkv, S_pad, d))[0]
+        vs = jax.lax.dynamic_slice(scratch.v[li], (0, 0, m, 0),
+                                   (1, Hkv, S_pad, d))[0]
+        pk2.append(pk[li].at[dest, ri[None]].set(ks.astype(pk[li].dtype)))
+        pv2.append(pv[li].at[dest, ri[None]].set(vs.astype(pv[li].dtype)))
+    pcache = dataclasses.replace(pcache, pages_k=tuple(pk2),
+                                 pages_v=tuple(pv2), table=table)
+    return logits, scratch, pcache
+
+
+def _paged_set_table_fn(pcache, rows, slot):
+    import dataclasses
+    Hkv = rows.shape[0]
+    table = jax.lax.dynamic_update_slice(pcache.table, rows,
+                                         (slot * Hkv, 0))
+    return dataclasses.replace(pcache, table=table)
+
+
+def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,
+                               active, *, gen_len: int):
+    """Greedy slot-masked decode chunk over the PAGED pool: same shape
+    as _slot_scan_decode_fn with the per-row KV scatter and attention
+    resolved through the page table."""
+    act = active.astype(jnp.int32)
+    cap = pcache.capacity
+
+    def step(carry, _):
+        logits, pc, pos = carry
+        tok = jnp.argmax(logits, axis=-1)
+        tok = jnp.where(active, tok, 0)
+        logits, pc = model.forward_tokens_slots_paged(tok[:, None], pc,
+                                                      pos, mode=backend)
+        pos = jnp.minimum(pos + act, cap - 1)
+        return (logits, pc, pos), tok
+
+    (logits, pcache, pos), toks = jax.lax.scan(
+        step, (logits0, pcache, pos), None, length=gen_len)
+    return toks.T, logits, pcache, pos                # [B, gen_len]
+
+
+def _sampled_paged_slot_scan_fn(backend, sampling, params, model,
+                                logits0, pcache, pos, active, keys, *,
+                                gen_len: int):
+    """Sampled paged slot chunk: per-slot PRNG chains exactly as in
+    _sampled_slot_scan_decode_fn — the sampler never sees the cache
+    layout, so paged streams equal contiguous streams token for token
+    whenever the logits do."""
+    from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
+
+    temp = max(params["temperature"], 0.0)
+    act = active.astype(jnp.int32)
+    cap = pcache.capacity
+
+    def sample_one(k, logits):
+        if temp == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        if sampling == "top_k":
+            return sample_top_k(k, logits, k=params["k"],
+                                temperature=temp)
+        return sample_top_p(k, logits, p=params["p"], temperature=temp)
+
+    def step(carry, _):
+        logits, pc, pos, keys = carry
+        split = jax.vmap(functools.partial(jax.random.split, num=2))
+        ks = split(keys)
+        keys, subs = ks[:, 0], ks[:, 1]
+        tok = jax.vmap(sample_one)(subs, logits)
+        tok = jnp.where(active, tok, 0)
+        logits, pc = model.forward_tokens_slots_paged(tok[:, None], pc,
+                                                      pos, mode=backend)
+        pos = jnp.minimum(pos + act, cap - 1)
+        return (logits, pc, pos, keys), tok
+
+    (logits, pcache, pos, keys), toks = jax.lax.scan(
+        step, (logits0, pcache, pos, keys), None, length=gen_len)
+    return toks.T, logits, pcache, pos, keys          # [B, gen_len]
 
 
 def _scan_decode_fn(backend, model, logits0, cache, *, gen_len: int):

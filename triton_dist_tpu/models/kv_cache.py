@@ -93,3 +93,69 @@ class KVCache:
 
     def advance(self, n) -> "KVCache":
         return dataclasses.replace(self, offset=self.offset + n)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedSlotCache:
+    """Multi-layer paged KV cache for the continuous-batching slot path
+    (models/prefix_cache.py policy over kernels/paged_kv.py mechanics).
+
+    Per-layer physical pools pages_k/v [NP, page, d] (one page = `page`
+    contiguous positions of ONE (slot, kv-head) stream) behind ONE
+    shared page table [B*Hkv, max_pages]: a physical page id means the
+    same row in EVERY layer's pool, so the host allocator hands out one
+    [Hkv] page-id group per logical tile and it covers all layers.
+    That is what makes cross-request prefix sharing cheap: mapping a
+    cached prefix into a slot is a table edit, not a KV copy.
+
+    Page id `trash` (row 0 by convention, reserved by the allocator) is
+    the write sink for retired/dead slots: the slot scan keeps stepping
+    masked-out rows, and their KV scatter must land somewhere that no
+    live slot ever maps — retiring a slot points its whole table row at
+    trash so its surplus writes can never corrupt a reused page."""
+
+    pages_k: Tuple[jax.Array, ...]   # L x [NP, page, d]
+    pages_v: Tuple[jax.Array, ...]
+    table: jax.Array                 # [B*Hkv, max_pages] int32
+    trash: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    @staticmethod
+    def create(num_layers: int, batch: int, max_seq: int, n_kv_heads: int,
+               head_dim: int, *, page: int, num_pages: int, mesh: Mesh,
+               dtype=jnp.bfloat16, trash: int = 0) -> "PagedSlotCache":
+        maxp = -(-max_seq // page)
+        X = batch * n_kv_heads
+        rep = NamedSharding(mesh, P(None, None, None))
+        mk = lambda: tuple(
+            jax.device_put(jnp.zeros((num_pages, page, head_dim), dtype),
+                           rep)
+            for _ in range(num_layers))
+        table = jax.device_put(
+            jnp.full((X, maxp), trash, jnp.int32),
+            NamedSharding(mesh, P(None, None)))
+        return PagedSlotCache(pages_k=mk(), pages_v=mk(), table=table,
+                              trash=trash)
+
+    @property
+    def page(self) -> int:
+        return self.pages_k[0].shape[1]
+
+    @property
+    def num_pages(self) -> int:
+        return self.pages_k[0].shape[0]
+
+    @property
+    def capacity(self) -> int:
+        """Logical positions addressable per slot (table width x page)."""
+        return self.table.shape[1] * self.page
+
+    def layer(self, idx: int):
+        return self.pages_k[idx], self.pages_v[idx]
+
+    def set_layer(self, idx: int, ck, cv) -> "PagedSlotCache":
+        def put(t, x):
+            return t[:idx] + (x,) + t[idx + 1:]
+
+        return dataclasses.replace(self, pages_k=put(self.pages_k, ck),
+                                   pages_v=put(self.pages_v, cv))

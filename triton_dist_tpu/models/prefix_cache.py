@@ -1,0 +1,375 @@
+"""Shared-prefix KV cache: radix-tree page reuse with refcounts,
+copy-on-write, and LRU eviction.
+
+The serving stack's missing policy layer over the paged pool
+(kernels/paged_kv.py mechanics + kv_cache.PagedSlotCache layout): in a
+multi-tenant server most prefill work is re-computing KV for prompts
+that share a system prompt or few-shot header. vLLM's PagedAttention
+makes physical sharing cheap (a page-granular pool behind per-slot
+tables); SGLang's RadixAttention turns that sharing into AUTOMATIC
+cross-request reuse by keying a radix tree on token ids. This module is
+that pair for the TPU serving stack:
+
+- `RefcountedPages`: a refcount layer over the hardened `PageAllocator`
+  free list. A physical page may back many slots' page tables AND many
+  tree nodes at once; it returns to the free list only at refcount
+  zero. Pages are handed out in [Hkv] GROUPS (one page per kv-head
+  stream of a logical tile) because one page id means the same row in
+  every layer's pool (PagedSlotCache) — a group is the sharing unit.
+
+- `RadixPrefixTree`: token-granular radix tree whose nodes carry the
+  page groups backing their span. Matching a new prompt returns the
+  longest cached prefix and the groups to map read-only into the
+  slot's table; the LAST group is only partially valid when the match
+  ends mid-page — the admission copy-on-writes it into a fresh page
+  (the boundary page will receive the diverging request's own writes,
+  which must never touch the shared original). Node splits on insert
+  may leave a boundary page referenced by two nodes — refcounts make
+  that safe. Retired sequences (prompt + generated) are inserted back,
+  donating the slot's page refs to the tree.
+
+- LRU eviction: when an admission would exhaust the pool, the least
+  recently matched leaves are evicted until enough pages free up (or
+  nothing evictable remains, and the admission is rejected). Evicting
+  a node only drops the TREE's refs — pages still mapped by in-flight
+  slots survive until those slots retire.
+
+Exactness contract (tests/test_prefix_cache.py): reused prefix KV is
+bitwise the KV the donor request computed for the same (token, position)
+pairs, and the suffix forward runs the same program as a cache-off
+admission with kv_start as traced data — so cache-on token streams are
+bitwise identical to cache-off, greedy and sampled, including under
+eviction pressure.
+
+All host-side numpy: policy changes page TABLES (data), never programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from triton_dist_tpu.kernels.paged_kv import PageAllocator
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _common_prefix(a: np.ndarray, b: np.ndarray) -> int:
+    L = min(len(a), len(b))
+    if L == 0:
+        return 0
+    neq = np.nonzero(a[:L] != b[:L])[0]
+    return int(neq[0]) if len(neq) else L
+
+
+class RefcountedPages:
+    """Refcounting layer over the PageAllocator free list (the
+    "physical page backs many tables" half of the design). The trash
+    page is reserved at construction and never refcounted — it is the
+    write sink for retired slots, not storage."""
+
+    def __init__(self, num_pages: int, n_kv_heads: int):
+        self._alloc = PageAllocator(num_pages)
+        self.n_kv_heads = n_kv_heads
+        self._ref: Dict[int, int] = {}
+        self.trash = self._alloc.alloc(1)[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self._alloc.num_pages
+
+    @property
+    def available(self) -> int:
+        return self._alloc.available
+
+    @property
+    def pages_in_use(self) -> int:
+        return len(self._ref)
+
+    def alloc_group(self) -> np.ndarray:
+        """One fresh writable group ([Hkv] page ids at refcount 1)."""
+        g = np.asarray(self._alloc.alloc(self.n_kv_heads), np.int32)
+        for p in g:
+            self._ref[int(p)] = 1
+        return g
+
+    def retain(self, group) -> None:
+        for p in group:
+            self._ref[int(p)] += 1
+
+    def release(self, group) -> None:
+        """Drop one ref per page of the group; pages at zero go back to
+        the free list (the allocator re-checks double-frees)."""
+        freed = []
+        for p in group:
+            p = int(p)
+            c = self._ref[p] - 1
+            if c:
+                self._ref[p] = c
+            else:
+                del self._ref[p]
+                freed.append(p)
+        if freed:
+            self._alloc.free(freed)
+
+    def refcount(self, page) -> int:
+        return self._ref.get(int(page), 0)
+
+
+class _Node:
+    """One radix-tree edge: tokens `key` spanning absolute positions
+    [start, start + len(key)), backed by `groups` — one [Hkv] page
+    group per page index floor(start/page) .. ceil(end/page)-1. When
+    start is mid-page the first group is a page SHARED in span with the
+    parent's last group (the same physical page after a pure split, or
+    the diverging request's copy-on-write page)."""
+
+    __slots__ = ("parent", "children", "start", "key", "groups",
+                 "last_use")
+
+    def __init__(self, parent: Optional["_Node"], start: int,
+                 key: np.ndarray, groups: List[np.ndarray]):
+        self.parent = parent
+        self.children: Dict[int, "_Node"] = {}
+        self.start = start
+        self.key = key
+        self.groups = groups
+        self.last_use = 0
+
+
+class RadixPrefixTree:
+    """Token-keyed radix tree over the refcounted page pool. Each node
+    holds one pool ref per group it references; matching never touches
+    refcounts (callers retain what they map)."""
+
+    def __init__(self, pool: RefcountedPages, page: int):
+        self.pool = pool
+        self.page = page
+        self.root = _Node(None, 0, np.zeros((0,), np.int32), [])
+        self._tick = 0
+        self.evictions = 0
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_use = self._tick
+
+    # ------------------------------------------------------------------
+    # match
+    # ------------------------------------------------------------------
+
+    def match(self, tokens, cap: Optional[int] = None
+              ) -> Tuple[int, List[np.ndarray]]:
+        """Longest cached prefix of `tokens` (≤ cap): returns
+        (m, groups) with groups covering page indices
+        0 .. ceil(m/page)-1. When m is mid-page the last group is only
+        partially valid — the caller must copy-on-write it before the
+        slot writes anything. Touches the matched path for LRU."""
+        tokens = np.asarray(tokens, np.int32)
+        node = self.root
+        m = 0
+        groups: List[np.ndarray] = []
+        while m < len(tokens):
+            child = node.children.get(int(tokens[m]))
+            if child is None:
+                break
+            L = _common_prefix(child.key, tokens[m:m + len(child.key)])
+            if child.start % self.page:
+                # the child's first group is its own complete version
+                # of the boundary page (see _Node docstring) — it
+                # overrides the parent's
+                groups.pop()
+            first_pg = child.start // self.page
+            n_pg = _ceil_div(child.start + L, self.page) - first_pg
+            groups.extend(child.groups[:n_pg])
+            m += L
+            self._touch(child)
+            if L < len(child.key):
+                break
+            node = child
+        if cap is not None and m > cap:
+            m = cap
+            groups = groups[:_ceil_div(m, self.page)]
+        return m, groups
+
+    # ------------------------------------------------------------------
+    # insert
+    # ------------------------------------------------------------------
+
+    def insert(self, tokens, groups_by_page: List[np.ndarray]) -> int:
+        """Insert a finished sequence (prompt + generated): walk the
+        matched path, split a node if the sequence diverges inside it,
+        and attach the unmatched suffix as a new leaf whose groups are
+        the caller's pages for that span (the tree RETAINS them — the
+        caller keeps its own refs and releases them at retire). Returns
+        the number of newly cached tokens."""
+        tokens = np.asarray(tokens, np.int32)
+        node = self.root
+        m = 0
+        while m < len(tokens):
+            child = node.children.get(int(tokens[m]))
+            if child is None:
+                leaf_groups = [
+                    np.asarray(g, np.int32).copy()
+                    for g in groups_by_page[m // self.page:
+                                            _ceil_div(len(tokens),
+                                                      self.page)]]
+                leaf = _Node(node, m, tokens[m:].copy(), leaf_groups)
+                for g in leaf_groups:
+                    self.pool.retain(g)
+                node.children[int(tokens[m])] = leaf
+                self._touch(leaf)
+                return len(tokens) - m
+            L = _common_prefix(child.key, tokens[m:m + len(child.key)])
+            self._touch(child)
+            if L < len(child.key):
+                if m + L == len(tokens):
+                    return 0          # sequence ends inside the node
+                child = self._split(child, L)    # descend into the head
+            m += L
+            node = child
+        return 0
+
+    def _split(self, child: _Node, L: int) -> "_Node":
+        """Split `child` at key offset L into head [start, start+L) +
+        tail [start+L, end): the tail keeps the node object (so its
+        children stay wired), the head takes its place under the
+        parent. A mid-page split leaves the boundary page referenced by
+        BOTH nodes — one extra pool ref covers the second reference."""
+        s = child.start
+        cut = s + L
+        first_pg = s // self.page
+        head_groups = child.groups[:_ceil_div(cut, self.page) - first_pg]
+        head = _Node(child.parent, s, child.key[:L], head_groups)
+        head.last_use = child.last_use
+        child.parent.children[int(child.key[0])] = head
+        tail_first = cut // self.page
+        child.groups = child.groups[tail_first - first_pg:]
+        child.start = cut
+        child.key = child.key[L:]
+        child.parent = head
+        head.children[int(child.key[0])] = child
+        if cut % self.page:
+            # boundary page now appears in head.groups[-1] AND
+            # child.groups[0] (same physical page)
+            self.pool.retain(head.groups[-1])
+        return head
+
+    # ------------------------------------------------------------------
+    # LRU eviction
+    # ------------------------------------------------------------------
+
+    def evict_until(self, pages_needed: int) -> bool:
+        """Evict least-recently-matched leaves until the allocator has
+        `pages_needed` free pages (or nothing evictable remains —
+        returns False, the admission's rejection signal). Releasing a
+        leaf's groups only drops the tree's refs; a page still mapped
+        read-only by an in-flight slot stays allocated until that slot
+        retires.
+
+        One tree walk seeds a min-heap of leaves by last_use; a parent
+        joins the heap the moment its last child is evicted — O(n +
+        k log n) for k evictions instead of a full rescan per leaf."""
+        import heapq
+        if self.pool.available >= pages_needed:
+            return True
+        heap = []
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            if nd is not self.root and not nd.children:
+                heap.append((nd.last_use, id(nd), nd))
+            stack.extend(nd.children.values())
+        heapq.heapify(heap)
+        while self.pool.available < pages_needed and heap:
+            _, _, leaf = heapq.heappop(heap)
+            parent = leaf.parent
+            for g in leaf.groups:
+                self.pool.release(g)
+            del parent.children[int(leaf.key[0])]
+            self.evictions += 1
+            if parent is not self.root and not parent.children:
+                heapq.heappush(heap, (parent.last_use, id(parent),
+                                      parent))
+        return self.pool.available >= pages_needed
+
+    # introspection (tests)
+
+    def nodes(self) -> List[_Node]:
+        out = []
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            if nd is not self.root:
+                out.append(nd)
+            stack.extend(nd.children.values())
+        return out
+
+
+class PrefixCache:
+    """The serving-facing facade: pool + tree + hit/skip counters.
+    `enabled=False` keeps the identical pool/alloc path but never
+    matches or inserts — the cache-off configuration runs the SAME
+    device programs, which is what makes the bitwise cache-on/off
+    comparison meaningful."""
+
+    def __init__(self, num_pages: int, n_kv_heads: int, page: int, *,
+                 enabled: bool = True):
+        self.pool = RefcountedPages(num_pages, n_kv_heads)
+        self.page = page
+        self.enabled = enabled
+        self.tree = RadixPrefixTree(self.pool, page)
+        self.admissions = 0
+        self.hits = 0
+        self.prompt_tokens = 0
+        self.prefill_tokens_skipped = 0
+        self.tokens_inserted = 0
+
+    def lookup(self, prompt) -> Tuple[int, List[np.ndarray]]:
+        """Longest cached prefix for an admission (capped to n-1: the
+        last prompt token is always recomputed so the slot has fresh
+        next-token logits)."""
+        if not self.enabled:
+            return 0, []
+        return self.tree.match(prompt, cap=max(len(prompt) - 1, 0))
+
+    def record(self, n_prompt: int, n_matched: int) -> None:
+        """Count one SUCCESSFUL admission (rejected requests don't
+        skew the hit/skip rates)."""
+        self.admissions += 1
+        self.prompt_tokens += n_prompt
+        self.prefill_tokens_skipped += n_matched
+        self.hits += bool(n_matched)
+
+    def insert(self, tokens, groups_by_page) -> int:
+        if not self.enabled:
+            return 0
+        new = self.tree.insert(tokens, groups_by_page)
+        self.tokens_inserted += new
+        return new
+
+    def ensure_pages(self, n_pages: int) -> bool:
+        """Free-list headroom for an admission: evict LRU leaves when
+        short. False = not satisfiable (reject the admission)."""
+        if self.pool.available >= n_pages:
+            return True
+        if not self.enabled:
+            return False
+        return self.tree.evict_until(n_pages)
+
+    def stats(self) -> dict:
+        total = max(self.prompt_tokens, 1)
+        return {
+            "enabled": self.enabled,
+            "admissions": self.admissions,
+            "hits": self.hits,
+            "hit_rate": self.hits / max(self.admissions, 1),
+            "prompt_tokens": self.prompt_tokens,
+            "prefill_tokens_skipped": self.prefill_tokens_skipped,
+            "prefill_skip_frac": self.prefill_tokens_skipped / total,
+            "evictions": self.tree.evictions,
+            "pages_in_use": self.pool.pages_in_use,
+            "pages_free": self.pool.available,
+        }

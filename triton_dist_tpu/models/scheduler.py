@@ -58,7 +58,7 @@ class DecodeSlots:
         self.engine = engine
         self.batch = batch
         V = engine.model.config.vocab_size
-        self.cache = engine.make_slot_cache(batch)
+        self.cache = self._make_cache()
         self.logits = jnp.zeros((batch, V), jnp.float32)
         self.pos = jnp.zeros((batch,), jnp.int32)
         self.active = jnp.zeros((batch,), bool)
@@ -68,6 +68,15 @@ class DecodeSlots:
         self.remaining = np.zeros((batch,), np.int64)
         self.rids: List[Optional[object]] = [None] * batch
 
+    def _make_cache(self):
+        """Cache-flavor hook (PagedDecodeSlots swaps in the paged pool)."""
+        return self.engine.make_slot_cache(self.batch)
+
+    @property
+    def capacity(self) -> int:
+        """Admittable prompt+gen budget per slot."""
+        return self.cache.k[0].shape[2]
+
     @property
     def free(self) -> List[int]:
         return [b for b in range(self.batch) if self.rids[b] is None]
@@ -76,26 +85,31 @@ class DecodeSlots:
     def occupied(self) -> List[int]:
         return [b for b in range(self.batch) if self.rids[b] is not None]
 
-    def admit(self, slot: int, req: Request) -> None:
-        """Prefill req into `slot` and arm its row of the carry. Only
-        the slot's rows change — live slots decode on, unaware."""
+    def _arm_slot(self, slot: int, req: Request, row_logits, n: int
+                  ) -> None:
+        """Arm a freshly prefilled slot's rows of the decode carry
+        (shared by the contiguous and paged admit paths)."""
         import jax
-        assert self.rids[slot] is None, f"slot {slot} is occupied"
-        n = len(req.ids)
-        cap = self.cache.k[0].shape[2]
-        if n + req.gen_len > cap:
-            raise ValueError(
-                f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
-                f"exceeds slot capacity {cap}")
-        row, self.cache = self.engine.prefill_into_slot(
-            self.cache, slot, req.ids)
-        self.logits = self.logits.at[slot].set(row)
+        self.logits = self.logits.at[slot].set(row_logits)
         self.pos = self.pos.at[slot].set(n)
         self.active = self.active.at[slot].set(True)
         if self.keys is not None:
             self.keys = self.keys.at[slot].set(jax.random.key(req.seed))
         self.remaining[slot] = req.gen_len
         self.rids[slot] = req.rid
+
+    def admit(self, slot: int, req: Request) -> None:
+        """Prefill req into `slot` and arm its row of the carry. Only
+        the slot's rows change — live slots decode on, unaware."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        n = len(req.ids)
+        if n + req.gen_len > self.capacity:
+            raise ValueError(
+                f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
+                f"exceeds slot capacity {self.capacity}")
+        row, self.cache = self.engine.prefill_into_slot(
+            self.cache, slot, req.ids)
+        self._arm_slot(slot, req, row, n)
 
     def retire(self, slot: int) -> None:
         """Free a slot: mask it out of the scan. Its cache row and
@@ -105,17 +119,26 @@ class DecodeSlots:
         self.remaining[slot] = 0
         self.rids[slot] = None
 
+    def _run_chunk(self, chunk: int) -> np.ndarray:
+        """Engine-call hook: one chunk of the slot scan (paged variant
+        swaps in paged_slot_chunk)."""
+        toks, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.slot_chunk(self.logits, self.cache, self.pos,
+                                   self.active, chunk=chunk,
+                                   keys=self.keys)
+        return np.asarray(toks)
+
+    def _record(self, slot: int, toks) -> None:
+        """Hook: paged slots record kept tokens for the retire-time
+        prefix-tree insert; the contiguous path keeps nothing."""
+
     def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
                                               List[Tuple[int, object]]]:
         """Run one `chunk`-step slot scan. Returns ({slot: kept tokens
         (trimmed to the slot's remaining budget)}, [(slot, rid) of
         requests that just finished]). Finished slots are NOT retired
         here — the caller streams their tail first, then retires."""
-        toks, self.logits, self.cache, self.pos, self.keys = \
-            self.engine.slot_chunk(self.logits, self.cache, self.pos,
-                                   self.active, chunk=chunk,
-                                   keys=self.keys)
-        toks = np.asarray(toks)
+        toks = self._run_chunk(chunk)
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
         for b in self.occupied:
@@ -123,9 +146,156 @@ class DecodeSlots:
             if keep:
                 out[b] = toks[b, :keep]
                 self.remaining[b] -= keep
+                self._record(b, toks[b, :keep])
             if self.remaining[b] == 0:
                 finished.append((b, self.rids[b]))
         return out, finished
+
+
+class PagedDecodeSlots(DecodeSlots):
+    """DecodeSlots over the PAGED pool with the shared-prefix radix
+    cache (models/prefix_cache.py): admission consults the radix tree
+    for the longest cached prefix, maps those pages READ-ONLY into the
+    slot's table rows (refcount +1 each), copy-on-writes the partially
+    matched boundary page, and prefills ONLY the uncached suffix
+    (engine.admit_slot_paged's prefill-from-offset). Retirement inserts
+    the finished sequence (prompt + generated) back into the tree —
+    donating the slot's pages — so the NEXT request sharing the prefix
+    skips that prefill work. With prefix_cache=False the same programs
+    run with a never-matching tree (the bitwise cache-off reference).
+
+    margin: the slot scan keeps stepping a finished slot to its chunk
+    boundary; those surplus writes land in the slot's own reserved
+    pages (or the trash page past its table rows), so every admission
+    reserves capacity for prompt + gen + margin - 1 positions. Pass
+    the scheduler's chunk."""
+
+    def __init__(self, engine, batch: int, *, page: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True, margin: int = 4):
+        from triton_dist_tpu.models.prefix_cache import PrefixCache
+        self.page = page
+        self.margin = margin
+        self._num_pages = num_pages
+        super().__init__(engine, batch)
+        Hkv = engine.model.config.num_kv_heads
+        self.prefix = PrefixCache(self.cache.num_pages, Hkv, page,
+                                  enabled=prefix_cache)
+        # both sides reserve the same trash page (pool page 0)
+        assert self.prefix.pool.trash == self.cache.trash
+        # per-slot host mirrors: mapped page groups (absolute page
+        # order) and the token stream (prompt + kept generated) whose
+        # KV those pages hold — the retire-time tree insert
+        self._groups: List[List[np.ndarray]] = [[] for _ in range(batch)]
+        self._tokens: List[List[int]] = [[] for _ in range(batch)]
+
+    def _make_cache(self):
+        return self.engine.make_paged_slot_cache(
+            self.batch, page=self.page, num_pages=self._num_pages)
+
+    @property
+    def capacity(self) -> int:
+        """Admittable prompt+gen budget (table capacity minus the
+        chunk-surplus margin)."""
+        return self.cache.capacity - self.margin + 1
+
+    @property
+    def stats(self) -> dict:
+        return self.prefix.stats()
+
+    def admit(self, slot: int, req: Request) -> None:
+        """Consult the radix tree, map the cached prefix read-only,
+        allocate fresh writable pages for the rest (evicting LRU tree
+        leaves under pressure), and prefill the uncached suffix."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        tokens = np.asarray(req.ids, np.int32).reshape(-1)
+        n = len(tokens)
+        if n == 0:
+            # reject before touching the pool: the suffix forward needs
+            # at least one token (and a zero-length prompt would leak
+            # the refs retained below when the engine refused it)
+            raise ValueError(f"request {req.rid!r}: empty prompt")
+        if n + req.gen_len > self.capacity:
+            raise ValueError(
+                f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
+                f"exceeds slot capacity {self.capacity}")
+        pool = self.prefix.pool
+        m, shared = self.prefix.lookup(tokens)
+        full, r = m // self.page, m % self.page
+        retained: List[np.ndarray] = []
+        fresh: List[np.ndarray] = []
+        try:
+            # pin everything the admission program will read BEFORE
+            # eviction can run
+            for g in shared[:full]:
+                pool.retain(g)
+                retained.append(g)
+            boundary = shared[full] if r else None
+            if boundary is not None:
+                pool.retain(boundary)
+                retained.append(boundary)
+            need = -(-(n + req.gen_len + self.margin - 1)
+                     // self.page) - full
+            if not self.prefix.ensure_pages(need * pool.n_kv_heads):
+                raise ValueError(
+                    f"request {req.rid!r}: page pool exhausted "
+                    f"({need} fresh groups needed, "
+                    f"{pool.available} pages free, nothing evictable)")
+            fresh = [pool.alloc_group() for _ in range(need)]
+        except ValueError:
+            for g in fresh + retained:
+                pool.release(g)
+            raise
+        slot_groups = list(shared[:full]) + fresh
+        Hkv, maxp = pool.n_kv_heads, self.cache.table.shape[1]
+        rows = np.full((Hkv, maxp), self.cache.trash, np.int32)
+        for j, g in enumerate(slot_groups):
+            rows[:, j] = g
+        trash_vec = np.full((Hkv,), self.cache.trash, np.int32)
+        cow_src = boundary if r else trash_vec
+        cow_dst = fresh[0] if r else trash_vec
+        row, self.cache = self.engine.admit_slot_paged(
+            self.cache, slot, tokens, rows, m, cow_src, cow_dst, r)
+        if boundary is not None:
+            # only the CoW copy read it; the slot maps its own copy
+            pool.release(boundary)
+        self._arm_slot(slot, req, row, n)
+        self._groups[slot] = slot_groups
+        self._tokens[slot] = tokens.tolist()
+        self.prefix.record(n, m)
+        # insert the PROMPT pages now (not just at retire): the next
+        # admission — even one in the same poll — can already share
+        # them. N clients connecting at once with one system prompt is
+        # the headline case, and they must not all prefill it.
+        self.prefix.insert(tokens, slot_groups[:-(-n // self.page)])
+
+    def retire(self, slot: int) -> None:
+        """Insert the finished sequence back into the tree (the pages
+        already hold its KV — insertion is pure bookkeeping), release
+        the slot's page refs, and point its table rows at the trash
+        page so the masked-out scan rows can never write into a page
+        the allocator hands to someone else."""
+        if self._tokens[slot]:
+            npg = -(-len(self._tokens[slot]) // self.page)
+            self.prefix.insert(
+                np.asarray(self._tokens[slot], np.int32),
+                self._groups[slot][:npg])
+        for g in self._groups[slot]:
+            self.prefix.pool.release(g)
+        self.cache = self.engine.retire_slot_paged(self.cache, slot)
+        self._groups[slot] = []
+        self._tokens[slot] = []
+        super().retire(slot)
+
+    def _run_chunk(self, chunk: int) -> np.ndarray:
+        toks, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.paged_slot_chunk(self.logits, self.cache,
+                                         self.pos, self.active,
+                                         chunk=chunk, keys=self.keys)
+        return np.asarray(toks)
+
+    def _record(self, slot: int, toks) -> None:
+        self._tokens[slot].extend(int(t) for t in toks)
 
 
 class ContinuousScheduler:
@@ -134,13 +304,53 @@ class ContinuousScheduler:
     callers enqueue requests from any thread; one driver thread calls
     poll() (or run()) and owns every jax dispatch."""
 
-    def __init__(self, engine, *, batch: int, chunk: int = 4):
-        self.slots = DecodeSlots(engine, batch)
+    def __init__(self, engine, *, batch: int, chunk: int = 4,
+                 paged: bool = False, prefix_cache: bool = True,
+                 page: int = 16, num_pages: Optional[int] = None):
+        """paged=True serves over the paged KV pool with the
+        shared-prefix radix cache (models/prefix_cache.py): admissions
+        reuse cached prefix pages and skip that prefill work;
+        prefix_cache=False keeps the paged pool but never shares (the
+        bitwise cache-off reference). num_pages sizes the pool (default:
+        worst case, no sharing needed to fit `batch` full slots)."""
+        if paged:
+            self.slots = PagedDecodeSlots(
+                engine, batch, page=page, num_pages=num_pages,
+                prefix_cache=prefix_cache, margin=chunk)
+        else:
+            self.slots = DecodeSlots(engine, batch)
         self.chunk = chunk
         self._queue: deque = deque()
+        # rid -> rejection reason for requests the slots refused (the
+        # serving layer pops these to tell the client WHY it got zero
+        # tokens instead of a success-shaped empty stream)
+        self.rejected: Dict[object, str] = {}
 
     def submit(self, req: Request) -> None:
         self._queue.append(req)
+
+    def cancel(self, rid) -> bool:
+        """Drop a request mid-flight (cancel-on-disconnect): a queued
+        request is removed; an in-flight one retires NOW — its slot,
+        carry rows and (paged) pages free immediately instead of
+        decoding to gen_len with the tokens falling on the floor. The
+        tokens generated so far are still valid, so a paged retire
+        inserts them into the prefix tree as usual. Returns False for
+        an unknown/finished rid."""
+        for i, r in enumerate(self._queue):
+            if r.rid == rid:
+                del self._queue[i]
+                return True
+        for b in self.slots.occupied:
+            if self.slots.rids[b] == rid:
+                self.slots.retire(b)
+                return True
+        return False
+
+    def stats(self) -> dict:
+        """Prefix-cache hit/skip counters (empty for the contiguous
+        slot path)."""
+        return getattr(self.slots, "stats", {})
 
     @property
     def idle(self) -> bool:
@@ -165,6 +375,12 @@ class ContinuousScheduler:
                 import sys
                 print(f"[scheduler] rejected request {req.rid!r}: {e}",
                       file=sys.stderr)
+                self.rejected[req.rid] = str(e)
+                while len(self.rejected) > 1024:
+                    # bound the side channel: callers that never read
+                    # reasons (run()/bench loops) must not leak — drop
+                    # oldest first (dict preserves insertion order)
+                    self.rejected.pop(next(iter(self.rejected)))
                 rejected.append(req.rid)
         if not self.slots.occupied:
             return {}, rejected

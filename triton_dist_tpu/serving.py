@@ -21,6 +21,16 @@ from the accept queue between chunks while the other streams keep
 flowing. Chunked decode is token-exact vs Engine.serve() in BOTH
 sampling modes (greedy: same argmax chain; sampled: the scan's evolved
 key chains across chunks).
+
+paged=True additionally serves over the paged KV pool with the
+SHARED-PREFIX radix cache (models/prefix_cache.py): prompts sharing a
+system-prompt/few-shot prefix reuse its cached KV pages and skip that
+prefill work — token streams stay bitwise identical to prefix_cache=
+False. The final {"done": ...} message then reports a "cache" dict
+(hit rate, prefill tokens skipped). Clients that hang up mid-stream
+are detected (EOF probe or failed write) and their slot is CANCELLED —
+pages freed and the partial sequence inserted into the prefix tree —
+instead of decoding to gen_len for nobody.
 """
 
 from __future__ import annotations
@@ -89,13 +99,24 @@ class TokenServer:
 
     def __init__(self, engine, tokenizer, *, batch: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 chunk: int = 4):
+                 chunk: int = 4, paged: bool = False,
+                 prefix_cache: bool = True, page: int = 16,
+                 num_pages: Optional[int] = None):
+        """paged=True serves over the paged KV pool with the
+        shared-prefix radix cache (models/prefix_cache.py): concurrent
+        prompts sharing a system-prompt/few-shot prefix reuse its
+        cached KV pages and skip that prefill; the final {"done": ...}
+        message then carries a "cache" dict (hit rate, prefill tokens
+        skipped) and stats() exposes the running counters."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
         self.batch = batch
         self.chunk = chunk
-        self.sched = ContinuousScheduler(engine, batch=batch, chunk=chunk)
+        self.paged = paged
+        self.sched = ContinuousScheduler(
+            engine, batch=batch, chunk=chunk, paged=paged,
+            prefix_cache=prefix_cache, page=page, num_pages=num_pages)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -132,15 +153,16 @@ class TokenServer:
             req = json.loads(line)
             ids = self.tok.encode(req.get("prompt", "")) or [0]
             gen_len = int(req.get("gen_len", 16))
-            # clamp to slot capacity (prompt + gen must fit max_seq);
+            # clamp to slot capacity (prompt + gen must fit the slot);
             # a prompt with no room for even one token is refused here
             # with a visible error instead of occupying a slot
-            cap = self.engine.max_seq - len(ids)
+            slot_cap = self.sched.slots.capacity
+            cap = slot_cap - len(ids)
             if cap < 1:
                 f.write(json.dumps({
                     "done": True, "n_tokens": 0,
                     "error": f"prompt of {len(ids)} tokens exceeds "
-                             f"capacity {self.engine.max_seq - 1}"}) + "\n")
+                             f"capacity {slot_cap - 1}"}) + "\n")
                 f.flush()
                 conn.close()
                 return
@@ -160,9 +182,9 @@ class TokenServer:
 
     def _emit(self, rid, toks) -> None:
         """Stream one chunk's tokens to the owning client; a dead
-        socket marks the stream dead (its slot keeps decoding to
-        gen_len — simplest correct policy; the tokens fall on the
-        floor)."""
+        socket marks the stream dead — the model loop then CANCELS its
+        slot (sched.cancel) instead of decoding to gen_len with the
+        tokens falling on the floor."""
         cs = self._conns.get(rid)
         if cs is None or cs.dead:
             return
@@ -175,14 +197,59 @@ class TokenServer:
         except OSError:
             cs.dead = True
 
+    def _probe_disconnects(self) -> None:
+        """Detect clients that hung up WITHOUT a failed write: after
+        the request line a client never sends again, so a non-blocking
+        recv returning b'' is EOF — mark the stream dead so the model
+        loop cancels its slot this iteration."""
+        for cs in list(self._conns.values()):
+            if cs.dead:
+                continue
+            try:
+                timeout = cs.conn.gettimeout()
+            except OSError:
+                cs.dead = True
+                continue
+            try:
+                cs.conn.setblocking(False)
+                if cs.conn.recv(1) == b"":
+                    cs.dead = True
+            except (BlockingIOError, InterruptedError):
+                pass            # alive, nothing to read
+            except OSError:
+                cs.dead = True
+            finally:
+                try:
+                    cs.conn.settimeout(timeout)   # keep the write timeout
+                except OSError:
+                    pass
+
+    def stats(self) -> dict:
+        """Prefix-cache counters (hit rate, prefill tokens skipped;
+        empty dict for the contiguous path)."""
+        with self._lock:
+            return dict(self.sched.stats())
+
     def _finish(self, rid) -> None:
         cs = self._conns.pop(rid, None)
         if cs is None:
             return
+        reason = self.sched.rejected.pop(rid, None)
         try:
             if not cs.dead:
-                cs.fh.write(json.dumps({"done": True,
-                                        "n_tokens": cs.n}) + "\n")
+                msg = {"done": True, "n_tokens": cs.n}
+                if reason is not None:
+                    # a scheduler-rejected request (pool exhausted,
+                    # over capacity) must not look like a legitimate
+                    # zero-token completion
+                    msg["error"] = reason
+                if self.paged:
+                    st = self.sched.stats()
+                    msg["cache"] = {
+                        k: st[k] for k in ("hit_rate",
+                                           "prefill_tokens_skipped",
+                                           "prefill_skip_frac")}
+                cs.fh.write(json.dumps(msg) + "\n")
                 cs.fh.flush()
         except OSError:
             pass
@@ -216,6 +283,17 @@ class TokenServer:
                 for rid, toks in out.items():
                     self._emit(rid, toks)
                 for rid in finished:
+                    self._finish(rid)
+                    done_count += 1
+                # cancel-on-disconnect: a hung-up client's slot retires
+                # NOW (pages freed / inserted into the prefix tree)
+                # instead of decoding to gen_len for nobody
+                self._probe_disconnects()
+                dead = [rid for rid, cs in list(self._conns.items())
+                        if cs.dead]
+                for rid in dead:
+                    with self._lock:
+                        self.sched.cancel(rid)
                     self._finish(rid)
                     done_count += 1
                 if max_requests is not None and done_count >= max_requests:
