@@ -308,6 +308,56 @@ def _bench():
         "backend": jax.default_backend(),
     }), flush=True)
 
+    # --- speculative decoding row (models/spec_decode.py): n-gram
+    # self-drafted multi-token verify on a REPETITIVE workload (the
+    # summarization/self-quoting regime prompt-lookup targets — here a
+    # periodic prompt that pulls greedy decode into a loop the drafter
+    # locks onto). Reports accepted tokens per verify forward (> 1.0 is
+    # the win: decode is weight-bandwidth-bound, so tokens-per-forward
+    # is the latency lever) and the accept rate, with the spec-off
+    # scheduler timed on the same requests as the baseline.
+    if on_tpu:
+        sp_gen, sp_batch, sp_K, period, reps = 96, 16, 4, 4, 16
+    else:
+        sp_gen, sp_batch, sp_K, period, reps = 48, 2, 4, 4, 6
+    rng = np.random.RandomState(3)
+    pat = np.tile(rng.randint(0, cfg.vocab_size, size=(period,)), reps)
+
+    def spec_reqs():
+        return [Request(rid=i,
+                        ids=np.concatenate(
+                            [pat, pat[:2]]).astype(np.int32),
+                        gen_len=sp_gen)
+                for i in range(sp_batch)]
+
+    eng_s = Engine(model, max_seq=len(pat) + 2 + sp_gen + 8,
+                   backend=backend, kv_dtype=kv_dtype)
+    times = {}
+    stats_on = None
+    for K in (0, sp_K):
+        sched = ContinuousScheduler(eng_s, batch=sp_batch, chunk=4,
+                                    spec=K)
+        sched.run(spec_reqs())            # warm the programs
+        sched = ContinuousScheduler(eng_s, batch=sp_batch, chunk=4,
+                                    spec=K)
+        t0 = time.perf_counter()
+        out = sched.run(spec_reqs())
+        times[K] = time.perf_counter() - t0
+        if K:
+            stats_on = sched.stats()
+        assert all(len(t) == sp_gen for t in out.values())
+    print(json.dumps({
+        "metric": "spec_decode_tokens_per_step",
+        "value": round(stats_on["tokens_per_step"], 4),
+        "unit": "tok/forward",
+        "accept_rate": round(stats_on["spec_accept_rate"], 4),
+        "spec": sp_K,
+        "baseline_tokens_per_step": 1.0,
+        "tok_per_s_spec": round(sp_batch * sp_gen / times[sp_K], 2),
+        "tok_per_s_base": round(sp_batch * sp_gen / times[0], 2),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
 
 def main():
     if os.environ.get("TDTPU_BENCH_CHILD") == "1":

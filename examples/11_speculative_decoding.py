@@ -1,0 +1,76 @@
+"""Speculative decoding: n-gram self-drafting + batched multi-token
+verify (models/spec_decode.py).
+
+Decode is weight-bandwidth-bound — every forward reads the whole model
+to emit ONE token per slot. With spec=K the scheduler drafts up to K
+continuation tokens per slot by prompt-lookup (match the last n-gram
+of the slot's own prompt+generated history, propose what followed it
+last time), scores all slots' drafts in ONE verify forward, and keeps
+each slot's longest accepted prefix plus the corrected token — several
+tokens per forward when generation re-quotes its context, and the
+greedy streams stay BITWISE identical to spec=0 (the demo asserts it).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from triton_dist_tpu.models import (AutoLLM, ContinuousScheduler,
+                                        Engine, Request)
+    from triton_dist_tpu.models.config import tiny_qwen3
+    from triton_dist_tpu.runtime import initialize_distributed
+    from triton_dist_tpu.serving import ByteTokenizer
+
+    ctx = initialize_distributed()
+    n = ctx.tp_size()
+    cfg = tiny_qwen3(n)
+    model = AutoLLM.from_config(cfg, ctx.mesh)
+    eng = Engine(model, max_seq=128, backend="xla")
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    # a self-quoting workload (the regime prompt-lookup targets): the
+    # prompt repeats a phrase, greedy decode locks into the loop, and
+    # the drafter proposes the continuation it has already seen
+    phrase = "the pod of the slice of the pod "
+    prompts = [phrase * 2 + "the pod", phrase * 2 + "the slice"]
+    def reqs():
+        return [Request(rid=i, ids=np.asarray(tok.encode(p), np.int32),
+                        gen_len=40)
+                for i, p in enumerate(prompts)]
+
+    runs = {}
+    for K in (0, 4):
+        sched = ContinuousScheduler(eng, batch=2, chunk=4, spec=K)
+        t0 = time.perf_counter()
+        runs[K] = sched.run(reqs())
+        dt = time.perf_counter() - t0
+        if K:
+            st = sched.stats()
+            print(f"spec={K} over {len(prompts)} slots ({dt:.2f}s):")
+            print(f"  tokens / verify forward  "
+                  f"{st['tokens_per_step']:.2f}  (spec=0: 1.00)")
+            print(f"  draft accept rate        "
+                  f"{st['spec_accept_rate']:.0%} "
+                  f"({st['spec_accepted']}/{st['spec_drafted']})")
+            print(f"  verify forwards          {st['spec_steps']} "
+                  f"for {st['spec_emitted']} tokens")
+            assert st["tokens_per_step"] > 1.0, st
+
+    # the whole point: speculation must be invisible in the tokens
+    for i in range(len(prompts)):
+        assert np.array_equal(runs[0][i], runs[4][i]), (
+            f"slot {i}: spec-on stream diverged from spec-off")
+    print("token streams bitwise identical with spec on and off")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

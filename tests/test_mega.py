@@ -123,19 +123,38 @@ def test_mega_engine_tp_decode_matches_dist():
     model = AutoLLM.from_config(cfg, mesh)
     ids = np.random.RandomState(0).randint(
         0, cfg.vocab_size, size=(4, 8)).astype(np.int32)  # B % tp == 0
+    gen = 5
     toks_d = np.asarray(
-        Engine(model, max_seq=64, backend="dist").serve(ids, 5))
+        Engine(model, max_seq=64, backend="dist").serve(ids, gen))
     toks_m = np.asarray(
-        Engine(model, max_seq=64, backend="mega").serve(ids, 5))
-    # the two backends are numerically different-but-correct (bf16
+        Engine(model, max_seq=64, backend="mega").serve(ids, gen))
+    # The two backends are numerically different-but-correct (bf16
     # dots, different reduction orders), so CHAINED greedy equality is
-    # not a sound invariant — one near-tie flips the rest of the row
-    # (the layer-level test above holds the tight numeric bound). The
-    # first two steps must agree exactly; the full sequences must agree
-    # on the overwhelming majority of positions.
-    np.testing.assert_array_equal(toks_d[:, :2], toks_m[:, :2])
-    agree = (toks_d == toks_m).mean()
-    assert agree >= 0.75, (agree, toks_d, toks_m)
+    # not a sound invariant — one near-tie flips every later token of
+    # the row, and the old >= 0.75 agreement bound let real numeric
+    # drift hide behind "near-tie divergence". Compare LOGITS instead
+    # (ADVICE item): teacher-force each backend's OWN token stream
+    # through the xla-oracle prefill and require every chosen token's
+    # oracle logit to sit within a bf16-scale margin of the oracle
+    # argmax. Drift in either backend shows up directly as a large
+    # margin; a genuine near-tie stays within it.
+    tol = 0.05
+    oracle = Engine(model, max_seq=64, backend="xla")
+
+    def near_argmax(toks):
+        full = np.concatenate([ids, toks], 1)
+        S = ids.shape[1]
+        for i in range(gen):
+            # oracle distribution for generated token i = prefill
+            # logits of the teacher-forced prefix ending right before
+            step = np.asarray(oracle.prefill(full[:, :S + i])[0])
+            chosen = np.take_along_axis(
+                step, toks[:, i][:, None], axis=1)[:, 0]
+            gap = step.max(-1) - chosen
+            assert (gap <= tol).all(), (i, gap, toks)
+
+    near_argmax(toks_d)
+    near_argmax(toks_m)
 
 
 def test_mega_engine_rejects_indivisible_tp():

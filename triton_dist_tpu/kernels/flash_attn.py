@@ -49,15 +49,21 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
     happens in VMEM, so KV HBM traffic is halved (the decode regime is
     KV-bandwidth-bound at long context).
 
-    per_stream=True (the continuous-batching decode path, S == 1): rest
-    is prefixed by a [bx, 1] int32 block of per-stream kv lengths (its
-    BlockSpec walks the [X, 1] lens operand with the x grid axis) and
-    each stream masks to its OWN kv length — slots of different
-    sequence lengths share one kernel launch. Tiles past a stream's
-    length are masked to a BITWISE no-op of the accumulator update
-    (alpha == 1, p == 0), so a short slot's output is exactly what a
-    uniform-length launch at its length produces; the grid/DMA walk
-    still runs to max_len (len_ref[0])."""
+    per_stream=True (the continuous-batching decode path): rest is
+    prefixed by a [bx, 2] int32 block of per-stream (kv length, query
+    length) pairs (its BlockSpec walks the [X, 2] lens operand with the
+    x grid axis) and each stream masks to its OWN lengths — slots of
+    different sequence lengths share one kernel launch. q_len == 1 is
+    plain decode; q_len > 1 is the SPECULATIVE VERIFY window
+    (models/spec_decode.py): the stream's q_len query rows sit at
+    positions kv_len - q_len .. kv_len - 1 and row s attends causally
+    within the draft window (col <= kv_len - q_len + s). Padded rows
+    past q_len behave like the last valid row (their outputs are
+    discarded by the caller; the clamp keeps them NaN-free). Tiles past
+    a stream's length are masked to a BITWISE no-op of the accumulator
+    update (alpha == 1, p == 0), so a short slot's output is exactly
+    what a uniform-length launch at its length produces; the grid/DMA
+    walk still runs to max_len (len_ref[0])."""
     if quant:
         ks_ref, vs_ref, *rest = rest
     else:
@@ -101,9 +107,14 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 0) // rep
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, bt), 1) + start
         if per_stream:
-            # each stream masks to its own length (S == 1, so the
-            # causal frontier col <= len_j - 1 IS the length mask)
-            mask = (col[None] < lens_ref[...][:, :, None]) & (col[None] < T)
+            # stream j's causal frontier: query row s (s = row, since
+            # row = r // rep) sits at kv_len_j - q_len_j + s; rows past
+            # q_len_j clamp to the last valid row (outputs discarded).
+            # q_len == 1 degenerates to the plain col < kv_len mask.
+            kvl = lens_ref[...][:, 0][:, None, None]     # [bx, 1, 1]
+            ql = lens_ref[...][:, 1][:, None, None]
+            frontier = kvl - ql + jnp.minimum(row[None], ql - 1)
+            mask = (col[None] <= frontier) & (col[None] < T)
         else:
             # col < T guards the last block's padding when a caller
             # shifts the causal frontier past the buffer (kv_len > T,
@@ -188,7 +199,7 @@ def _pick_bx(X: int, rows: int, d: int, bt: int, itemsize: int,
 def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
                  block_x: Optional[int] = None,
                  block_t: Optional[int] = None,
-                 k_scale=None, v_scale=None, kv_lens=None):
+                 k_scale=None, v_scale=None, kv_lens=None, q_lens=None):
     """Cached GQA attention (decode and prefill-into-cache).
 
     q: [B, S, Hq, d]; k, v: [B, Hkv, T, d] (T = static cache capacity);
@@ -200,11 +211,19 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     int8 KV cache (k/v int8); dequant folds into the logits / the P
     matrix inside the kernel (exact), halving KV HBM traffic.
 
-    kv_lens: optional per-BATCH-ROW valid lengths [B] int32 (S must be
-    1; kv_len must then be their max) — the continuous-batching decode
-    path, where each slot of the batch is a different request at a
-    different sequence position (models/scheduler.py). Row b attends
-    exactly its own kv_lens[b] positions.
+    kv_lens: optional per-BATCH-ROW valid lengths [B] int32 (kv_len
+    must then be their max) — the continuous-batching decode path,
+    where each slot of the batch is a different request at a different
+    sequence position (models/scheduler.py). Row b attends exactly its
+    own kv_lens[b] positions.
+
+    q_lens: optional per-BATCH-ROW query-window lengths [B] int32
+    (requires kv_lens; the speculative-verify path,
+    models/spec_decode.py): slot b's first q_lens[b] query rows are its
+    draft window at positions kv_lens[b] - q_lens[b] .. kv_lens[b] - 1,
+    causal WITHIN the window; rows past q_lens[b] are padding whose
+    output the caller discards. Without q_lens, S must be 1 (plain
+    per-slot decode).
 
     Reference: flash_decode.py:130 (split-KV GQA kernel) + :308
     (combine); here split-KV partial results live in VMEM scratch and
@@ -215,8 +234,11 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     rep = Hq // Hkv
     if scale is None:
         scale = d ** -0.5
+    if q_lens is not None:
+        assert kv_lens is not None, "q_lens rides on per-slot kv_lens"
     if kv_lens is not None:
-        assert S == 1, "per-slot kv_lens is the decode path (S == 1)"
+        assert S == 1 or q_lens is not None, (
+            "per-slot kv_lens with S > 1 needs q_lens (the verify path)")
         # the scalar kv_len becomes the walk bound (max over slots);
         # callers may pass anything — it is recomputed here
         kv_len = jnp.max(jnp.asarray(kv_lens, jnp.int32))
@@ -240,8 +262,12 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     vx = v.reshape(X, T, d)
     ks = None if k_scale is None else k_scale.reshape(X, T)
     vs = None if v_scale is None else v_scale.reshape(X, T)
-    lens_x = (None if kv_lens is None
-              else jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv))
+    lens_x = None
+    if kv_lens is not None:
+        kv_x = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv)
+        q_x = (jnp.ones_like(kv_x) if q_lens is None
+               else jnp.repeat(jnp.asarray(q_lens, jnp.int32), Hkv))
+        lens_x = jnp.stack([kv_x, q_x], axis=1)          # [X, 2]
     out = _flash_call(qx, kx, vx, kv_len, kv_len - S, scale=float(scale),
                       rep=rep, S=S, T=T, partial=False, block_x=block_x,
                       block_t=block_t, ks=ks, vs=vs, lens=lens_x)
@@ -340,11 +366,12 @@ def _flash_call(qx, kx, vx, kv_len, q_off, *, scale: float, rep: int,
                      pl.BlockSpec((bx, bt), kvs_map)]
         args += [ks, vs]
     if lens is not None:
-        # per-stream kv lengths ride as a [X, 1] operand whose block
-        # walks the x grid axis — each bx-slab sees its own lengths
-        in_specs += [pl.BlockSpec((bx, 1),
+        # per-stream (kv_len, q_len) pairs ride as a [X, 2] operand
+        # whose block walks the x grid axis — each bx-slab sees its own
+        # lengths
+        in_specs += [pl.BlockSpec((bx, 2),
                                   lambda x, t, len_ref: (x, 0))]
-        args += [lens.reshape(X, 1)]
+        args += [lens.reshape(X, 2)]
 
     if partial:
         out_shape = (jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
@@ -417,12 +444,17 @@ def kv_update(cache, new, tile_pos):
     )(jnp.asarray(tile_pos, jnp.int32).reshape(1), new, cache)
 
 
-def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
+def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None,
+                         q_lens=None):
     """jnp oracle for flash_decode (same layout/contract): masked f32
     softmax over the full static T — the role the torch attention plays
     for the reference's differential tests. kv_len may be a scalar
     (uniform batch) or a [B] vector (per-slot lengths, the
-    continuous-batching contract of flash_decode(kv_lens=...))."""
+    continuous-batching contract of flash_decode(kv_lens=...)).
+    q_lens [B] (requires vector kv_len) is the speculative-verify
+    contract: slot b's first q_lens[b] query rows are its draft window
+    ending at kv_len[b] - 1, causal within the window; padded rows
+    clamp to the last valid row (discarded by the caller)."""
     B, S, Hq, d = q.shape
     _, Hkv, T, _ = k.shape
     rep = Hq // Hkv
@@ -434,7 +466,12 @@ def attention_cached_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
     si = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
     ti = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
     kv_len = jnp.asarray(kv_len, jnp.int32)
-    if kv_len.ndim == 0:
+    if q_lens is not None:
+        ql = jnp.asarray(q_lens, jnp.int32)[:, None, None]    # [B, 1, 1]
+        frontier = (kv_len[:, None, None] - ql
+                    + jnp.minimum(si[None], ql - 1))
+        mask = ti[None] <= frontier
+    elif kv_len.ndim == 0:
         mask = (ti <= (si + (kv_len - S)))[None]              # [1, S, T]
     else:
         mask = ti[None] <= (si[None] + (kv_len[:, None, None] - S))

@@ -50,12 +50,17 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
     page; the W streams' pages DMA in parallel under the step and each
     keeps its own accumulator row.
 
-    per_stream=True (continuous batching): a [W, 1] int32 lens block
-    rides as the last input and stream j masks to its OWN kv length
-    (S == 1), so slots at different sequence positions share one
-    launch; tiles past a stream's length are a bitwise no-op of its
-    accumulator (and its index map clamps to its own last page, so the
-    surplus DMAs re-request the same block and are elided)."""
+    per_stream=True (continuous batching): a [W, 2] int32 lens block
+    of (kv length, query length) pairs rides as the last input and
+    stream j masks to its OWN lengths, so slots at different sequence
+    positions share one launch; tiles past a stream's length are a
+    bitwise no-op of its accumulator (and its index map clamps to its
+    own last page, so the surplus DMAs re-request the same block and
+    are elided). q_len == 1 is plain decode; q_len > 1 is the
+    speculative-verify window (models/spec_decode.py): row s of the
+    stream's q_len query rows sits at kv_len - q_len + s and attends
+    causally within the window; padded rows clamp to the last valid
+    row (outputs discarded by the caller)."""
     q_ref = refs[0]
     k_refs = refs[1:1 + W]
     v_refs = refs[1 + W:1 + 2 * W]
@@ -85,8 +90,11 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
             mask = (col <= (row + q_off)) & (col < kv_len)
         for j in range(W):
             if per_stream:
-                # S == 1: col <= len_j - 1 is the whole causal story
-                mask = col < lens_ref[j, 0]
+                # row s's causal frontier within stream j's draft
+                # window; q_len == 1 degenerates to col < kv_len
+                kvl = lens_ref[j, 0]
+                ql = lens_ref[j, 1]
+                mask = col <= (kvl - ql + jnp.minimum(row, ql - 1))
             q = q_ref[pl.ds(j, 1)]                       # [1, rows, d]
             s = jax.lax.dot_general(
                 q, k_refs[j][...], (((2,), (2,)), ((0,), (0,))),
@@ -115,13 +123,15 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
 
 
 def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
-                       scale: Optional[float] = None, kv_lens=None):
+                       scale: Optional[float] = None, kv_lens=None,
+                       q_lens=None):
     """Cached GQA decode attention through a page table.
 
-    q: [B, 1, Hq, d]; pages_k/v: [NP, page, d]; page_table:
-    [B*Hkv, max_pages] int32 (physical page of each logical tile; rows
-    beyond ceil(kv_len/page) may hold anything); kv_len: traced scalar
-    — valid positions INCLUDING the current query. Returns [B, 1, Hq, d].
+    q: [B, S, Hq, d] (S == 1 unless q_lens is given); pages_k/v:
+    [NP, page, d]; page_table: [B*Hkv, max_pages] int32 (physical page
+    of each logical tile; rows beyond ceil(kv_len/page) may hold
+    anything); kv_len: traced scalar — valid positions INCLUDING the
+    current query. Returns [B, S, Hq, d].
 
     kv_lens: optional per-BATCH-ROW lengths [B] int32 (continuous
     batching: each slot is a different request at a different sequence
@@ -131,17 +141,29 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     of a short slot's walk re-requests one block and its DMAs are
     elided — a mixed-length batch pays max_len grid steps but only
     sum(len_b) page traffic.
+
+    q_lens: optional per-BATCH-ROW query-window lengths [B] int32
+    (requires kv_lens; the speculative-verify path,
+    models/spec_decode.py): slot b's first q_lens[b] of the S query
+    rows are its draft window at positions kv_lens[b] - q_lens[b] ..
+    kv_lens[b] - 1, causal within the window; padded rows are
+    discarded by the caller.
     """
     B, S, Hq, d = q.shape
-    assert S == 1, "paged walk is the decode path (S == 1)"
+    if q_lens is not None:
+        assert kv_lens is not None, "q_lens rides on per-slot kv_lens"
+    else:
+        assert S == 1, "paged walk without q_lens is decode (S == 1)"
     NP, page, _ = pages_k.shape
     X, maxp = page_table.shape
     Hkv = X // B
     rep = Hq // Hkv
     if scale is None:
         scale = d ** -0.5
-    rows = rep
-    qx = (q.reshape(B, Hkv, rep, d).reshape(X, rows, d))
+    rows = S * rep
+    qx = (q.reshape(B, S, Hkv, rep, d)
+           .transpose(0, 2, 1, 3, 4)
+           .reshape(X, rows, d))
     # W streams per grid step (see module docstring): the largest
     # divisor of X in (8, 4, 2, 1)
     W = next(w for w in (8, 4, 2, 1) if X % w == 0)
@@ -149,6 +171,8 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     if per_stream:
         lens_x = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv)  # [X]
         kv_len = jnp.max(lens_x)
+        qlens_x = (jnp.ones_like(lens_x) if q_lens is None
+                   else jnp.repeat(jnp.asarray(q_lens, jnp.int32), Hkv))
     # scalars: [kv_len, q_off, lens..., table...]; the kv index map
     # resolves the logical tile through the table (clamped to the last
     # valid tile so the tail is elided like the contiguous walk). The
@@ -179,9 +203,10 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
 
     kv_specs = [pl.BlockSpec((1, page, d), kv_map_j(j)) for j in range(W)]
     in_specs = ([pl.BlockSpec((W, rows, d), q_map)] + kv_specs + kv_specs
-                + ([pl.BlockSpec((W, 1), lens_map)] if per_stream else []))
+                + ([pl.BlockSpec((W, 2), lens_map)] if per_stream else []))
     args = ([qx] + [pages_k] * W + [pages_v] * W
-            + ([lens_x.reshape(X, 1)] if per_stream else []))
+            + ([jnp.stack([lens_x, qlens_x], axis=1)]
+               if per_stream else []))
     out = pl.pallas_call(
         functools.partial(_paged_kernel, float(scale), rep, page, W,
                           per_stream),
@@ -201,7 +226,9 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         # the W k (v) operands are the SAME pool array — one buffer,
         # W per-stream index maps
     )(scalars, *args)
-    return out.reshape(B, Hkv, rep, d).reshape(B, 1, Hq, d)
+    return (out.reshape(B, Hkv, S, rep, d)
+               .transpose(0, 2, 1, 3, 4)
+               .reshape(B, S, Hq, d))
 
 
 @jax.tree_util.register_dataclass

@@ -535,6 +535,122 @@ class TP_Attn:
         out = f(qkv, *kv, jnp.asarray(pos, jnp.int32))
         return out[0], tuple(out[1:])
 
+    def _attend_cached_slots_verify(self, qkv, cos, sin, batch: int, kv,
+                                    pos, q_lens, impl: str = "flash"):
+        """Speculative-verify variant of _attend_cached_slots
+        (models/spec_decode.py): each slot feeds a variable-length
+        draft window of up to S tokens in ONE forward. qkv:
+        [B*S, qkv_cols] sharded P(None, tp); pos/q_lens: [B] int32 —
+        slot b's q_lens[b] valid window rows sit at positions pos[b] ..
+        pos[b] + q_lens[b] - 1 (RoPE-rotated there), write their K/V at
+        those columns of the slot's cache row, and attend causally
+        within the window (flash_decode q_lens / attention_cached_ref
+        q_lens). Padded rows (s >= q_lens[b], or past the cache
+        capacity) are DROPPED by the scatter (out-of-bounds update
+        indices), so they can never clobber a live KV row; their
+        attention outputs are computed-and-discarded. Returns
+        (o [B*S, hq_loc*hd], updated kv)."""
+        from triton_dist_tpu.kernels.flash_attn import (attention_cached_ref,
+                                                        flash_decode)
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        scale = hd ** -0.5
+        quant = len(kv) == 4
+        cache_spec = P(None, self.axis, None, None)
+        scale_spec = P(None, self.axis, None)
+        kv_specs = ((cache_spec, cache_spec, scale_spec, scale_spec)
+                    if quant else (cache_spec, cache_spec))
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis),) + kv_specs + (P(None), P(None)),
+            out_specs=((P(None, self.axis),) + kv_specs),
+            check_vma=False)
+        def f(qkv_loc, ck_loc, cv_loc, *rest):
+            *scales, pos, q_lens = rest
+            M = qkv_loc.shape[0]
+            B = batch
+            S = M // B
+            T = ck_loc.shape[2]
+            q = qkv_loc[:, :hq * hd].reshape(B, S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(B, S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope_slots(q, cos, sin, pos)
+            k = apply_rope_slots(k, cos, sin, pos)
+            p = pos[:, None] + jnp.arange(S)[None]          # [B, S]
+            valid = (jnp.arange(S)[None] < q_lens[:, None]) & (p < T)
+            # invalid rows scatter OUT OF BOUNDS (column T) — jax drops
+            # OOB scatter updates, so padding can never collide with a
+            # live row's write (a clamped index could, at T - 1)
+            wpos = jnp.where(valid, p, T)
+            rows = jnp.arange(B)[:, None]
+            lens = pos + q_lens
+
+            def scat(c, u):   # u: [B, S, hkv, ...] matching c's cols
+                return c.at[rows, :, wpos].set(u.astype(c.dtype))
+
+            if quant:
+                ks_loc, vs_loc = scales
+
+                def q8(x):
+                    xf = x.astype(jnp.float32)
+                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
+                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
+                            s)
+
+                k8, k_s = q8(k)
+                v8, v_s = q8(v)
+                ck_loc = scat(ck_loc, k8)
+                cv_loc = scat(cv_loc, v8)
+                ks_loc = ks_loc.at[rows, :, wpos].set(k_s)
+                vs_loc = vs_loc.at[rows, :, wpos].set(v_s)
+                if impl == "flash":
+                    bt = min(T, 2048)
+                    o = flash_decode(q.astype(jnp.bfloat16), ck_loc,
+                                     cv_loc, jnp.max(lens), scale=scale,
+                                     k_scale=ks_loc, v_scale=vs_loc,
+                                     block_t=bt, kv_lens=lens,
+                                     q_lens=q_lens)
+                else:
+                    o = attention_cached_ref(
+                        q.astype(jnp.float32),
+                        ck_loc.astype(jnp.float32) * ks_loc[..., None],
+                        cv_loc.astype(jnp.float32) * vs_loc[..., None],
+                        lens, scale=scale, q_lens=q_lens)
+                return (o.reshape(M, hq * hd).astype(qkv_loc.dtype),
+                        ck_loc, cv_loc, ks_loc, vs_loc)
+
+            ck_loc = scat(ck_loc, k)
+            cv_loc = scat(cv_loc, v)
+            if impl == "flash":
+                o = flash_decode(q.astype(ck_loc.dtype), ck_loc, cv_loc,
+                                 jnp.max(lens), scale=scale, kv_lens=lens,
+                                 q_lens=q_lens)
+            else:
+                o = attention_cached_ref(q.astype(ck_loc.dtype), ck_loc,
+                                         cv_loc, lens, scale=scale,
+                                         q_lens=q_lens)
+            return o.reshape(M, hq * hd), ck_loc, cv_loc
+
+        out = f(qkv, *kv, jnp.asarray(pos, jnp.int32),
+                jnp.asarray(q_lens, jnp.int32))
+        return out[0], tuple(out[1:])
+
+    def fwd_cached_slots_verify(self, x, cos, sin, batch: int, kv, pos,
+                                q_lens, mode: str = "dist"):
+        """Speculative-verify attention block (spec decode,
+        models/spec_decode.py): B slots x up to S draft-window tokens
+        in ONE forward. x: [B*S, D]; pos/q_lens: [B] int32. Same mode
+        dispatch as fwd_cached_slots."""
+        impl = "ref" if mode == "xla" else "flash"
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_cached_slots_verify(qkv, cos, sin, batch,
+                                                 kv, pos, q_lens, impl)
+        return self._o_proj(o, mode), kv
+
     def _split_qkv_global(self, qkv, S: int = 1):
         """Unpack a GLOBAL packed [q|k|v] projection into per-head q/k/v
         [B, S, H, d]. The packed column layout is n per-rank blocks
@@ -601,6 +717,72 @@ class TP_Attn:
             o = attention_cached_ref(q.astype(ck.dtype), kfull, vfull,
                                      lens, scale=scale)
         return o.reshape(B, self.n_heads * hd), (ck, cv)
+
+    def _attend_paged_slots_verify(self, qkv, cos, sin, batch: int, kv,
+                                   table, pos, q_lens,
+                                   impl: str = "flash"):
+        """Paged-pool variant of _attend_cached_slots_verify (spec
+        decode over the shared-prefix pool): slot b's draft-window K/V
+        lands in the physical pages its table row maps for positions
+        pos[b] .. pos[b] + q_lens[b] - 1; padded rows scatter to an
+        out-of-bounds page id and are dropped, so they can never touch
+        a live or cached page. Attention walks the pool through the
+        table with per-slot kv_lens AND q_lens (flash_decode_paged)."""
+        from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
+        from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+        hd = self.head_dim
+        Hkv = self.n_kv_heads
+        scale = hd ** -0.5
+        ck, cv = kv
+        NP, page, _ = ck.shape
+        B = batch
+        S = qkv.shape[0] // B
+        q, k, v = self._split_qkv_global(qkv, S)      # [B, S, H, d]
+        if self.q_norm is not None:
+            q = rms_norm(q, self.q_norm)
+        if self.k_norm is not None:
+            k = rms_norm(k, self.k_norm)
+        pos = jnp.asarray(pos, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        q = apply_rope_slots(q, cos, sin, pos)
+        k = apply_rope_slots(k, cos, sin, pos)
+        maxp = table.shape[1]
+        p = pos[:, None] + jnp.arange(S)[None]                 # [B, S]
+        valid = ((jnp.arange(S)[None] < q_lens[:, None])
+                 & (p < maxp * page))
+        streams = (jnp.arange(B) * Hkv)[:, None, None] \
+            + jnp.arange(Hkv)[None, None, :]                   # [B, 1, Hkv]
+        pidx = table[streams, jnp.minimum(p // page, maxp - 1)[:, :, None]]
+        # invalid rows scatter to page NP (out of bounds -> dropped)
+        dest = jnp.where(valid[:, :, None], pidx, NP)          # [B, S, Hkv]
+        r = (p % page)[:, :, None]
+        ck = ck.at[dest, r].set(k.astype(ck.dtype))
+        cv = cv.at[dest, r].set(v.astype(cv.dtype))
+        lens = pos + q_lens
+        if impl == "flash":
+            o = flash_decode_paged(q.astype(ck.dtype), ck, cv, table,
+                                   jnp.max(lens), scale=scale,
+                                   kv_lens=lens, q_lens=q_lens)
+        else:
+            T = maxp * page
+            kfull = ck[table].reshape(B, Hkv, T, hd)
+            vfull = cv[table].reshape(B, Hkv, T, hd)
+            o = attention_cached_ref(q.astype(ck.dtype), kfull, vfull,
+                                     lens, scale=scale, q_lens=q_lens)
+        return o.reshape(B * S, self.n_heads * hd), (ck, cv)
+
+    def fwd_cached_slots_paged_verify(self, x, cos, sin, batch: int, kv,
+                                      table, pos, q_lens,
+                                      mode: str = "flash"):
+        """Speculative-verify attention block over the PAGED pool: same
+        contract as fwd_cached_slots_verify with the slot's KV resolved
+        through the page table (models/spec_decode.py over the
+        shared-prefix serving path)."""
+        impl = "ref" if mode == "xla" else "flash"
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_paged_slots_verify(qkv, cos, sin, batch, kv,
+                                                table, pos, q_lens, impl)
+        return self._o_proj(o, mode), kv
 
     def fwd_cached_slots_paged(self, x, cos, sin, batch: int, kv, table,
                                pos, mode: str = "flash"):

@@ -13,6 +13,8 @@ from triton_dist_tpu.models.prefix_cache import PrefixCache  # noqa: F401
 from triton_dist_tpu.models.scheduler import (ContinuousScheduler,  # noqa: F401
                                               DecodeSlots,
                                               PagedDecodeSlots, Request)
+from triton_dist_tpu.models.spec_decode import (Drafter,  # noqa: F401
+                                                NgramDrafter)
 
 
 class AutoLLM:

@@ -239,6 +239,63 @@ class DenseLLM:
         logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
         return logits, cache
 
+    def forward_tokens_slots_verify(self, ids, cache: KVCache, pos,
+                                    q_lens, mode: str = "dist",
+                                    mlp_mode: Optional[str] = None):
+        """Speculative-verify forward (models/spec_decode.py): each
+        batch row is a slot scoring a variable-length draft window in
+        ONE pass. ids: [B, S] — slot b's first q_lens[b] tokens occupy
+        positions pos[b] .. pos[b] + q_lens[b] - 1 (padding past
+        q_lens[b] is computed-and-discarded); K/V of the valid window
+        rows are written at those cache columns (a rejected suffix is
+        simply overwritten by the next step). Returns (per-position
+        logits [B, S, V], cache)."""
+        B, S = ids.shape
+        mlp_mode = mlp_mode or mode
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            kv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots_verify(
+                h, self.cos, self.sin, B, kv, pos, q_lens, mode)
+            cache = cache.set_layer(li, kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp(h, mlp_mode)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "dist":
+            x = self._gather_rows(x)
+        from triton_dist_tpu.kernels.quant import qmm
+        logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
+        return logits.reshape(B, S, -1), cache
+
+    def forward_tokens_slots_paged_verify(self, ids, pcache, pos, q_lens,
+                                          mode: str = "flash",
+                                          mlp_mode: Optional[str] = None):
+        """forward_tokens_slots_verify over the PAGED KV pool: the
+        draft window's K/V resolves through the page table (padded rows
+        scatter out of bounds and are dropped), and attention walks the
+        pool with per-slot kv_lens AND q_lens. Returns (per-position
+        logits [B, S, V], pcache)."""
+        B, S = ids.shape
+        mlp_mode = mlp_mode or mode
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, (ck, cv) = layer.attn.fwd_cached_slots_paged_verify(
+                h, self.cos, self.sin, B, pcache.layer(li),
+                pcache.table, pos, q_lens, mode)
+            pcache = pcache.set_layer(li, ck, cv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            x = x + layer.mlp(h, mlp_mode)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode == "dist":
+            x = self._gather_rows(x)
+        from triton_dist_tpu.kernels.quant import qmm
+        logits = qmm(x, self.lm_head, preferred_element_type=jnp.float32)
+        return logits.reshape(B, S, -1), pcache
+
     def forward_tokens_slots_paged(self, ids, pcache, pos,
                                    mode: str = "flash",
                                    mlp_mode: Optional[str] = None):

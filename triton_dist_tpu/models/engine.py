@@ -162,6 +162,24 @@ class Engine:
             self._paged_set_table = jax.jit(_paged_set_table_fn,
                                             donate_argnums=(0,))
             self._paged_scratch = None
+            # speculative-decoding verify programs (models/spec_decode.py
+            # drives these through the scheduler's spec=K mode): ONE
+            # forward scores every slot's padded draft window, and the
+            # accept rule runs in the same program — the host reads back
+            # only (n_emit, next seed token). Lazy-compiled.
+            if sampling == "greedy":
+                vfn = functools.partial(_slot_verify_fn, backend)
+                pvfn = functools.partial(_paged_slot_verify_fn, backend)
+            else:
+                vfn = functools.partial(_sampled_slot_verify_fn, backend,
+                                        sampling, self._sample_params)
+                pvfn = functools.partial(_sampled_paged_slot_verify_fn,
+                                         backend, sampling,
+                                         self._sample_params)
+                self._spec_seed = jax.jit(functools.partial(
+                    _spec_seed_fn, sampling, self._sample_params))
+            self._slot_verify = jax.jit(vfn, donate_argnums=(1,))
+            self._paged_slot_verify = jax.jit(pvfn, donate_argnums=(1,))
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -258,6 +276,65 @@ class Engine:
             self.model, logits, cache, pos, active, keys, gen_len=chunk)
         return toks, logits, cache, pos, keys
 
+
+    # ------------------------------------------------------------------
+    # speculative decoding (models/spec_decode.py policy; the
+    # scheduler's spec=K mode drives these)
+    # ------------------------------------------------------------------
+
+    def spec_seed(self, row_logits, key):
+        """Draw the pending seed token for a freshly admitted slot from
+        its prefill logits (sampled modes only; greedy admission takes
+        the host argmax). Returns (token, evolved key)."""
+        assert self.sampling != "greedy"
+        return self._spec_seed(row_logits, key)
+
+    def slot_verify_chunk(self, cache, pos, active, tokens, q_lens, *,
+                          keys=None):
+        """One speculative verify step over the CONTIGUOUS slot cache:
+        score every slot's draft window (tokens [B, S] — the pending
+        seed token at column 0, up to S-1 drafts after, padded; q_lens
+        [B] valid lengths) in ONE forward at per-slot positions pos,
+        run the acceptance rule (greedy: longest argmax-matching
+        prefix; sampled: leftover rejection sampling through the
+        per-slot PRNG chains `keys`), write the window KV, and advance
+        each slot by its accepted count — the rejected suffix stays as
+        dead rows past the rewound length, overwritten by the next
+        step. Returns (n_emit [B] — tokens kept from the window,
+        t0_next [B] — the corrected next seed token, cache, pos, keys).
+        """
+        if self.backend == "mega":
+            raise ValueError("backend='mega' carries no resumable slot "
+                             "state; use the per-op backends")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        if self.sampling == "greedy":
+            assert keys is None
+            n_emit, t0n, cache, pos = self._slot_verify(
+                self.model, cache, pos, active, tokens, q_lens)
+            return n_emit, t0n, cache, pos, None
+        n_emit, t0n, cache, pos, keys = self._slot_verify(
+            self.model, cache, pos, active, tokens, q_lens, keys)
+        return n_emit, t0n, cache, pos, keys
+
+    def paged_slot_verify_chunk(self, pcache, pos, active, tokens,
+                                q_lens, *, keys=None):
+        """slot_verify_chunk over the PAGED pool: identical contract,
+        with the window KV scatter and attention resolved through the
+        page table (a padded row's write drops out of bounds, so it can
+        never touch a live or cached page; rejected rows stay in the
+        slot's own mapped pages until the next window overwrites them).
+        """
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        if self.sampling == "greedy":
+            assert keys is None
+            n_emit, t0n, pcache, pos = self._paged_slot_verify(
+                self.model, pcache, pos, active, tokens, q_lens)
+            return n_emit, t0n, pcache, pos, None
+        n_emit, t0n, pcache, pos, keys = self._paged_slot_verify(
+            self.model, pcache, pos, active, tokens, q_lens, keys)
+        return n_emit, t0n, pcache, pos, keys
 
     # ------------------------------------------------------------------
     # paged slot path (shared-prefix serving; models/prefix_cache.py
@@ -463,6 +540,96 @@ def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
     (logits, cache, pos, keys), toks = jax.lax.scan(
         step, (logits0, cache, pos, keys), None, length=gen_len)
     return toks.T, logits, cache, pos, keys          # [B, gen_len]
+
+
+def _spec_seed_fn(sampling, params, logits, key):
+    """Sample the pending seed token for a fresh spec-mode slot from
+    its prefill logits, consuming one split of the slot's PRNG chain
+    (models/spec_decode.py; greedy admission argmaxes on the host)."""
+    from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
+    temp = max(params["temperature"], 0.0)
+    key, sub = jax.random.split(key)
+    if temp == 0.0:
+        tok = jnp.argmax(logits, axis=-1)
+    elif sampling == "top_k":
+        tok = sample_top_k(sub, logits, k=params["k"], temperature=temp)
+    else:
+        tok = sample_top_p(sub, logits, p=params["p"], temperature=temp)
+    return tok.astype(jnp.int32), key
+
+
+def _verify_accept(sampling, params, logits_all, tokens, q_lens, active,
+                   pos, cap, keys=None):
+    """Shared acceptance epilogue of the four verify programs
+    (models/spec_decode.py): greedy = longest argmax-matching prefix +
+    corrected token; sampled = leftover rejection sampling through the
+    per-slot PRNG chains (emitted marginal equals the spec-off
+    sampler's at every position; temperature=0 collapses to greedy,
+    mirroring the samplers' degeneracy). Inactive slots report
+    n_emit == 0; pos advances by the accepted count, clamped to the
+    cache capacity — the rejected suffix stays as dead rows past the
+    rewound length. Returns (n_emit, t0_next, pos, keys)."""
+    from triton_dist_tpu.models.spec_decode import (accept_greedy,
+                                                    accept_sampled,
+                                                    target_probs)
+    if sampling is None or max(params["temperature"], 0.0) == 0.0:
+        nxt = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+        n_emit, t0n = accept_greedy(tokens, nxt, q_lens)
+    else:
+        probs = target_probs(logits_all, sampling, params)
+        n_emit, t0n, keys = accept_sampled(keys, probs, tokens, q_lens)
+    n_emit = n_emit * active.astype(jnp.int32)
+    pos = jnp.minimum(pos + n_emit, cap - 1)
+    return n_emit, t0n, pos, keys
+
+
+def _slot_verify_fn(backend, model, cache, pos, active, tokens, q_lens):
+    """Greedy speculative verify (contiguous cache): one forward over
+    every slot's padded draft window + the shared on-device acceptance
+    epilogue (_verify_accept). Inactive slots flow through masked
+    (q_lens handed in as 1, writes land in their own dead rows)."""
+    logits_all, cache = model.forward_tokens_slots_verify(
+        tokens, cache, pos, q_lens, mode=backend)
+    n_emit, t0n, pos, _ = _verify_accept(
+        None, None, logits_all, tokens, q_lens, active, pos,
+        cache.k[0].shape[2])
+    return n_emit, t0n, cache, pos
+
+
+def _sampled_slot_verify_fn(backend, sampling, params, model, cache, pos,
+                            active, tokens, q_lens, keys):
+    """Sampled _slot_verify_fn: leftover rejection sampling through the
+    per-slot PRNG chains (see _verify_accept)."""
+    logits_all, cache = model.forward_tokens_slots_verify(
+        tokens, cache, pos, q_lens, mode=backend)
+    n_emit, t0n, pos, keys = _verify_accept(
+        sampling, params, logits_all, tokens, q_lens, active, pos,
+        cache.k[0].shape[2], keys)
+    return n_emit, t0n, cache, pos, keys
+
+
+def _paged_slot_verify_fn(backend, model, pcache, pos, active, tokens,
+                          q_lens):
+    """_slot_verify_fn over the PAGED pool (the prefix-cache serving
+    path): identical acceptance, KV resolved through the page table."""
+    logits_all, pcache = model.forward_tokens_slots_paged_verify(
+        tokens, pcache, pos, q_lens, mode=backend)
+    n_emit, t0n, pos, _ = _verify_accept(
+        None, None, logits_all, tokens, q_lens, active, pos,
+        pcache.capacity)
+    return n_emit, t0n, pcache, pos
+
+
+def _sampled_paged_slot_verify_fn(backend, sampling, params, model,
+                                  pcache, pos, active, tokens, q_lens,
+                                  keys):
+    """Sampled _paged_slot_verify_fn (see _verify_accept)."""
+    logits_all, pcache = model.forward_tokens_slots_paged_verify(
+        tokens, pcache, pos, q_lens, mode=backend)
+    n_emit, t0n, pos, keys = _verify_accept(
+        sampling, params, logits_all, tokens, q_lens, active, pos,
+        pcache.capacity, keys)
+    return n_emit, t0n, pcache, pos, keys
 
 
 def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,

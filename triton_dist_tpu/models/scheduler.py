@@ -25,6 +25,14 @@ Mechanics (engine.py slot path):
 - between chunks the host trims each slot's tokens to its remaining
   budget, retires finished slots, and admits queued requests into the
   freed rows while the other slots keep decoding mid-stream.
+
+Speculative decoding (spec=K, models/spec_decode.py): each step is one
+draft-then-verify iteration instead of a 1-token scan step — the host
+drafter proposes up to K continuations per slot by n-gram prompt
+lookup, ONE verify forward (Engine.slot_verify_chunk /
+paged_slot_verify_chunk) scores every slot's padded window, and each
+slot emits its seed token plus the accepted prefix (1..K+1 tokens per
+forward). Greedy streams stay bitwise identical to spec=0.
 """
 
 from __future__ import annotations
@@ -52,7 +60,18 @@ class DecodeSlots:
     slot scan's carry — admission and retirement edit rows of them
     between chunks."""
 
-    def __init__(self, engine, batch: int):
+    def __init__(self, engine, batch: int, *, spec: int = 0,
+                 drafter=None):
+        """spec=K > 0 enables SPECULATIVE DECODING
+        (models/spec_decode.py): each step_chunk becomes one
+        draft-then-verify iteration — the host `drafter` (default
+        NgramDrafter, prompt-lookup over the slot's own history)
+        proposes up to K continuation tokens per slot, ONE verify
+        forward scores every slot's padded window, and each slot emits
+        its seed token plus the accepted draft prefix (1..K+1 tokens
+        per forward instead of exactly 1). Greedy streams stay bitwise
+        identical to spec=0; sampled streams stay distributionally
+        exact (leftover rejection sampling)."""
         import jax
         import jax.numpy as jnp
         self.engine = engine
@@ -67,6 +86,32 @@ class DecodeSlots:
         # host mirrors (scheduling is host-side; the model never syncs)
         self.remaining = np.zeros((batch,), np.int64)
         self.rids: List[Optional[object]] = [None] * batch
+        self.spec = int(spec)
+        if self.spec:
+            from triton_dist_tpu.models.spec_decode import NgramDrafter
+            if engine.backend == "mega":
+                raise ValueError("backend='mega' has no verify path; "
+                                 "spec decoding uses the per-op "
+                                 "backends")
+            self.drafter = drafter if drafter is not None \
+                else NgramDrafter()
+            # per-slot token history (prompt + emitted) — the drafter's
+            # lookup corpus — and the pending seed token each verify
+            # window starts with
+            self._hist: List[List[int]] = [[] for _ in range(batch)]
+            self._t0 = np.zeros((batch,), np.int64)
+            # accept counters (stats(): spec_accept_rate /
+            # tokens_per_step, surfaced through TokenServer). The
+            # scalars are LIFETIME aggregates (they survive slot
+            # reuse); the per-slot arrays cover the current occupants
+            # only (zeroed at admit).
+            self._spec_steps = 0           # verify forwards run
+            self._spec_slot_steps = 0      # live (slot, forward) pairs
+            self._spec_emitted = 0         # tokens kept (incl. seeds)
+            self._spec_drafted_total = 0
+            self._spec_accepted_total = 0
+            self._spec_drafted = np.zeros((batch,), np.int64)
+            self._spec_accepted = np.zeros((batch,), np.int64)
 
     def _make_cache(self):
         """Cache-flavor hook (PagedDecodeSlots swaps in the paged pool)."""
@@ -97,6 +142,21 @@ class DecodeSlots:
             self.keys = self.keys.at[slot].set(jax.random.key(req.seed))
         self.remaining[slot] = req.gen_len
         self.rids[slot] = req.rid
+        if self.spec:
+            # seed the slot's verify chain: history = prompt, pending
+            # seed token = what spec=0 would emit first from these
+            # logits (greedy argmax on the host; sampled draws through
+            # the slot's PRNG chain so the chain stays per-slot)
+            self._hist[slot] = [int(t) for t in np.asarray(req.ids)]
+            if self.engine.sampling == "greedy":
+                self._t0[slot] = int(np.argmax(np.asarray(row_logits)))
+            else:
+                t0, k2 = self.engine.spec_seed(row_logits,
+                                               self.keys[slot])
+                self.keys = self.keys.at[slot].set(k2)
+                self._t0[slot] = int(t0)
+            self._spec_drafted[slot] = 0
+            self._spec_accepted[slot] = 0
 
     def admit(self, slot: int, req: Request) -> None:
         """Prefill req into `slot` and arm its row of the carry. Only
@@ -118,6 +178,8 @@ class DecodeSlots:
         self.active = self.active.at[slot].set(False)
         self.remaining[slot] = 0
         self.rids[slot] = None
+        if self.spec:
+            self._hist[slot] = []
 
     def _run_chunk(self, chunk: int) -> np.ndarray:
         """Engine-call hook: one chunk of the slot scan (paged variant
@@ -132,12 +194,105 @@ class DecodeSlots:
         """Hook: paged slots record kept tokens for the retire-time
         prefix-tree insert; the contiguous path keeps nothing."""
 
+    def _run_verify(self, tokens, q_lens):
+        """Engine-call hook for one spec verify forward (paged variant
+        swaps in paged_slot_verify_chunk). Returns host (n_emit,
+        t0_next)."""
+        n_emit, t0n, self.cache, self.pos, self.keys = \
+            self.engine.slot_verify_chunk(self.cache, self.pos,
+                                          self.active, tokens, q_lens,
+                                          keys=self.keys)
+        return np.asarray(n_emit), np.asarray(t0n)
+
+    def _step_spec(self) -> Tuple[Dict[int, np.ndarray],
+                                  List[Tuple[int, object]]]:
+        """One speculative draft-then-verify iteration
+        (models/spec_decode.py): the drafter proposes up to `spec`
+        continuations of each slot's history + pending seed token
+        (capped at remaining - 1, so a slot never writes past its
+        budget), ONE verify forward scores every window, and each slot
+        keeps its seed plus the accepted draft prefix. The corrected
+        token returned by the verify becomes the next window's seed."""
+        S = self.spec + 1
+        tokens = np.zeros((self.batch, S), np.int32)
+        q_lens = np.ones((self.batch,), np.int32)
+        for b in self.occupied:
+            tokens[b, 0] = self._t0[b]
+            kmax = min(self.spec, int(self.remaining[b]) - 1)
+            if kmax > 0:
+                # append the pending seed for the lookup, then undo —
+                # no per-step copy of the (growing) history list
+                h = self._hist[b]
+                h.append(int(self._t0[b]))
+                try:
+                    d = list(self.drafter.propose(h, kmax))[:kmax]
+                finally:
+                    h.pop()
+            else:
+                d = []
+            tokens[b, 1:1 + len(d)] = d
+            q_lens[b] = 1 + len(d)
+        n_emit, t0n = self._run_verify(tokens, q_lens)
+        self._spec_steps += 1
+        out: Dict[int, np.ndarray] = {}
+        finished: List[Tuple[int, object]] = []
+        for b in self.occupied:
+            keep = int(min(self.remaining[b], n_emit[b]))
+            if keep:
+                kept = tokens[b, :keep].copy()
+                out[b] = kept
+                self.remaining[b] -= keep
+                self._hist[b].extend(int(t) for t in kept)
+                self._record(b, kept)
+                self._spec_slot_steps += 1
+                self._spec_emitted += keep
+                self._spec_drafted[b] += int(q_lens[b]) - 1
+                self._spec_accepted[b] += keep - 1
+                self._spec_drafted_total += int(q_lens[b]) - 1
+                self._spec_accepted_total += keep - 1
+                self._t0[b] = int(t0n[b])
+            if self.remaining[b] == 0:
+                finished.append((b, self.rids[b]))
+        return out, finished
+
+    @property
+    def stats(self) -> dict:
+        """Speculative-decoding counters (empty when spec == 0):
+        LIFETIME aggregate accept rate (accepted drafts / proposed
+        drafts — survives slot reuse, consistent with spec_emitted /
+        spec_steps), tokens emitted per slot per verify forward (1.0 =
+        no speculation win, K+1 = every draft accepted), and the
+        per-slot counter arrays for the CURRENT occupants."""
+        if not self.spec:
+            return {}
+        drafted = self._spec_drafted_total
+        accepted = self._spec_accepted_total
+        return {
+            "spec": self.spec,
+            "spec_steps": self._spec_steps,
+            "spec_drafted": drafted,
+            "spec_accepted": accepted,
+            "spec_emitted": self._spec_emitted,
+            "spec_accept_rate": (accepted / drafted) if drafted else 0.0,
+            "tokens_per_step": (self._spec_emitted
+                                / self._spec_slot_steps
+                                if self._spec_slot_steps else 0.0),
+            "spec_accepted_per_slot": self._spec_accepted.tolist(),
+            "spec_drafted_per_slot": self._spec_drafted.tolist(),
+        }
+
     def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
                                               List[Tuple[int, object]]]:
         """Run one `chunk`-step slot scan. Returns ({slot: kept tokens
         (trimmed to the slot's remaining budget)}, [(slot, rid) of
         requests that just finished]). Finished slots are NOT retired
-        here — the caller streams their tail first, then retires."""
+        here — the caller streams their tail first, then retires.
+
+        In spec mode (spec=K) one call is one draft-then-verify
+        iteration instead of `chunk` single-token steps: each live slot
+        emits 1..K+1 tokens per call (seed + accepted drafts)."""
+        if self.spec:
+            return self._step_spec()
         toks = self._run_chunk(chunk)
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
@@ -172,12 +327,13 @@ class PagedDecodeSlots(DecodeSlots):
 
     def __init__(self, engine, batch: int, *, page: int = 16,
                  num_pages: Optional[int] = None,
-                 prefix_cache: bool = True, margin: int = 4):
+                 prefix_cache: bool = True, margin: int = 4,
+                 spec: int = 0, drafter=None):
         from triton_dist_tpu.models.prefix_cache import PrefixCache
         self.page = page
         self.margin = margin
         self._num_pages = num_pages
-        super().__init__(engine, batch)
+        super().__init__(engine, batch, spec=spec, drafter=drafter)
         Hkv = engine.model.config.num_kv_heads
         self.prefix = PrefixCache(self.cache.num_pages, Hkv, page,
                                   enabled=prefix_cache)
@@ -201,7 +357,9 @@ class PagedDecodeSlots(DecodeSlots):
 
     @property
     def stats(self) -> dict:
-        return self.prefix.stats()
+        out = dict(DecodeSlots.stats.fget(self))   # spec counters
+        out.update(self.prefix.stats())
+        return out
 
     def admit(self, slot: int, req: Request) -> None:
         """Consult the radix tree, map the cached prefix read-only,
@@ -294,6 +452,13 @@ class PagedDecodeSlots(DecodeSlots):
                                          chunk=chunk, keys=self.keys)
         return np.asarray(toks)
 
+    def _run_verify(self, tokens, q_lens):
+        n_emit, t0n, self.cache, self.pos, self.keys = \
+            self.engine.paged_slot_verify_chunk(self.cache, self.pos,
+                                                self.active, tokens,
+                                                q_lens, keys=self.keys)
+        return np.asarray(n_emit), np.asarray(t0n)
+
     def _record(self, slot: int, toks) -> None:
         self._tokens[slot].extend(int(t) for t in toks)
 
@@ -306,19 +471,32 @@ class ContinuousScheduler:
 
     def __init__(self, engine, *, batch: int, chunk: int = 4,
                  paged: bool = False, prefix_cache: bool = True,
-                 page: int = 16, num_pages: Optional[int] = None):
+                 page: int = 16, num_pages: Optional[int] = None,
+                 spec: int = 0, drafter=None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
         prefix_cache=False keeps the paged pool but never shares (the
         bitwise cache-off reference). num_pages sizes the pool (default:
-        worst case, no sharing needed to fit `batch` full slots)."""
+        worst case, no sharing needed to fit `batch` full slots).
+
+        spec=K > 0 turns each poll's decode step into one speculative
+        draft-then-verify iteration (models/spec_decode.py): up to K
+        drafter-proposed tokens per slot are scored in ONE forward and
+        each slot emits its seed token plus the accepted prefix
+        (1..K+1 tokens per forward). Greedy streams are bitwise
+        identical to spec=0; sampled streams stay distributionally
+        exact. `drafter` defaults to the n-gram/prompt-lookup
+        NgramDrafter; stats() then reports spec_accept_rate and
+        tokens_per_step."""
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
-                prefix_cache=prefix_cache, margin=chunk)
+                prefix_cache=prefix_cache, margin=chunk,
+                spec=spec, drafter=drafter)
         else:
-            self.slots = DecodeSlots(engine, batch)
+            self.slots = DecodeSlots(engine, batch, spec=spec,
+                                     drafter=drafter)
         self.chunk = chunk
         self._queue: deque = deque()
         # rid -> rejection reason for requests the slots refused (the
@@ -348,8 +526,10 @@ class ContinuousScheduler:
         return False
 
     def stats(self) -> dict:
-        """Prefix-cache hit/skip counters (empty for the contiguous
-        slot path)."""
+        """Serving counters: prefix-cache hit/skip (paged path) and
+        speculative-decoding accept counters (spec=K mode —
+        spec_accept_rate, tokens_per_step); empty for the plain
+        contiguous path."""
         return getattr(self.slots, "stats", {})
 
     @property

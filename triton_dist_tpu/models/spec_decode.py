@@ -1,0 +1,187 @@
+"""Speculative decoding: n-gram self-drafting + batched multi-token
+verify over the slot caches (contiguous AND paged).
+
+Decode at serving batch sizes is weight-bandwidth-bound: every forward
+reads the whole model to emit ONE token per slot. Speculative sampling
+(Leviathan et al., ICML 2023 — PAPERS.md) emits several: a cheap
+DRAFTER guesses the next K tokens, one target-model forward scores all
+of them in parallel (the verify), and an acceptance rule keeps the
+longest prefix the target agrees with — provably without changing the
+target distribution. Prompt-lookup / n-gram decoding (Saxena, 2023)
+supplies a model-free drafter: LLM output constantly re-quotes its own
+context (summarization, code edits, chat with retrieved documents), so
+matching the last n-gram of the slot's prompt+generated history and
+proposing the tokens that followed it last time is free and often
+right — and, being deterministic, fits this repo's bitwise-differential
+test style.
+
+Division of labor:
+- host (this module + models/scheduler.py `spec=K` mode): per-slot
+  token history, the `Drafter` (pluggable — a small draft MODEL can
+  implement the same protocol later), window padding/len bookkeeping,
+  accept counters;
+- device (models/engine.py slot_verify_chunk / paged_slot_verify_chunk
+  over dense.forward_tokens_slots_verify): ONE forward scores all B
+  slots' variable-length windows (0..K drafts each, padded + masked via
+  per-slot q_lens alongside kv_lens in kernels/flash_attn.py and
+  kernels/paged_kv.py), then the acceptance functions below pick the
+  kept prefix and the next seed token without a second forward.
+
+Acceptance:
+- greedy (`accept_greedy`): keep drafts while they equal the verify
+  argmax; the next seed token is the argmax AFTER the kept prefix (the
+  "corrected" token) — so every emitted token is an argmax of target
+  logits and the stream is bitwise identical to spec=0.
+- sampled (`accept_sampled`): leftover-distribution rejection sampling.
+  The n-gram draft is a point mass, so draft d at target distribution p
+  is accepted with probability p(d); on rejection the replacement is
+  drawn from p with d zeroed and renormalized (the leftover), which
+  makes the emitted marginal EXACTLY p at every position regardless of
+  draft quality (tests/test_spec_decode.py checks the marginal).
+
+Rollback is positional: the verify wrote KV for every window row, but a
+rejected suffix just stays as dead rows past the slot's rewound length
+— never attended (per-slot kv_lens masks) and overwritten by the next
+step's window (paged: the pages stay mapped; contiguous: same cache
+row).
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Sequence
+
+import numpy as np
+
+
+class Drafter(Protocol):
+    """Draft source protocol: given a slot's full token history
+    (prompt + everything emitted so far, INCLUDING the pending next
+    token), propose up to k likely continuation tokens. May return
+    fewer (or none) — the scheduler pads and masks. Implementations
+    must be deterministic for the differential tests; a small draft
+    model can implement this by greedy-decoding k tokens."""
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NgramDrafter:
+    """Prompt-lookup / n-gram self-drafting (Saxena, 2023): find the
+    most recent earlier occurrence of the history's trailing n-gram
+    (longest n first) and propose the tokens that followed it. Free
+    (no model), deterministic, and strong exactly where speculative
+    decoding pays best: repetitive/summarization-style generation that
+    re-quotes its own context.
+
+    `window` bounds the lookup to the last `window` history tokens, so
+    the host work between verify forwards stays O(max_n * window) per
+    slot regardless of sequence length (an unbounded scan on a long
+    chat history can out-cost the device forward it is meant to
+    hide)."""
+
+    def __init__(self, max_n: int = 3, min_n: int = 1,
+                 window: int = 1024):
+        assert 1 <= min_n <= max_n
+        self.max_n = max_n
+        self.min_n = min_n
+        self.window = window
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        h = history if isinstance(history, list) else list(history)
+        if k <= 0 or len(h) < self.min_n + 1:
+            return []
+        base = max(0, len(h) - self.window)
+        L = len(h)
+        for n in range(min(self.max_n, L - base - 1),
+                       self.min_n - 1, -1):
+            tail = h[-n:]
+            # scan right-to-left for the most recent PRIOR occurrence
+            for i in range(L - n - 1, base - 1, -1):
+                if h[i:i + n] == tail:
+                    return h[i + n:i + n + k]
+        return []
+
+
+# ----------------------------------------------------------------------
+# device-side acceptance (called inside the engine's jitted verify
+# programs; jax imported lazily so host-only users of this module —
+# the drafter — stay jax-free)
+# ----------------------------------------------------------------------
+
+
+def accept_greedy(tokens, nxt, q_lens):
+    """Greedy acceptance over one verify window. tokens: [B, S] — the
+    window fed to the forward (seed token at column 0, drafts after);
+    nxt: [B, S] — per-position argmax of the verify logits (nxt[:, s]
+    is the model's token AFTER consuming tokens[:, :s+1]); q_lens: [B]
+    valid window lengths. Returns (n_emit [B] — seed + accepted-draft
+    count, 1..q_lens; t0_next [B] — the corrected token following the
+    kept prefix, the next step's seed)."""
+    import jax.numpy as jnp
+    B, S = tokens.shape
+    ok = (tokens[:, 1:] == nxt[:, :-1]) \
+        & (jnp.arange(1, S)[None] < q_lens[:, None])
+    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    n_emit = 1 + acc
+    t0_next = jnp.take_along_axis(nxt, acc[:, None], axis=1)[:, 0]
+    return n_emit, t0_next
+
+
+def target_probs(logits, sampling: str, params: dict):
+    """The TARGET next-token distribution the spec-off sampler defines:
+    temperature-scaled softmax over the filtered support, built from
+    the SAME filtering helpers the samplers use (models/utils.py
+    top_k_support / top_p_masked_logits) so the two can never
+    desynchronize — leftover rejection sampling is exact only against
+    the exact sampler distribution. logits: [..., V] (any leading
+    batch dims). temperature must be > 0 (0 degenerates to the greedy
+    path, handled by the caller)."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models.utils import (top_k_support,
+                                              top_p_masked_logits)
+    temp = max(params["temperature"], 0.0)
+    assert temp > 0.0, "temperature 0 is the greedy acceptance path"
+    if sampling == "top_k":
+        topv, topi = top_k_support(logits, params["k"], temp)
+        p = jax.nn.softmax(topv, axis=-1)
+        return jnp.put_along_axis(jnp.zeros_like(logits), topi, p,
+                                  axis=-1, inplace=False)
+    if sampling == "top_p":
+        return jax.nn.softmax(
+            top_p_masked_logits(logits, params["p"], temp), axis=-1)
+    raise ValueError(f"unknown sampling mode {sampling!r}")
+
+
+def accept_sampled(keys, probs, tokens, q_lens):
+    """Leftover-distribution rejection sampling over one verify window
+    (Leviathan et al. specialized to a point-mass draft). keys: [B]
+    per-slot PRNG keys; probs: [B, S, V] — probs[b, s] is the target
+    distribution AFTER consuming tokens[b, :s+1]; tokens: [B, S]
+    window (seed + drafts); q_lens: [B]. Per slot: draft d_i
+    (= tokens[:, i], i >= 1) is accepted while u_i < p_{i-1}(d_i); the
+    next seed token is drawn from p_{acc} — zeroed at the rejected
+    draft and renormalized when one was rejected (the leftover), plain
+    p_{acc} when every draft was accepted. Returns (n_emit [B],
+    t0_next [B], keys' [B])."""
+    import jax
+    import jax.numpy as jnp
+    B, S, V = probs.shape
+
+    def one(key, p, toks, qlen):
+        key, ku, ks = jax.random.split(key, 3)
+        u = jax.random.uniform(ku, (S - 1,))
+        d = toks[1:]
+        p_d = jnp.take_along_axis(p[:-1], d[:, None], axis=1)[:, 0]
+        ok = (jnp.arange(1, S) < qlen) & (u < p_d)
+        acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+        all_acc = acc == qlen - 1
+        p_next = p[acc]
+        rej = toks[jnp.minimum(acc + 1, S - 1)]
+        p_left = jnp.where(all_acc, p_next,
+                           p_next * (jnp.arange(V) != rej))
+        p_left = p_left / jnp.maximum(jnp.sum(p_left), 1e-30)
+        t0n = jax.random.categorical(ks, jnp.log(p_left))
+        return 1 + acc, t0n.astype(toks.dtype), key
+
+    return jax.vmap(one)(keys, probs, tokens, q_lens)

@@ -20,20 +20,37 @@ def sample_greedy(logits):
     return jnp.argmax(logits, axis=-1)
 
 
-def sample_top_k(key, logits, k: int = 50, temperature: float = 1.0):
-    """Top-k sampling (reference: models/utils.py sampling helpers)."""
-    topv, topi = jax.lax.top_k(logits / temperature, k)
-    idx = jax.random.categorical(key, topv)
-    return jnp.take_along_axis(topi, idx[..., None], axis=-1)[..., 0]
+def top_k_support(logits, k: int, temperature: float):
+    """Temperature-scaled logits restricted to the top-k support:
+    (values [..., k], vocab indices [..., k]). SHARED by sample_top_k
+    and the speculative-verify target distribution
+    (models/spec_decode.py target_probs) — the leftover rejection
+    sampling is exact only if both draw from the same support."""
+    return jax.lax.top_k(logits / temperature, k)
 
 
-def sample_top_p(key, logits, p: float = 0.9, temperature: float = 1.0):
-    """Nucleus sampling: mask the tail whose cumulative prob > p."""
+def top_p_masked_logits(logits, p: float, temperature: float):
+    """Temperature-scaled logits with the nucleus tail (cumulative
+    prob > p) masked to -inf. SHARED by sample_top_p and the
+    speculative-verify target distribution (same exactness contract as
+    top_k_support)."""
     logits = logits / temperature
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
     cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-    masked = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, masked)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample_top_k(key, logits, k: int = 50, temperature: float = 1.0):
+    """Top-k sampling (reference: models/utils.py sampling helpers)."""
+    topv, topi = top_k_support(logits, k, temperature)
+    idx = jax.random.categorical(key, topv)
+    return jnp.take_along_axis(topi, idx[..., None], axis=-1)[..., 0]
+
+
+def sample_top_p(key, logits, p: float = 0.9, temperature: float = 1.0):
+    """Nucleus sampling: mask the tail whose cumulative prob > p."""
+    return jax.random.categorical(
+        key, top_p_masked_logits(logits, p, temperature))

@@ -101,13 +101,21 @@ class TokenServer:
                  host: str = "127.0.0.1", port: int = 0,
                  chunk: int = 4, paged: bool = False,
                  prefix_cache: bool = True, page: int = 16,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, spec: int = 0,
+                 drafter=None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
         cached KV pages and skip that prefill; the final {"done": ...}
         message then carries a "cache" dict (hit rate, prefill tokens
-        skipped) and stats() exposes the running counters."""
+        skipped) and stats() exposes the running counters.
+
+        spec=K > 0 turns each decode step into a speculative
+        draft-then-verify iteration (models/spec_decode.py, n-gram
+        prompt-lookup drafting by default): every slot streams 1..K+1
+        tokens per model forward, token-for-token identical to spec=0
+        under greedy sampling. stats() then also reports
+        spec_accept_rate and tokens_per_step."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -116,7 +124,8 @@ class TokenServer:
         self.paged = paged
         self.sched = ContinuousScheduler(
             engine, batch=batch, chunk=chunk, paged=paged,
-            prefix_cache=prefix_cache, page=page, num_pages=num_pages)
+            prefix_cache=prefix_cache, page=page, num_pages=num_pages,
+            spec=spec, drafter=drafter)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -225,8 +234,10 @@ class TokenServer:
                     pass
 
     def stats(self) -> dict:
-        """Prefix-cache counters (hit rate, prefill tokens skipped;
-        empty dict for the contiguous path)."""
+        """Serving counters: prefix-cache (hit rate, prefill tokens
+        skipped — paged path) and speculative decoding
+        (spec_accept_rate, tokens_per_step — spec=K mode); empty dict
+        for the plain contiguous path."""
         with self._lock:
             return dict(self.sched.stats())
 
