@@ -34,6 +34,21 @@ _METRIC = "qwen3_decode_tok_per_s_per_chip"
 _SERVE_METRIC = "serving_tok_per_s_per_chip"
 
 
+def _emit_json(obj):
+    """One bench row: stdout (the driver's capture) + optional file
+    capture when TDTPU_BENCH_JSON names a path (append, one JSON line
+    per row — ad-hoc runs keep their history without tee plumbing)."""
+    line = json.dumps(obj)
+    print(line, flush=True)
+    path = os.environ.get("TDTPU_BENCH_JSON")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+
+
 def _run_captured(cmd, env, timeout):
     """subprocess with output to temp FILES (not pipes) and process-GROUP
     kill on timeout. subprocess.run(capture_output=..., timeout=...)
@@ -196,13 +211,13 @@ def _bench():
     params_per_chip = params / ndev
     vs_baseline = (tok_s_chip * params_per_chip) / (1289.0 * 4e9)
 
-    print(json.dumps({
+    _emit_json({
         "metric": _METRIC,
         "value": round(tok_s_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(vs_baseline, 4),
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
     # --- continuous-batching serving row: N DISTINCT prompts of mixed
     # gen_lens through the slot scheduler (models/scheduler.py) — the
@@ -229,7 +244,7 @@ def _bench():
     dt = time.perf_counter() - t0
     total = sum(len(t) for t in out.values())
     s_tok_chip = total / dt / ndev
-    print(json.dumps({
+    _emit_json({
         "metric": _SERVE_METRIC,
         "value": round(s_tok_chip, 2),
         "unit": "tok/s/chip",
@@ -237,7 +252,7 @@ def _bench():
                              / (1289.0 * 4e9), 4),
         "backend": jax.default_backend(),
         "requests": n_req, "slots": serve_batch,
-    }), flush=True)
+    })
 
     # --- shared-prefix cache row: N requests sharing a system prompt
     # through the paged radix-cache scheduler (models/prefix_cache.py).
@@ -296,7 +311,7 @@ def _bench():
         sched.submit(r)
     drain(sched)
     st = sched.stats()
-    print(json.dumps({
+    _emit_json({
         "metric": "prefix_hit_prefill_skip_frac",
         "value": round(st["prefill_skip_frac"], 4),
         "unit": "frac",
@@ -306,7 +321,7 @@ def _bench():
         "ttft_cold_ms": round(ttft_cold * 1e3, 2),
         "ttft_warm_ms": round(ttft_warm * 1e3, 2),
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
     # --- speculative decoding row (models/spec_decode.py): n-gram
     # self-drafted multi-token verify on a REPETITIVE workload (the
@@ -346,7 +361,7 @@ def _bench():
         if K:
             stats_on = sched.stats()
         assert all(len(t) == sp_gen for t in out.values())
-    print(json.dumps({
+    _emit_json({
         "metric": "spec_decode_tokens_per_step",
         "value": round(stats_on["tokens_per_step"], 4),
         "unit": "tok/forward",
@@ -356,7 +371,64 @@ def _bench():
         "tok_per_s_spec": round(sp_batch * sp_gen / times[sp_K], 2),
         "tok_per_s_base": round(sp_batch * sp_gen / times[0], 2),
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
+
+    # --- preemption/resume overhead row (models/scheduler.py
+    # resilience): the SAME mixed workload through an AMPLE pool vs a
+    # pool sized to force KV-pressure preemption (fits roughly half the
+    # slots' worst case). Reports the throughput ratio — the price of
+    # degrading gracefully instead of rejecting — plus the preemption
+    # count; streams are asserted identical (the exactness contract,
+    # tests/test_resilience.py).
+    if on_tpu:
+        pr_len, pr_gen, pr_batch, pr_n, pr_page = 64, 48, 8, 16, 16
+    else:
+        pr_len, pr_gen, pr_batch, pr_n, pr_page = 10, 8, 2, 4, 8
+    pr_chunk = 4
+    Hkv = cfg.num_kv_heads
+
+    def pr_reqs():
+        r2 = np.random.RandomState(5)
+        return [Request(rid=i,
+                        ids=r2.randint(0, cfg.vocab_size,
+                                       size=(pr_len,)).astype(np.int32),
+                        gen_len=pr_gen)
+                for i in range(pr_n)]
+
+    worst = -(-(pr_len + pr_gen + pr_chunk - 1) // pr_page)
+    tiny = max(1, pr_batch // 2) * worst * Hkv + 1 + Hkv
+    eng_r = Engine(model, max_seq=pr_len + pr_gen + pr_chunk + 16,
+                   backend=backend)
+    pr_times, pr_outs, pr_preempts = {}, {}, 0
+    for label, npages in (("ample", None), ("tiny", tiny)):
+        sched = ContinuousScheduler(eng_r, batch=pr_batch,
+                                    chunk=pr_chunk, paged=True,
+                                    prefix_cache=True, page=pr_page,
+                                    num_pages=npages)
+        sched.run(pr_reqs()[:1])          # warm the programs
+        sched = ContinuousScheduler(eng_r, batch=pr_batch,
+                                    chunk=pr_chunk, paged=True,
+                                    prefix_cache=True, page=pr_page,
+                                    num_pages=npages)
+        t0 = time.perf_counter()
+        pr_outs[label] = sched.run(pr_reqs())
+        pr_times[label] = time.perf_counter() - t0
+        if label == "tiny":
+            pr_preempts = sched.preemptions
+    assert all(np.array_equal(pr_outs["tiny"][i], pr_outs["ample"][i])
+               for i in range(pr_n)), "preempted streams diverged"
+    total = pr_n * pr_gen
+    _emit_json({
+        "metric": "preempt_resume_overhead",
+        "value": round(pr_times["tiny"] / pr_times["ample"], 4),
+        "unit": "x slowdown",
+        "preemptions": pr_preempts,
+        "tok_per_s_tiny_pool": round(total / pr_times["tiny"], 2),
+        "tok_per_s_ample_pool": round(total / pr_times["ample"], 2),
+        "tiny_pool_pages": tiny,
+        "requests": pr_n, "slots": pr_batch,
+        "backend": jax.default_backend(),
+    })
 
 
 def main():

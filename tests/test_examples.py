@@ -46,6 +46,10 @@ def test_speculative_decoding_example_runs():
     _run_example("11_speculative_decoding.py")
 
 
+def test_resilient_serving_example_runs():
+    _run_example("12_resilient_serving.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

@@ -356,31 +356,41 @@ def test_paged_prefix_multi_device_mesh(ndev):
                                       err_msg=f"rid={r.rid}")
 
 
-def test_pool_exhaustion_rejects_gracefully():
+def test_pool_exhaustion_preempts_instead_of_rejecting():
     """When eviction cannot free enough pages (everything pinned by
-    live slots), the admission raises and the scheduler reports the
-    request as finished-with-no-tokens instead of dying."""
+    live slots), the scheduler PREEMPTS a victim and re-queues it
+    (tests/test_resilience.py has the full exactness matrix): with a
+    pool fitting ONE worst-case slot, BOTH requests now complete
+    bitwise-exactly, time-sliced through preemption. preempt=False
+    restores the old hard-reject contract — the rejection REASON is
+    recorded for the serving layer (a zero-token stream must not look
+    like a legitimate completion)."""
     cfg, model = _model()
     eng = Engine(model, max_seq=64, backend="xla")
     rng = np.random.RandomState(6)
     Hkv, page = cfg.num_kv_heads, 8
-    # pool fits ONE worst-case slot only; batch=2 -> second admission
-    # in the same poll must be rejected, first must still stream
     ids = rng.randint(0, cfg.vocab_size, size=(2, 20)).astype(np.int32)
     num_pages = -(-(20 + 6 + 3) // page) * Hkv + 1
+    reqs = lambda: [Request(rid=i, ids=ids[i], gen_len=6)
+                    for i in range(2)]
     sched = ContinuousScheduler(eng, batch=2, chunk=4, paged=True,
                                 prefix_cache=True, page=page,
                                 num_pages=num_pages)
-    reqs = [Request(rid=i, ids=ids[i], gen_len=6) for i in range(2)]
-    got = sched.run(reqs)
-    lens = sorted(len(got[r.rid]) for r in reqs)
+    got = sched.run(reqs())
+    assert sched.preemptions > 0
+    assert not sched.rejected, sched.rejected
+    for r in reqs():
+        want = np.asarray(eng.serve(np.tile(r.ids[None], (2, 1)),
+                                    6))[0]
+        np.testing.assert_array_equal(got[r.rid], want,
+                                      err_msg=f"rid={r.rid}")
+    # preempt=False: the old contract — second admission rejects
+    sched = ContinuousScheduler(eng, batch=2, chunk=4, paged=True,
+                                prefix_cache=True, page=page,
+                                num_pages=num_pages, preempt=False)
+    got = sched.run(reqs())
+    lens = sorted(len(got[r.rid]) for r in reqs())
     assert lens[0] == 0 and lens[1] == 6, lens
-    ok_rid = [r.rid for r in reqs if len(got[r.rid]) == 6][0]
-    want = np.asarray(eng.serve(np.tile(ids[ok_rid][None], (2, 1)),
-                                6))[0]
-    np.testing.assert_array_equal(got[ok_rid], want)
-    # the rejection REASON is recorded for the serving layer to report
-    # (a zero-token stream must not look like a legitimate completion)
     assert any("page pool exhausted" in v
                for v in sched.rejected.values()), sched.rejected
 

@@ -9,10 +9,12 @@ from triton_dist_tpu.models.config import (ModelConfig, qwen3_30b_a3b,  # noqa: 
 from triton_dist_tpu.models.dense import DenseLLM  # noqa: F401
 from triton_dist_tpu.models.engine import Engine  # noqa: F401
 from triton_dist_tpu.models.kv_cache import KVCache, PagedSlotCache  # noqa: F401
-from triton_dist_tpu.models.prefix_cache import PrefixCache  # noqa: F401
+from triton_dist_tpu.models.prefix_cache import (PoolExhausted,  # noqa: F401
+                                                 PrefixCache)
 from triton_dist_tpu.models.scheduler import (ContinuousScheduler,  # noqa: F401
                                               DecodeSlots,
-                                              PagedDecodeSlots, Request)
+                                              PagedDecodeSlots, Request,
+                                              ResumeState)
 from triton_dist_tpu.models.spec_decode import (Drafter,  # noqa: F401
                                                 NgramDrafter)
 

@@ -339,7 +339,16 @@ class Engine:
     # ------------------------------------------------------------------
     # paged slot path (shared-prefix serving; models/prefix_cache.py
     # owns the policy — radix tree, refcounts, eviction — and drives
-    # these device-side entry points through the scheduler)
+    # these device-side entry points through the scheduler).
+    #
+    # The slot lifecycle these programs implement is PREEMPTIBLE
+    # (models/scheduler.py resilience): a preemption is exactly a
+    # retire (retire_slot_paged — tree insert is host bookkeeping,
+    # table rows to trash) followed later by a re-admission of the
+    # prompt + generated sequence through admit_slot_paged, whose
+    # prefix match caps at n-1 so only the last token's KV recomputes
+    # while the tree still holds the pages. No preemption-specific
+    # device program exists — that is the point.
     # ------------------------------------------------------------------
 
     def make_paged_slot_cache(self, batch: int, *, page: int = 16,

@@ -113,7 +113,11 @@ class PagedSlotCache:
     the write sink for retired/dead slots: the slot scan keeps stepping
     masked-out rows, and their KV scatter must land somewhere that no
     live slot ever maps — retiring a slot points its whole table row at
-    trash so its surplus writes can never corrupt a reused page."""
+    trash so its surplus writes can never corrupt a reused page. The
+    same property is what makes PREEMPTION (models/scheduler.py) safe:
+    a preempted slot's pages live on inside the radix tree while its
+    table row points at trash, so the still-stepping masked row cannot
+    scribble on KV a future re-admission will map back."""
 
     pages_k: Tuple[jax.Array, ...]   # L x [NP, page, d]
     pages_v: Tuple[jax.Array, ...]

@@ -57,6 +57,17 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+class PoolExhausted(ValueError):
+    """A paged admission could not get pages even after LRU eviction.
+
+    Raised (instead of a generic ValueError) so the scheduler can tell
+    RECOVERABLE pressure — preempt a victim slot and retry — from the
+    hard rejections (over-capacity request, empty prompt) that no
+    amount of preemption can fix. The chaos harness
+    (runtime/chaos.py::FaultInjector) raises it too, to force the
+    preemption path without actually draining the pool."""
+
+
 def _common_prefix(a: np.ndarray, b: np.ndarray) -> int:
     L = min(len(a), len(b))
     if L == 0:
@@ -88,6 +99,15 @@ class RefcountedPages:
     @property
     def pages_in_use(self) -> int:
         return len(self._ref)
+
+    @property
+    def outstanding(self) -> int:
+        """Pages held out of the free list (refcounted pages + the
+        reserved trash page). Conservation invariant — the chaos
+        harness's no-leak check (tests/test_resilience.py):
+        ``available + outstanding == num_pages`` after ANY sequence of
+        admissions, retirements, preemptions, evictions, and faults."""
+        return self._alloc.outstanding
 
     def alloc_group(self) -> np.ndarray:
         """One fresh writable group ([Hkv] page ids at refcount 1)."""
@@ -372,4 +392,5 @@ class PrefixCache:
             "evictions": self.tree.evictions,
             "pages_in_use": self.pool.pages_in_use,
             "pages_free": self.pool.available,
+            "pages_outstanding": self.pool.outstanding,
         }

@@ -33,11 +33,41 @@ lookup, ONE verify forward (Engine.slot_verify_chunk /
 paged_slot_verify_chunk) scores every slot's padded window, and each
 slot emits its seed token plus the accepted prefix (1..K+1 tokens per
 forward). Greedy streams stay bitwise identical to spec=0.
+
+Resilience (the degradation ladder under pressure — vLLM's
+preemption/recompute design over the Orca operational model,
+PAPERS.md):
+- PREEMPTION: a paged admission that cannot get pages even after LRU
+  eviction no longer hard-rejects when a victim slot exists. The
+  scheduler preempts the victim (fewest generated tokens, then most
+  recently admitted): its prompt + generated sequence goes into the
+  radix prefix tree through the EXISTING retire path (the pages
+  already hold its KV — insertion is bookkeeping), its page refs are
+  released (now evictable), and the request re-queues at the front
+  with a resume snapshot (ResumeState: evolved PRNG key, pending spec
+  seed token, emitted count). On re-admission the prefix cache hands
+  the pages back (match capped at n-1, so only the last token
+  recomputes) and decode resumes mid-stream — token streams are
+  BITWISE identical preempted vs unpreempted, greedy and sampled,
+  spec=K included (tests/test_resilience.py). Hard rejection remains
+  only when a single request alone exceeds capacity.
+- BACKPRESSURE: `max_queue` bounds the waiting line; submit() returns
+  False on overflow and the serving layer replies
+  {"busy": true, "retry_after_ms": ...} instead of queueing unboundedly.
+- DEADLINES: a Request's optional `deadline_ms` budget (stamped at
+  submit) expires queued requests before admission and cancels
+  in-flight ones mid-stream with a visible error reason.
+- WATCHDOG: `watchdog_s` runs every decode chunk under
+  runtime/stress.py::watchdog — a hung chunk surfaces as a clean HANG
+  verdict in stats() (and a HangError to the caller) instead of a
+  frozen model loop.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -45,12 +75,33 @@ import numpy as np
 
 
 @dataclasses.dataclass
+class ResumeState:
+    """Mid-stream snapshot carried by a preempted request: everything
+    exact resume needs beyond the (prompt + generated) token sequence
+    already folded into Request.ids. The KV itself is NOT snapshotted —
+    the radix prefix tree holds the preempted pages (until eviction
+    recycles them), and re-admission either maps them back or
+    recomputes, bitwise identically either way."""
+    key: object = None             # evolved per-slot PRNG key (sampled)
+    t0: Optional[int] = None       # pending spec-mode seed token
+    emitted: int = 0               # tokens already streamed pre-preempt
+    preemptions: int = 1           # times this request was displaced
+
+
+@dataclasses.dataclass
 class Request:
-    """One generation request (the scheduler's admission unit)."""
+    """One generation request (the scheduler's admission unit).
+
+    deadline_ms: optional latency budget from submit(); an expired
+    request is cancelled with a visible error instead of occupying a
+    slot past its usefulness. resume: set internally by preemption —
+    callers never construct it."""
     rid: object                    # caller's id (any hashable)
     ids: np.ndarray                # prompt token ids [S]
     gen_len: int
     seed: int = 0
+    deadline_ms: Optional[float] = None
+    resume: Optional[ResumeState] = None
 
 
 class DecodeSlots:
@@ -86,6 +137,12 @@ class DecodeSlots:
         # host mirrors (scheduling is host-side; the model never syncs)
         self.remaining = np.zeros((batch,), np.int64)
         self.rids: List[Optional[object]] = [None] * batch
+        # full Request per occupant + admission order — the preemption
+        # victim policy reads both (fewest generated tokens, then most
+        # recently admitted)
+        self.reqs: List[Optional[Request]] = [None] * batch
+        self.admit_tick = np.zeros((batch,), np.int64)
+        self._admit_seq = 0
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
@@ -95,6 +152,7 @@ class DecodeSlots:
                                  "backends")
             self.drafter = drafter if drafter is not None \
                 else NgramDrafter()
+            self._vocab = V
             # per-slot token history (prompt + emitted) — the drafter's
             # lookup corpus — and the pending seed token each verify
             # window starts with
@@ -112,6 +170,10 @@ class DecodeSlots:
             self._spec_accepted_total = 0
             self._spec_drafted = np.zeros((batch,), np.int64)
             self._spec_accepted = np.zeros((batch,), np.int64)
+            # a drafter that raises (or proposes garbage) must degrade
+            # to plain decode, never take down the model loop — the
+            # chaos harness (runtime/chaos.py::FlakyDrafter) pins this
+            self._drafter_errors = 0
 
     def _make_cache(self):
         """Cache-flavor hook (PagedDecodeSlots swaps in the paged pool)."""
@@ -133,22 +195,36 @@ class DecodeSlots:
     def _arm_slot(self, slot: int, req: Request, row_logits, n: int
                   ) -> None:
         """Arm a freshly prefilled slot's rows of the decode carry
-        (shared by the contiguous and paged admit paths)."""
+        (shared by the contiguous and paged admit paths). A RESUMED
+        request (req.resume set — it was preempted mid-stream) restores
+        its snapshot instead of restarting: the evolved PRNG key
+        replaces jax.random.key(seed) so the sampled chain continues
+        exactly where it stopped, and the pending spec seed token is
+        restored rather than re-drawn (re-drawing would consume an
+        extra key split the unpreempted chain never spent)."""
         import jax
+        rs = req.resume
         self.logits = self.logits.at[slot].set(row_logits)
         self.pos = self.pos.at[slot].set(n)
         self.active = self.active.at[slot].set(True)
         if self.keys is not None:
-            self.keys = self.keys.at[slot].set(jax.random.key(req.seed))
+            self.keys = self.keys.at[slot].set(
+                rs.key if rs is not None and rs.key is not None
+                else jax.random.key(req.seed))
         self.remaining[slot] = req.gen_len
         self.rids[slot] = req.rid
+        self.reqs[slot] = req
+        self._admit_seq += 1
+        self.admit_tick[slot] = self._admit_seq
         if self.spec:
             # seed the slot's verify chain: history = prompt, pending
             # seed token = what spec=0 would emit first from these
             # logits (greedy argmax on the host; sampled draws through
             # the slot's PRNG chain so the chain stays per-slot)
             self._hist[slot] = [int(t) for t in np.asarray(req.ids)]
-            if self.engine.sampling == "greedy":
+            if rs is not None and rs.t0 is not None:
+                self._t0[slot] = int(rs.t0)
+            elif self.engine.sampling == "greedy":
                 self._t0[slot] = int(np.argmax(np.asarray(row_logits)))
             else:
                 t0, k2 = self.engine.spec_seed(row_logits,
@@ -171,6 +247,15 @@ class DecodeSlots:
             self.cache, slot, req.ids)
         self._arm_slot(slot, req, row, n)
 
+    def emitted(self, slot: int) -> int:
+        """Tokens this slot's request has streamed since its ORIGINAL
+        admission — resume-aware (a preempted request's pre-preemption
+        span rides in resume.emitted). The single source for the
+        victim policy, deadline messages, and preemption snapshots."""
+        req = self.reqs[slot]
+        base = req.resume.emitted if req.resume is not None else 0
+        return base + req.gen_len - int(self.remaining[slot])
+
     def retire(self, slot: int) -> None:
         """Free a slot: mask it out of the scan. Its cache row and
         carry rows stay as dead data until the next admit overwrites
@@ -178,6 +263,7 @@ class DecodeSlots:
         self.active = self.active.at[slot].set(False)
         self.remaining[slot] = 0
         self.rids[slot] = None
+        self.reqs[slot] = None
         if self.spec:
             self._hist[slot] = []
 
@@ -225,7 +311,17 @@ class DecodeSlots:
                 h = self._hist[b]
                 h.append(int(self._t0[b]))
                 try:
-                    d = list(self.drafter.propose(h, kmax))[:kmax]
+                    d = [int(t) for t in
+                         self.drafter.propose(h, kmax)][:kmax]
+                    if any(not 0 <= t < self._vocab for t in d):
+                        raise ValueError(f"draft token out of vocab "
+                                         f"range [0, {self._vocab})")
+                except Exception:
+                    # a broken drafter degrades to plain decode for
+                    # this window (the verify still emits the seed
+                    # token) — it must never take down the model loop
+                    self._drafter_errors += 1
+                    d = []
                 finally:
                     h.pop()
             else:
@@ -279,6 +375,7 @@ class DecodeSlots:
                                 if self._spec_slot_steps else 0.0),
             "spec_accepted_per_slot": self._spec_accepted.tolist(),
             "spec_drafted_per_slot": self._spec_drafted.tolist(),
+            "drafter_errors": self._drafter_errors,
         }
 
     def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
@@ -378,6 +475,21 @@ class PagedDecodeSlots(DecodeSlots):
                 f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
                 f"exceeds slot capacity {self.capacity}")
         pool = self.prefix.pool
+        # a request whose TOTAL footprint (shared + fresh groups must
+        # all coexist in the pool) exceeds the pool can never be
+        # admitted — reject upfront with a plain ValueError so the
+        # scheduler does not preempt every live slot discovering it
+        # (the cheap denial-of-service a repeated never-fits request
+        # would otherwise buy)
+        # total page groups the admitted slot will map (shared + fresh
+        # must all coexist in the pool); `need` below is total - full
+        total = -(-(n + req.gen_len + self.margin - 1) // self.page)
+        usable = (pool.num_pages - 1) // pool.n_kv_heads
+        if total > usable:
+            raise ValueError(
+                f"request {req.rid!r}: worst-case footprint {total} "
+                f"page groups exceeds the whole pool ({usable} usable "
+                f"groups) — page pool exhausted for this request alone")
         m, shared = self.prefix.lookup(tokens)
         full, r = m // self.page, m % self.page
         retained: List[np.ndarray] = []
@@ -392,10 +504,11 @@ class PagedDecodeSlots(DecodeSlots):
             if boundary is not None:
                 pool.retain(boundary)
                 retained.append(boundary)
-            need = -(-(n + req.gen_len + self.margin - 1)
-                     // self.page) - full
+            need = total - full
             if not self.prefix.ensure_pages(need * pool.n_kv_heads):
-                raise ValueError(
+                from triton_dist_tpu.models.prefix_cache import \
+                    PoolExhausted
+                raise PoolExhausted(
                     f"request {req.rid!r}: page pool exhausted "
                     f"({need} fresh groups needed, "
                     f"{pool.available} pages free, nothing evictable)")
@@ -426,6 +539,33 @@ class PagedDecodeSlots(DecodeSlots):
         # them. N clients connecting at once with one system prompt is
         # the headline case, and they must not all prefill it.
         self.prefix.insert(tokens, slot_groups[:-(-n // self.page)])
+
+    def preempt(self, slot: int) -> Request:
+        """Evict a LIVE slot under pool pressure (vLLM-style recompute
+        preemption) and return the request to re-queue. The snapshot is
+        tiny because the token sequence IS the state: prompt + kept
+        generated tokens become the re-queued request's prompt (its KV
+        goes into the radix tree through the normal retire path, so
+        re-admission maps the pages back while they survive eviction —
+        capped at n-1, only the last token recomputes), gen_len drops
+        to the remaining budget, and ResumeState carries what tokens
+        cannot encode: the evolved PRNG key (sampled chains continue
+        exactly) and the pending spec seed token (already determined,
+        never emitted). Works for slots that were themselves resumed —
+        ids and the emitted counter just keep accumulating."""
+        req = self.reqs[slot]
+        assert req is not None, f"slot {slot} is empty"
+        toks = np.asarray(self._tokens[slot], np.int32)
+        remaining = int(self.remaining[slot])
+        rs = req.resume
+        snap = ResumeState(
+            key=self.keys[slot] if self.keys is not None else None,
+            t0=int(self._t0[slot]) if self.spec else None,
+            emitted=self.emitted(slot),
+            preemptions=(rs.preemptions + 1) if rs is not None else 1)
+        self.retire(slot)      # tree insert + ref release + trash rows
+        return dataclasses.replace(req, ids=toks, gen_len=remaining,
+                                   resume=snap)
 
     def retire(self, slot: int) -> None:
         """Insert the finished sequence back into the tree (the pages
@@ -472,7 +612,10 @@ class ContinuousScheduler:
     def __init__(self, engine, *, batch: int, chunk: int = 4,
                  paged: bool = False, prefix_cache: bool = True,
                  page: int = 16, num_pages: Optional[int] = None,
-                 spec: int = 0, drafter=None):
+                 spec: int = 0, drafter=None,
+                 max_queue: Optional[int] = None,
+                 watchdog_s: Optional[float] = None,
+                 preempt: bool = True, fault=None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -488,7 +631,20 @@ class ContinuousScheduler:
         identical to spec=0; sampled streams stay distributionally
         exact. `drafter` defaults to the n-gram/prompt-lookup
         NgramDrafter; stats() then reports spec_accept_rate and
-        tokens_per_step."""
+        tokens_per_step.
+
+        Resilience knobs (module docstring has the full story):
+        max_queue bounds the waiting line (submit() returns False on
+        overflow — backpressure, not an unbounded deque); watchdog_s
+        runs each decode chunk under runtime/stress.py::watchdog so a
+        hang becomes a HANG verdict in stats() + a HangError, never a
+        frozen loop (cost: one short-lived thread per chunk — the
+        verdict's price; leave it None when chasing peak loop
+        throughput); preempt=False disables KV-pressure preemption
+        (pool exhaustion then hard-rejects as before — the differential
+        baseline for the bitwise preemption tests); fault is an
+        optional chaos hook (runtime/chaos.py::FaultInjector) consulted
+        before every admission."""
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
@@ -498,14 +654,52 @@ class ContinuousScheduler:
             self.slots = DecodeSlots(engine, batch, spec=spec,
                                      drafter=drafter)
         self.chunk = chunk
+        self.max_queue = max_queue
+        self.watchdog_s = watchdog_s
+        self.preempt = preempt
+        self.fault = fault
         self._queue: deque = deque()
+        # guards _queue/_deadline against cross-thread submit()/cancel()
+        # racing the driver thread's poll() (the class contract allows
+        # enqueueing from any thread; a bare deque.append was atomic
+        # under the GIL, but the deadline stamp + max_queue bound are
+        # check-then-act sequences and _expire_deadlines iterates)
+        self._lock = threading.Lock()
+        # rid -> absolute monotonic deadline for requests that carry a
+        # deadline_ms budget; preserved across preemptions (keyed by
+        # rid, stamped once at first submit)
+        self._deadline: Dict[object, float] = {}
         # rid -> rejection reason for requests the slots refused (the
         # serving layer pops these to tell the client WHY it got zero
         # tokens instead of a success-shaped empty stream)
         self.rejected: Dict[object, str] = {}
+        self.preemptions = 0
+        self.deadline_expired = 0
+        self.busy_rejections = 0
+        self._hang: Optional[str] = None
 
-    def submit(self, req: Request) -> None:
-        self._queue.append(req)
+    def submit(self, req: Request) -> bool:
+        """Enqueue a request. Returns False — WITHOUT queueing — when
+        the waiting line is at max_queue: the caller owes the client a
+        busy/retry-later reply instead of unbounded buffering. Internal
+        re-queues (preemption) bypass the bound — a preempted request
+        was already admitted once and must never be dropped.
+        Thread-safe: any thread may submit while the driver polls."""
+        with self._lock:
+            if self.max_queue is not None \
+                    and len(self._queue) >= self.max_queue:
+                self.busy_rejections += 1
+                return False
+            if req.deadline_ms is not None \
+                    and req.rid not in self._deadline:
+                self._deadline[req.rid] = time.monotonic() \
+                    + req.deadline_ms / 1e3
+            self._queue.append(req)
+            return True
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
 
     def cancel(self, rid) -> bool:
         """Drop a request mid-flight (cancel-on-disconnect): a queued
@@ -514,62 +708,201 @@ class ContinuousScheduler:
         decoding to gen_len with the tokens falling on the floor. The
         tokens generated so far are still valid, so a paged retire
         inserts them into the prefix tree as usual. Returns False for
-        an unknown/finished rid."""
-        for i, r in enumerate(self._queue):
-            if r.rid == rid:
-                del self._queue[i]
-                return True
+        an unknown/finished rid.
+
+        Threading contract: removing a QUEUED request is safe from any
+        thread (it shares the submit lock). Cancelling an IN-FLIGHT
+        slot mutates the decode carry and releases pages, so it must
+        run on the driver thread or be serialized with poll() by the
+        caller — racing a live chunk could retire a slot the driver
+        just re-armed and free pages a masked row still writes.
+        TokenServer does exactly this: cancel and poll both run under
+        its own lock."""
+        with self._lock:
+            for i, r in enumerate(self._queue):
+                if r.rid == rid:
+                    del self._queue[i]
+                    self._deadline.pop(rid, None)
+                    return True
         for b in self.slots.occupied:
             if self.slots.rids[b] == rid:
                 self.slots.retire(b)
+                with self._lock:
+                    self._deadline.pop(rid, None)
                 return True
         return False
 
     def stats(self) -> dict:
-        """Serving counters: prefix-cache hit/skip (paged path) and
+        """Serving counters: prefix-cache hit/skip (paged path),
         speculative-decoding accept counters (spec=K mode —
-        spec_accept_rate, tokens_per_step); empty for the plain
-        contiguous path."""
-        return getattr(self.slots, "stats", {})
+        spec_accept_rate, tokens_per_step), and the resilience
+        counters: queue_depth, preemptions, deadline_expired,
+        busy_rejections, plus a "hang" verdict string once a
+        watchdogged chunk has missed its deadline."""
+        out = dict(getattr(self.slots, "stats", {}) or {})
+        out.update({
+            "queue_depth": len(self._queue),
+            "preemptions": self.preemptions,
+            "deadline_expired": self.deadline_expired,
+            "busy_rejections": self.busy_rejections,
+        })
+        if self._hang is not None:
+            out["hang"] = self._hang
+        return out
 
     @property
     def idle(self) -> bool:
         return not self._queue and not self.slots.occupied
 
-    def poll(self) -> Tuple[Dict[object, np.ndarray], List[object]]:
-        """One scheduling iteration: refill free slots from the queue,
-        run one decode chunk, retire what finished. Returns
-        ({rid: new tokens}, [rids finished this chunk]). A request the
-        slots REJECT (e.g. prompt + gen beyond capacity) is reported as
-        finished with no tokens — one bad request must never take down
-        the serving loop (the old per-request server survived bad
-        clients too)."""
-        rejected: List[object] = []
-        for slot in self.slots.free:
-            if not self._queue:
-                break
-            req = self._queue.popleft()
+    def _reject(self, rid, reason: str) -> None:
+        import sys
+        print(f"[scheduler] rejected request {rid!r}: {reason}",
+              file=sys.stderr)
+        self.rejected[rid] = reason
+        while len(self.rejected) > 1024:
+            # bound the side channel: callers that never read
+            # reasons (run()/bench loops) must not leak — drop
+            # oldest first (dict preserves insertion order)
+            self.rejected.pop(next(iter(self.rejected)))
+        self._deadline.pop(rid, None)
+
+    def _expire_deadlines(self, done: List[object]) -> None:
+        """Cancel everything past its deadline_ms budget: queued
+        requests are dropped before wasting an admission; in-flight
+        slots retire NOW (a paged retire still donates the partial
+        sequence to the prefix tree — the tokens are valid), with a
+        visible reason the serving layer reports as an error."""
+        if not self._deadline:
+            return
+        now = time.monotonic()
+        expired = {rid for rid, dl in self._deadline.items()
+                   if now >= dl}
+        if not expired:
+            return
+        if any(r.rid in expired for r in self._queue):
+            keep: deque = deque()
+            for r in self._queue:
+                if r.rid in expired:
+                    self.deadline_expired += 1
+                    if r.resume is not None:
+                        # preempted mid-stream, expired while waiting
+                        # to resume: the client DID receive tokens —
+                        # say so, like the in-flight branch
+                        reason = (f"deadline_ms={r.deadline_ms:g} "
+                                  f"exceeded after {r.resume.emitted} "
+                                  f"tokens (preempted, awaiting resume)")
+                    else:
+                        reason = (f"deadline_ms={r.deadline_ms:g} "
+                                  f"expired before admission")
+                    self._reject(r.rid, reason)
+                    done.append(r.rid)
+                else:
+                    keep.append(r)
+            self._queue = keep
+        for b in list(self.slots.occupied):
+            rid = self.slots.rids[b]
+            if rid in expired:
+                req = self.slots.reqs[b]
+                emitted = self.slots.emitted(b)
+                self.slots.retire(b)
+                self.deadline_expired += 1
+                self._reject(rid, f"deadline_ms={req.deadline_ms:g} "
+                                  f"exceeded after {emitted} tokens")
+                done.append(rid)
+
+    def _pick_victim(self) -> int:
+        """Preemption victim policy: fewest generated tokens (least
+        recompute thrown away — the long-running streams finish), ties
+        to the most recently admitted (it displaced the least)."""
+        slots = self.slots
+        return min(slots.occupied,
+                   key=lambda b: (slots.emitted(b),
+                                  -int(slots.admit_tick[b])))
+
+    def _admit(self, done: List[object]) -> None:
+        """Refill free slots from the waiting line. A PoolExhausted
+        admission PREEMPTS a victim and retries instead of rejecting,
+        whenever a victim exists — the victim's request re-queues right
+        behind the admission that displaced it, its pages now evictable
+        through the prefix tree. Hard rejection remains only when every
+        victim is gone and the pool still cannot fit the request (it
+        alone exceeds capacity). A request preempted within THIS poll
+        that immediately fails re-admission waits for the next chunk
+        instead of thrashing the slots it just lost."""
+        from triton_dist_tpu.models.prefix_cache import PoolExhausted
+        preempted_now: set = set()
+        while self._queue:
+            free = self.slots.free
+            if not free:
+                return
+            req = self._queue[0]
             try:
-                self.slots.admit(slot, req)
+                if self.fault is not None:
+                    self.fault.admission(req)
+                self.slots.admit(free[0], req)
+                self._queue.popleft()
+            except PoolExhausted as e:
+                can_preempt = (self.preempt and self.slots.occupied
+                               and hasattr(self.slots, "preempt"))
+                if not can_preempt:
+                    self._queue.popleft()
+                    self._reject(req.rid, str(e))
+                    done.append(req.rid)
+                    continue
+                if req.rid in preempted_now:
+                    return
+                victim = self.slots.preempt(self._pick_victim())
+                self.preemptions += 1
+                preempted_now.add(victim.rid)
+                self._queue.insert(1, victim)
             except ValueError as e:
-                import sys
-                print(f"[scheduler] rejected request {req.rid!r}: {e}",
-                      file=sys.stderr)
-                self.rejected[req.rid] = str(e)
-                while len(self.rejected) > 1024:
-                    # bound the side channel: callers that never read
-                    # reasons (run()/bench loops) must not leak — drop
-                    # oldest first (dict preserves insertion order)
-                    self.rejected.pop(next(iter(self.rejected)))
-                rejected.append(req.rid)
+                self._queue.popleft()
+                self._reject(req.rid, str(e))
+                done.append(req.rid)
+
+    def poll(self) -> Tuple[Dict[object, np.ndarray], List[object]]:
+        """One scheduling iteration: expire deadlines, refill free
+        slots from the queue (preempting under pool pressure), run one
+        decode chunk (optionally under the watchdog), retire what
+        finished. Returns ({rid: new tokens}, [rids done this chunk] —
+        finished, rejected, or deadline-expired; rejected/expired rids
+        have their reason in self.rejected). A request the slots REJECT
+        (e.g. prompt + gen beyond capacity) is reported as finished
+        with no tokens — one bad request must never take down the
+        serving loop. A PREEMPTED request is in neither list: it
+        silently re-queues and its rid keeps streaming on resume."""
+        done: List[object] = []
+        with self._lock:
+            # the queue-mutating phases run under the submit lock; the
+            # decode chunk below does not (submitters may enqueue while
+            # the model steps)
+            self._expire_deadlines(done)
+            self._admit(done)
         if not self.slots.occupied:
-            return {}, rejected
-        by_slot, finished = self.slots.step_chunk(self.chunk)
+            return {}, done
+        if self.watchdog_s is not None:
+            from triton_dist_tpu.runtime.stress import watchdog
+            try:
+                by_slot, finished = watchdog(
+                    lambda: self.slots.step_chunk(self.chunk),
+                    self.watchdog_s,
+                    label=f"scheduler chunk (chunk={self.chunk})")
+            except Exception as e:
+                from triton_dist_tpu.runtime.stress import HangError
+                if isinstance(e, HangError):
+                    # record the verdict for stats(), then unwind: the
+                    # process is poisoned (stress.watchdog contract) and
+                    # the one unacceptable outcome is a silent freeze
+                    self._hang = str(e)
+                raise
+        else:
+            by_slot, finished = self.slots.step_chunk(self.chunk)
         rid_of = self.slots.rids
         out = {rid_of[b]: t for b, t in by_slot.items()}
-        done = rejected
         for b, rid in finished:
             self.slots.retire(b)
+            with self._lock:
+                self._deadline.pop(rid, None)
             done.append(rid)
         return out, done
 
@@ -578,7 +911,10 @@ class ContinuousScheduler:
         harness loop; a server calls poll() itself to interleave
         streaming I/O). Returns {rid: tokens [gen_len]}."""
         for r in requests:
-            self.submit(r)
+            if not self.submit(r):
+                raise RuntimeError(
+                    f"queue full (max_queue={self.max_queue}); run() "
+                    f"has no retry loop — submit through a server")
         acc: Dict[object, list] = {r.rid: [] for r in requests}
         while not self.idle:
             out, _ = self.poll()

@@ -1,0 +1,178 @@
+"""Chaos harness for the serving tier: deterministic fault injection.
+
+The reference repo treats robustness as a first-class surface —
+randomized stress loops, straggler injection, ``--verify_hang`` — and
+`runtime/stress.py` ports that discipline to the kernel tier. This
+module is the SERVING-tier counterpart: every way a production token
+server gets abused, packaged as reusable injectors so
+`tests/test_resilience.py` (and anyone's soak script) can assert the
+invariants that matter — the server never crashes, no page leaks
+(``available + outstanding == num_pages`` on the paged pool), and
+surviving clients' token streams stay bitwise exact.
+
+Pieces:
+  - ``FaultInjector``: scheduler-side hook
+    (``ContinuousScheduler(fault=...)``) that forces PoolExhausted at
+    chosen admission indices — exercises the preemption/requeue path
+    deterministically, without actually draining the pool.
+  - ``FlakyDrafter``: a Drafter wrapper that raises (or babbles
+    garbage) on schedule; the scheduler must degrade to plain decode
+    for that window, never die (spec=K resilience).
+  - misbehaving clients (host-side socket abusers for a live
+    TokenServer): ``malformed_client`` (garbage request line),
+    ``oversized_client`` (a request "line" bigger than the server's
+    cap, no newline in sight), ``disconnecting_client`` (hangs up
+    mid-stream), ``slow_client`` (stalls before sending — a
+    half-open connection must not block the accept loop).
+
+Everything is index/seed-deterministic so the tier-1 chaos smoke is
+reproducible; the randomized soak composing these lives in
+tests/test_resilience.py (marked slow).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Iterable, List, Optional, Tuple
+
+
+class FaultInjector:
+    """Deterministic admission faults for ContinuousScheduler(fault=...).
+
+    ``exhaust_admissions`` names the 0-based admission ATTEMPT indices
+    (every call into the hook counts, including retries after a
+    preemption) at which the hook raises PoolExhausted — the scheduler
+    then runs its real pressure path: preempt a victim and retry, or
+    hard-reject when none exists. Because the schedule is index-based,
+    the retry that follows a forced failure sees a new index and
+    proceeds, so one entry forces exactly one preemption."""
+
+    def __init__(self, *, exhaust_admissions: Iterable[int] = ()):
+        self.exhaust_admissions = {int(i) for i in exhaust_admissions}
+        self.admissions_seen = 0
+        self.injected = {"pool_exhausted": 0}
+
+    def admission(self, req) -> None:
+        i = self.admissions_seen
+        self.admissions_seen += 1
+        if i in self.exhaust_admissions:
+            from triton_dist_tpu.models.prefix_cache import PoolExhausted
+            self.injected["pool_exhausted"] += 1
+            raise PoolExhausted(
+                f"request {req.rid!r}: page pool exhausted "
+                f"(chaos injection, admission attempt {i})")
+
+
+class FlakyDrafter:
+    """Drafter wrapper that fails on schedule: every ``fail_every``-th
+    propose() raises (or, with garbage=True, returns out-of-vocab
+    tokens — the other way a buggy drafter can poison a verify window).
+    The scheduler must swallow both, count them in
+    stats()["drafter_errors"], and keep the token streams bitwise
+    identical to spec=0 — a drafter can only ever ACCELERATE decode."""
+
+    def __init__(self, inner=None, *, fail_every: int = 3,
+                 garbage: bool = False):
+        self.inner = inner
+        self.fail_every = max(1, int(fail_every))
+        self.garbage = garbage
+        self.calls = 0
+        self.failures = 0
+
+    def propose(self, history, k: int) -> List[int]:
+        self.calls += 1
+        if self.calls % self.fail_every == 0:
+            self.failures += 1
+            if self.garbage:
+                return [-1] * max(1, k)        # out-of-vocab poison
+            raise RuntimeError(
+                f"chaos: drafter failure #{self.failures}")
+        if self.inner is None:
+            return []
+        return self.inner.propose(history, k)
+
+
+# ----------------------------------------------------------------------
+# misbehaving clients (run these against a live TokenServer)
+# ----------------------------------------------------------------------
+
+
+def _read_reply(sock: socket.socket) -> Optional[dict]:
+    """One reply line, parsed; None when the server closed silently."""
+    with sock.makefile("r") as f:
+        line = f.readline()
+    if not line.strip():
+        return None
+    return json.loads(line)
+
+
+def malformed_client(host: str, port: int,
+                     payload: bytes = b'{"prompt": not json\n', *,
+                     timeout: float = 60.0) -> Optional[dict]:
+    """Send a garbage request line; return the server's structured
+    refusal ({"done": true, "error": ...}) — the server must reply,
+    not just slam the connection, and must keep serving afterwards."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(payload)
+        return _read_reply(s)
+
+
+def oversized_client(host: str, port: int, *, nbytes: int = 1 << 20,
+                     timeout: float = 60.0) -> Optional[dict]:
+    """Firehose: one request "line" of nbytes garbage (newline only at
+    the very end). The server must cap the read and refuse with a
+    structured error instead of ballooning a reader thread."""
+    blob = b"A" * nbytes + b"\n"
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(blob)
+        return _read_reply(s)
+
+
+def disconnecting_client(host: str, port: int, prompt: str, *,
+                         gen_len: int = 64, after_chunks: int = 1,
+                         seed: int = 0, timeout: float = 120.0
+                         ) -> List[int]:
+    """Start a stream, read ``after_chunks`` chunk messages, hang up
+    mid-stream. Returns the tokens seen before the hangup — the server
+    must cancel the slot (pages freed) instead of decoding to gen_len
+    for nobody."""
+    toks: List[int] = []
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        f = s.makefile("rw")
+        f.write(json.dumps({"prompt": prompt, "gen_len": gen_len,
+                            "seed": seed}) + "\n")
+        f.flush()
+        for _ in range(after_chunks):
+            line = f.readline()
+            if not line:
+                break
+            msg = json.loads(line)
+            if msg.get("done") or msg.get("busy"):
+                break
+            toks.extend(msg.get("token_ids", []))
+    return toks                     # context exit = mid-stream hangup
+
+
+def slow_client(host: str, port: int, prompt: str, *,
+                gen_len: int = 8, delay_s: float = 0.3, seed: int = 0,
+                timeout: float = 300.0) -> Tuple[List[int],
+                                                 Optional[dict]]:
+    """Connect, then stall ``delay_s`` BEFORE sending the request line
+    (a half-open connection parks one reader thread, and must not block
+    the accept loop or the other clients' streams), then stream
+    normally. Returns (tokens, final done message)."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        time.sleep(delay_s)
+        f = s.makefile("rw")
+        f.write(json.dumps({"prompt": prompt, "gen_len": gen_len,
+                            "seed": seed}) + "\n")
+        f.flush()
+        toks: List[int] = []
+        for line in f:
+            msg = json.loads(line)
+            if msg.get("done") or msg.get("busy"):
+                return toks, msg
+            toks.extend(msg.get("token_ids", []))
+    return toks, None

@@ -14,6 +14,11 @@ Pieces:
     credit/slot protocols (late producer, early consumer).
   - ``watchdog``: runs a computation on a daemon thread with a deadline;
     a deadlock surfaces as a clean HANG verdict instead of a stuck CI.
+    The serving tier reuses it per decode chunk
+    (models/scheduler.py::ContinuousScheduler watchdog_s) so a hung
+    compile or stuck chunk becomes a HANG verdict in stats() instead of
+    a frozen model loop; the serving-side fault INJECTION lives in
+    runtime/chaos.py.
   - ``race_state`` helpers: read/reset the Pallas interpreter's race
     detector (enabled via TDTPU_DETECT_RACES=1).
 """
@@ -45,7 +50,16 @@ def straggler_tax(x, me, rank, *, iters: int = 30, size: int = 256):
 
 
 class HangError(RuntimeError):
-    pass
+    """A watchdogged computation missed its deadline. `label` and
+    `timeout_s` carry the structured verdict for stats surfaces (the
+    serving tier reports str(e) under stats()["hang"] —
+    models/scheduler.py watchdog_s mode)."""
+
+    def __init__(self, msg: str, *, label: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        super().__init__(msg)
+        self.label = label
+        self.timeout_s = timeout_s
 
 
 def watchdog(fn: Callable[[], Any], timeout_s: float,
@@ -67,7 +81,9 @@ def watchdog(fn: Callable[[], Any], timeout_s: float,
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        raise HangError(f"HANG: {label} still running after {timeout_s}s")
+        raise HangError(
+            f"HANG: {label} still running after {timeout_s}s",
+            label=label, timeout_s=timeout_s)
     if "error" in result:
         raise result["error"]
     return result["value"]

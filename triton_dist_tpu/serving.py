@@ -31,6 +31,23 @@ False. The final {"done": ...} message then reports a "cache" dict
 are detected (EOF probe or failed write) and their slot is CANCELLED —
 pages freed and the partial sequence inserted into the prefix tree —
 instead of decoding to gen_len for nobody.
+
+Resilience (models/scheduler.py has the scheduler-side story):
+- a malformed request (bad JSON, over-capacity prompt, an unbounded
+  garbage "line" past _MAX_LINE bytes) gets a structured
+  {"done": true, "error": ...} refusal before the close — never a
+  silent slam, never a ballooning reader buffer;
+- max_queue bounds the accept line: overflow is answered with
+  {"busy": true, "retry_after_ms": ...} (retry_after scaled by the
+  measured poll cadence x queue depth), and request_stream retries it
+  with bounded backoff — as it retries refused connects during server
+  startup;
+- requests may carry "deadline_ms"; an expired request is cancelled
+  with a visible error in its done message;
+- under KV-pool pressure the scheduler PREEMPTS a victim slot instead
+  of rejecting (the client just sees a pause — resumed streams are
+  bitwise identical), and a hung decode chunk (watchdog_s) ends the
+  loop with a HANG error to every live client instead of freezing.
 """
 
 from __future__ import annotations
@@ -38,9 +55,24 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
+
+# longest accepted request line: a protocol message is a few hundred
+# bytes; anything bigger is a firehose and gets a structured refusal
+_MAX_LINE = 65536
+
+
+class ServerBusy(RuntimeError):
+    """request_stream exhausted its busy retries; retry_after_ms is the
+    server's latest hint."""
+
+    def __init__(self, retry_after_ms: float):
+        super().__init__(
+            f"server busy (retry_after_ms={retry_after_ms:g})")
+        self.retry_after_ms = retry_after_ms
 
 
 class ByteTokenizer:
@@ -102,7 +134,8 @@ class TokenServer:
                  chunk: int = 4, paged: bool = False,
                  prefix_cache: bool = True, page: int = 16,
                  num_pages: Optional[int] = None, spec: int = 0,
-                 drafter=None):
+                 drafter=None, max_queue: Optional[int] = None,
+                 watchdog_s: Optional[float] = None, fault=None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -115,7 +148,13 @@ class TokenServer:
         prompt-lookup drafting by default): every slot streams 1..K+1
         tokens per model forward, token-for-token identical to spec=0
         under greedy sampling. stats() then also reports
-        spec_accept_rate and tokens_per_step."""
+        spec_accept_rate and tokens_per_step.
+
+        max_queue bounds the waiting line (overflow clients get
+        {"busy": true, "retry_after_ms": ...}); watchdog_s deadlines
+        every decode chunk (a hang ends serve_forever with a clean
+        error to every client); fault is a chaos hook
+        (runtime/chaos.py::FaultInjector) for resilience tests."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -125,7 +164,9 @@ class TokenServer:
         self.sched = ContinuousScheduler(
             engine, batch=batch, chunk=chunk, paged=paged,
             prefix_cache=prefix_cache, page=page, num_pages=num_pages,
-            spec=spec, drafter=drafter)
+            spec=spec, drafter=drafter, max_queue=max_queue,
+            watchdog_s=watchdog_s, fault=fault)
+        self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -147,47 +188,128 @@ class TokenServer:
             self.n = 0
             self.dead = False
 
+    @staticmethod
+    def _refuse(conn, f, msg: dict) -> None:
+        """Best-effort structured refusal, then close: a bad or
+        refused request gets a visible reason, never a silent slam.
+        Before closing, signal end-of-stream and BRIEFLY drain unread
+        input (the oversized-line path leaves the rest of the firehose
+        in the receive queue; closing with unread bytes makes TCP send
+        RST, which can discard the refusal before the client reads it).
+        The drain is bounded in time and bytes so an endless firehose
+        cannot park this thread."""
+        try:
+            f.write(json.dumps(msg) + "\n")
+            f.flush()
+        except (OSError, ValueError):
+            pass
+        try:
+            conn.shutdown(socket.SHUT_WR)
+            conn.settimeout(0.25)
+            drained, t0 = 0, time.monotonic()
+            while drained < (4 << 20) and time.monotonic() - t0 < 1.0:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                drained += len(chunk)
+        except OSError:
+            pass
+        for closer in (f.close, conn.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
     def _reader(self, conn: socket.socket) -> None:
-        """Connection thread: parse ONE request line, enqueue it for
-        the model loop, leave the socket open for streaming replies."""
+        """Connection thread: parse ONE request line (capped at
+        _MAX_LINE bytes — a garbage firehose cannot balloon this
+        thread), enqueue it for the model loop, leave the socket open
+        for streaming replies. Every refusal — malformed JSON,
+        over-capacity prompt, oversized line, full queue — is answered
+        with a structured line before the close."""
         import sys
         from triton_dist_tpu.models.scheduler import Request
         try:
             conn.settimeout(60.0)   # a silent client cannot hold a slot
             f = conn.makefile("rw")
-            line = f.readline()
+            try:
+                line = f.readline(_MAX_LINE + 1)
+            except UnicodeDecodeError:
+                # the reply side of the text-mode file is independent
+                # of the poisoned read side — refuse, don't hang the
+                # client until its timeout
+                self._refuse(conn, f, {
+                    "done": True, "n_tokens": 0,
+                    "error": "bad request: line is not valid UTF-8"})
+                return
             if not line.strip():
                 conn.close()
                 return
-            req = json.loads(line)
-            ids = self.tok.encode(req.get("prompt", "")) or [0]
-            gen_len = int(req.get("gen_len", 16))
+            # readline's cap counts decoded CHARACTERS; the contract is
+            # BYTES (multi-byte UTF-8 would otherwise stretch it 4x)
+            if len(line) > _MAX_LINE or len(line.encode()) > _MAX_LINE:
+                self._refuse(conn, f, {
+                    "done": True, "n_tokens": 0,
+                    "error": f"request line exceeds {_MAX_LINE} bytes"})
+                return
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+                ids = self.tok.encode(str(req.get("prompt", ""))) or [0]
+                gen_len = int(req.get("gen_len", 16))
+                seed = int(req.get("seed", 0))
+                deadline_ms = req.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+            except (ValueError, KeyError, TypeError) as e:
+                self._refuse(conn, f, {
+                    "done": True, "n_tokens": 0,
+                    "error": f"bad request: {type(e).__name__}: {e}"})
+                return
             # clamp to slot capacity (prompt + gen must fit the slot);
             # a prompt with no room for even one token is refused here
             # with a visible error instead of occupying a slot
             slot_cap = self.sched.slots.capacity
             cap = slot_cap - len(ids)
             if cap < 1:
-                f.write(json.dumps({
+                self._refuse(conn, f, {
                     "done": True, "n_tokens": 0,
                     "error": f"prompt of {len(ids)} tokens exceeds "
-                             f"capacity {slot_cap - 1}"}) + "\n")
-                f.flush()
-                conn.close()
+                             f"capacity {slot_cap - 1}"})
                 return
             gen_len = max(1, min(gen_len, cap))
-            seed = int(req.get("seed", 0))
             with self._lock:
                 rid = self._next_rid
                 self._next_rid += 1
-                self._conns[rid] = self._ClientStream(conn, f)
-                self.sched.submit(Request(
+                accepted = self.sched.submit(Request(
                     rid=rid, ids=np.asarray(ids, np.int32),
-                    gen_len=gen_len, seed=seed))
-        except (OSError, ValueError, KeyError) as e:
+                    gen_len=gen_len, seed=seed,
+                    deadline_ms=deadline_ms))
+                if accepted:
+                    self._conns[rid] = self._ClientStream(conn, f)
+                else:
+                    hint = self._retry_after_ms()
+            if not accepted:
+                # backpressure, not an unbounded queue: tell the client
+                # WHEN to come back instead of buffering it forever
+                self._refuse(conn, f, {"busy": True,
+                                       "retry_after_ms": hint})
+        except OSError as e:
             print(f"[TokenServer] bad request: {type(e).__name__}: {e}",
                   file=sys.stderr)
-            conn.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _retry_after_ms(self) -> int:
+        """Backpressure hint: the measured poll cadence times the line
+        ahead of the client — crude, but it scales with actual load
+        instead of being a magic constant."""
+        depth = self.sched.queue_depth
+        return int(max(25.0, min(5000.0,
+                                 1e3 * self._poll_ema * (depth + 2))))
 
     def _emit(self, rid, toks) -> None:
         """Stream one chunk's tokens to the owning client; a dead
@@ -235,17 +357,19 @@ class TokenServer:
 
     def stats(self) -> dict:
         """Serving counters: prefix-cache (hit rate, prefill tokens
-        skipped — paged path) and speculative decoding
-        (spec_accept_rate, tokens_per_step — spec=K mode); empty dict
-        for the plain contiguous path."""
+        skipped — paged path), speculative decoding (spec_accept_rate,
+        tokens_per_step — spec=K mode), and the resilience counters
+        (queue_depth, preemptions, deadline_expired, busy_rejections,
+        "hang" verdict once a watchdogged chunk missed its deadline)."""
         with self._lock:
             return dict(self.sched.stats())
 
-    def _finish(self, rid) -> None:
+    def _finish(self, rid, error: Optional[str] = None) -> None:
         cs = self._conns.pop(rid, None)
         if cs is None:
             return
-        reason = self.sched.rejected.pop(rid, None)
+        reason = error if error is not None \
+            else self.sched.rejected.pop(rid, None)
         try:
             if not cs.dead:
                 msg = {"done": True, "n_tokens": cs.n}
@@ -274,7 +398,12 @@ class TokenServer:
         """Model loop: accept connections (handing each to a reader
         thread), then run the scheduler — admit, one chunk, stream each
         slot's tokens to its client. max_requests counts COMPLETED
-        requests (so a test can serve N concurrent clients and exit)."""
+        requests (so a test can serve N concurrent clients and exit).
+        A watchdogged chunk that hangs (watchdog_s) ends the loop with
+        a structured HANG error to every live client — the process is
+        poisoned (runtime/stress.py::watchdog contract), and a visible
+        verdict beats a silent freeze."""
+        from triton_dist_tpu.runtime.stress import HangError
         done_count = 0
         self._sock.settimeout(0.02)
         try:
@@ -289,8 +418,16 @@ class TokenServer:
                         break
                     threading.Thread(target=self._reader, args=(conn,),
                                      daemon=True).start()
-                with self._lock:
-                    out, finished = self.sched.poll()
+                t0 = time.monotonic()
+                try:
+                    with self._lock:
+                        out, finished = self.sched.poll()
+                except HangError as e:
+                    for rid in list(self._conns):
+                        self._finish(rid, error=str(e))
+                    break
+                self._poll_ema = 0.9 * self._poll_ema + \
+                    0.1 * (time.monotonic() - t0)
                 for rid, toks in out.items():
                     self._emit(rid, toks)
                 for rid in finished:
@@ -324,17 +461,54 @@ class TokenServer:
 
 def request_stream(host: str, port: int, prompt: str, *,
                    gen_len: int = 16, seed: int = 0,
-                   timeout: float = 300.0) -> Iterator[dict]:
+                   timeout: float = 300.0,
+                   deadline_ms: Optional[float] = None,
+                   connect_retries: int = 8,
+                   connect_backoff_s: float = 0.05,
+                   busy_retries: int = 4) -> Iterator[dict]:
     """Client: send one prompt, yield the server's chunk messages as
-    they arrive (the last one has {"done": true}). Reference: the
-    chat.py client's receive loop."""
-    with socket.create_connection((host, port), timeout=timeout) as s:
-        with s.makefile("rw") as f:
-            f.write(json.dumps({"prompt": prompt, "gen_len": gen_len,
-                                "seed": seed}) + "\n")
+    they arrive (the last one has {"done": true}, possibly carrying an
+    "error" — rejection, deadline expiry, server hang — which callers
+    should check rather than trusting n_tokens). Reference: the chat.py
+    client's receive loop.
+
+    Resilient by default: a refused connect (server still starting —
+    the classic flaky-test source) retries with bounded exponential
+    backoff, and a {"busy": ...} backpressure reply sleeps the server's
+    retry_after_ms hint and resubmits, up to busy_retries times before
+    raising ServerBusy. Busy replies are consumed internally — they are
+    NEVER yielded as chunks."""
+    payload = {"prompt": prompt, "gen_len": gen_len, "seed": seed}
+    if deadline_ms is not None:
+        payload["deadline_ms"] = deadline_ms
+    connects = 0
+    busy_left = busy_retries
+    while True:
+        try:
+            s = socket.create_connection((host, port), timeout=timeout)
+        except OSError:
+            if connects >= connect_retries:
+                raise
+            time.sleep(min(connect_backoff_s * (2 ** connects), 2.0))
+            connects += 1
+            continue
+        retry_ms = None
+        with s, s.makefile("rw") as f:
+            f.write(json.dumps(payload) + "\n")
             f.flush()
             for line in f:
                 msg = json.loads(line)
+                if msg.get("busy"):
+                    retry_ms = float(msg.get("retry_after_ms", 100.0))
+                    break
                 yield msg
                 if msg.get("done"):
                     return
+            else:
+                return      # server closed without a done message
+        if retry_ms is None:
+            return
+        if busy_left <= 0:
+            raise ServerBusy(retry_ms)
+        busy_left -= 1
+        time.sleep(retry_ms / 1e3)
