@@ -81,17 +81,21 @@ def _run_captured(cmd, env, timeout):
         return rc, fo.read(), fe.read()
 
 
-def _probe_backend(timeout=180):
+def _probe_backend(timeout=180, env_overrides=None):
     """Ask a short-lived subprocess which backend jax initializes.
 
     Returns the backend name, or None when init raises or hangs (the
     round-4 outage mode: the axon plugin asleep in a nanosleep probe
     loop). The probe is a subprocess so a hang costs `timeout` seconds,
-    not the whole driver budget.
+    not the whole driver budget. env_overrides lets the caller re-probe
+    a specific backend (the CPU re-probe that tells a plugin outage
+    apart from a host with no working backend at all).
     """
     code = "import jax; print('BACKEND=' + jax.default_backend())"
-    rc, out, _ = _run_captured([sys.executable, "-c", code],
-                               dict(os.environ), timeout)
+    env = dict(os.environ)
+    if env_overrides:
+        env.update(env_overrides)
+    rc, out, _ = _run_captured([sys.executable, "-c", code], env, timeout)
     if rc != 0:
         return None
     for ln in out.splitlines():
@@ -430,6 +434,87 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- chunked-prefill rows (models/scheduler.py step_mixed,
+    # Sarathi-Serve 2403.02310): a LONG prompt admitted into a busy
+    # decode batch. ttft_under_decode_load_ms is the long request's
+    # submit-to-first-token under that load, chunked (prefill_budget)
+    # vs monolithic; inter_token_p99_ms is the p99 (and max) wall-clock
+    # gap between consecutive tokens of the LIVE streams while the
+    # prompt is absorbed — the head-of-line stall the chunk budget
+    # bounds (monolithically the whole prompt prefills inside one poll
+    # and every live stream's next token waits behind it).
+    if on_tpu:
+        cl_live, cl_plen, cl_gen, cl_long, cl_budget = 6, 16, 192, 384, 32
+    else:
+        cl_live, cl_plen, cl_gen, cl_long, cl_budget = 2, 4, 24, 32, 4
+    eng_c = Engine(model, max_seq=cl_long + cl_gen + 16, backend=backend,
+                   kv_dtype=kv_dtype)
+
+    def chunked_load_run(budget):
+        rngc = np.random.RandomState(6)
+        live = [Request(rid=f"l{i}",
+                        ids=rngc.randint(0, cfg.vocab_size,
+                                         size=(cl_plen,)).astype(np.int32),
+                        gen_len=cl_gen)
+                for i in range(cl_live)]
+        long_req = Request(
+            rid="long",
+            ids=rngc.randint(0, cfg.vocab_size,
+                             size=(cl_long,)).astype(np.int32),
+            gen_len=8)
+        sched = ContinuousScheduler(eng_c, batch=cl_live + 1, chunk=2,
+                                    prefill_budget=budget)
+        for r in live:
+            sched.submit(r)
+        for _ in range(4):                 # live slots armed + decoding
+            sched.poll()
+        last = {r.rid: time.perf_counter() for r in live}
+        gaps = []
+        t_submit = time.perf_counter()
+        sched.submit(long_req)
+        ttft = None
+        while ttft is None:
+            out, done = sched.poll()
+            now = time.perf_counter()
+            for r in live:
+                if len(out.get(r.rid, ())):
+                    gaps.append(now - last[r.rid])
+                    last[r.rid] = now
+            if len(out.get("long", ())):
+                ttft = now - t_submit
+            elif "long" in done:
+                break                      # rejected — keep the gaps
+        while not sched.idle:
+            sched.poll()
+        return ttft, gaps
+
+    res = {}
+    for label, budget in (("chunked", cl_budget), ("monolithic", None)):
+        chunked_load_run(budget)           # warm the programs
+        res[label] = chunked_load_run(budget)
+    p99 = {k: float(np.percentile(v[1], 99) * 1e3) for k, v in res.items()}
+    gmax = {k: float(np.max(v[1]) * 1e3) for k, v in res.items()}
+    _emit_json({
+        "metric": "ttft_under_decode_load_ms",
+        "value": round(res["chunked"][0] * 1e3, 2),
+        "unit": "ms",
+        "monolithic_ms": round(res["monolithic"][0] * 1e3, 2),
+        "prompt_tokens": cl_long, "prefill_budget": cl_budget,
+        "live_streams": cl_live,
+        "backend": jax.default_backend(),
+    })
+    _emit_json({
+        "metric": "inter_token_p99_ms",
+        "value": round(p99["chunked"], 2),
+        "unit": "ms",
+        "monolithic_p99_ms": round(p99["monolithic"], 2),
+        "max_gap_chunked_ms": round(gmax["chunked"], 2),
+        "max_gap_monolithic_ms": round(gmax["monolithic"], 2),
+        "prompt_tokens": cl_long, "prefill_budget": cl_budget,
+        "live_streams": cl_live,
+        "backend": jax.default_backend(),
+    })
+
 
 def main():
     if os.environ.get("TDTPU_BENCH_CHILD") == "1":
@@ -442,8 +527,20 @@ def main():
         return _cpu_fallback(reason="tpu child failed or hung after a "
                                     "successful backend probe")
     if backend is None:
-        return _cpu_fallback(reason="backend init failed or hung "
-                                    "(tunnel outage)")
+        # the default-env probe failed — but that alone does not mean
+        # "tunnel outage": re-probe the pure-CPU backend so the note on
+        # the smoke row states the ACTUAL fallback reason instead of
+        # blaming an outage while the cpu backend was fine all along
+        # (the stale note BENCH_r05 carried)
+        cpu = _probe_backend(env_overrides={"JAX_PLATFORMS": "cpu",
+                                            "PALLAS_AXON_POOL_IPS": ""})
+        if cpu == "cpu":
+            return _cpu_fallback(
+                reason="tpu plugin init failed or hung (tunnel "
+                       "outage); cpu backend healthy, smoke fallback")
+        return _cpu_fallback(
+            reason="no backend initializes (default and cpu probes "
+                   "both failed)")
     return _cpu_fallback(reason=f"no tpu on this host (backend "
                                 f"{backend!r})")
 

@@ -66,11 +66,12 @@ def main():
         runs[label] = sched.run(reqs())
         dt = time.perf_counter() - t0
         st = sched.stats()
+        assert not sched.rejected, (
+            f"{label} pool unexpectedly rejected: {sched.rejected}")
         print(f"{label:>5} pool ({sched.slots.cache.num_pages} pages): "
               f"{len(prompts)} requests in {dt:.2f}s, "
               f"{st['preemptions']} preemptions, "
-              f"{st['evictions']} evictions, 0 rejections"
-              if not sched.rejected else "UNEXPECTED rejections")
+              f"{st['evictions']} evictions, 0 rejections")
         if label == "tiny":
             assert st["preemptions"] > 0, "pool was not actually tiny"
             pool = sched.slots.prefix.pool

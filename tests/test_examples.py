@@ -50,6 +50,10 @@ def test_resilient_serving_example_runs():
     _run_example("12_resilient_serving.py")
 
 
+def test_chunked_prefill_example_runs():
+    _run_example("13_chunked_prefill.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

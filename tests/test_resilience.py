@@ -424,14 +424,17 @@ def test_flaky_drafter_streams_stay_exact():
 def test_fault_injector_forces_preemption_invisibly():
     """Forced PoolExhausted on an AMPLE pool exercises the full
     preempt/requeue/resume machinery with zero real pressure — and the
-    streams must not notice."""
+    streams must not notice. Attempt 1 hits while the only resident is
+    fresh (no ELIGIBLE victim — the chunked-prefill liveness gate) so
+    the admission WAITS a poll; attempt 2 hits after that resident
+    decoded a chunk, so it is preempted."""
     cfg, model = _model()
     eng = Engine(model, max_seq=64, backend="xla")
     reqs = lambda: _mixed_requests(cfg, [(10, 10), (9, 8), (7, 9)])
     clean = ContinuousScheduler(eng, batch=2, chunk=CHUNK, paged=True,
                                 prefix_cache=True, page=PAGE)
     want = clean.run(reqs())
-    fault = FaultInjector(exhaust_admissions=(1, 3))
+    fault = FaultInjector(exhaust_admissions=(1, 2))
     sched = ContinuousScheduler(eng, batch=2, chunk=CHUNK, paged=True,
                                 prefix_cache=True, page=PAGE,
                                 fault=fault)

@@ -54,10 +54,12 @@ def _flash_decode_kernel(scale: float, rep: int, S: int, T: int,
     length) pairs (its BlockSpec walks the [X, 2] lens operand with the
     x grid axis) and each stream masks to its OWN lengths — slots of
     different sequence lengths share one kernel launch. q_len == 1 is
-    plain decode; q_len > 1 is the SPECULATIVE VERIFY window
-    (models/spec_decode.py): the stream's q_len query rows sit at
-    positions kv_len - q_len .. kv_len - 1 and row s attends causally
-    within the draft window (col <= kv_len - q_len + s). Padded rows
+    plain decode; q_len > 1 is a PREFILL-SHAPED WINDOW — the
+    speculative-verify draft (models/spec_decode.py) or a chunked-
+    prefill prompt chunk (models/scheduler.py step_mixed; both ride
+    the same mask): the stream's q_len query rows sit at positions
+    kv_len - q_len .. kv_len - 1 and row s attends causally within
+    the window (col <= kv_len - q_len + s). Padded rows
     past q_len behave like the last valid row (their outputs are
     discarded by the caller; the clamp keeps them NaN-free). Tiles past
     a stream's length are masked to a BITWISE no-op of the accumulator
@@ -218,12 +220,17 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
     own kv_lens[b] positions.
 
     q_lens: optional per-BATCH-ROW query-window lengths [B] int32
-    (requires kv_lens; the speculative-verify path,
-    models/spec_decode.py): slot b's first q_lens[b] query rows are its
-    draft window at positions kv_lens[b] - q_lens[b] .. kv_lens[b] - 1,
-    causal WITHIN the window; rows past q_lens[b] are padding whose
-    output the caller discards. Without q_lens, S must be 1 (plain
-    per-slot decode).
+    (requires kv_lens): slot b's first q_lens[b] query rows are a
+    window at positions kv_lens[b] - q_lens[b] .. kv_lens[b] - 1,
+    attending every prior position plus causally WITHIN the window —
+    the speculative-verify draft (models/spec_decode.py) AND the
+    chunked-prefill prompt chunk (models/scheduler.py step_mixed: a
+    prefill chunk is exactly this window, which is why chunked prefill
+    needed no new kernel). Rows past q_lens[b] are padding whose
+    output the caller discards; q_lens[b] == 0 marks a row making no
+    progress this launch (every column masked — its output is garbage
+    the caller drops). Without q_lens, S must be 1 (plain per-slot
+    decode).
 
     Reference: flash_decode.py:130 (split-KV GQA kernel) + :308
     (combine); here split-KV partial results live in VMEM scratch and

@@ -56,11 +56,13 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
     positions share one launch; tiles past a stream's length are a
     bitwise no-op of its accumulator (and its index map clamps to its
     own last page, so the surplus DMAs re-request the same block and
-    are elided). q_len == 1 is plain decode; q_len > 1 is the
-    speculative-verify window (models/spec_decode.py): row s of the
-    stream's q_len query rows sits at kv_len - q_len + s and attends
-    causally within the window; padded rows clamp to the last valid
-    row (outputs discarded by the caller)."""
+    are elided). q_len == 1 is plain decode; q_len > 1 is a
+    prefill-shaped window — the speculative-verify draft
+    (models/spec_decode.py) or a chunked-prefill prompt chunk
+    (models/scheduler.py step_mixed): row s of the stream's q_len
+    query rows sits at kv_len - q_len + s and attends causally within
+    the window; padded rows clamp to the last valid row (outputs
+    discarded by the caller)."""
     q_ref = refs[0]
     k_refs = refs[1:1 + W]
     v_refs = refs[1 + W:1 + 2 * W]
@@ -143,11 +145,13 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     sum(len_b) page traffic.
 
     q_lens: optional per-BATCH-ROW query-window lengths [B] int32
-    (requires kv_lens; the speculative-verify path,
-    models/spec_decode.py): slot b's first q_lens[b] of the S query
-    rows are its draft window at positions kv_lens[b] - q_lens[b] ..
-    kv_lens[b] - 1, causal within the window; padded rows are
-    discarded by the caller.
+    (requires kv_lens): slot b's first q_lens[b] of the S query rows
+    are a window at positions kv_lens[b] - q_lens[b] ..
+    kv_lens[b] - 1, attending prior positions plus causally within
+    the window — the speculative-verify draft (models/spec_decode.py)
+    and the chunked-prefill prompt chunk (models/scheduler.py
+    step_mixed) both ride this mask; padded rows (and whole q_len == 0
+    budget-starved rows) are discarded by the caller.
     """
     B, S, Hq, d = q.shape
     if q_lens is not None:

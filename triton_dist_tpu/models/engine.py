@@ -180,6 +180,36 @@ class Engine:
                     _spec_seed_fn, sampling, self._sample_params))
             self._slot_verify = jax.jit(vfn, donate_argnums=(1,))
             self._paged_slot_verify = jax.jit(pvfn, donate_argnums=(1,))
+            # chunked-prefill mixed ticks (Sarathi-Serve-style stall-free
+            # batching, models/scheduler.py step_mixed): ONE forward per
+            # tick covers live decode slots (q_len = 1, or the spec
+            # window) AND a token-budgeted chunk of every in-progress
+            # prefill (q_len = chunk) through the SAME per-slot
+            # q_lens/kv_lens masks the verify programs ride. Lazy-
+            # compiled; one executable per mixed window width.
+            samp = None if sampling == "greedy" else sampling
+            self._slot_mixed = jax.jit(
+                functools.partial(_mixed_step_fn, backend, samp,
+                                  self._sample_params, False),
+                donate_argnums=(2,))
+            self._paged_slot_mixed = jax.jit(
+                functools.partial(_mixed_step_fn, backend, samp,
+                                  self._sample_params, True),
+                donate_argnums=(2,))
+            self._slot_mixed_verify = jax.jit(
+                functools.partial(_mixed_verify_fn, backend, samp,
+                                  self._sample_params, False),
+                donate_argnums=(1,))
+            self._paged_slot_mixed_verify = jax.jit(
+                functools.partial(_mixed_verify_fn, backend, samp,
+                                  self._sample_params, True),
+                donate_argnums=(1,))
+            # chunk-0 of a chunked paged admission: table install +
+            # boundary-page copy-on-write, with the suffix forward left
+            # to the mixed-chunk ticks (_paged_admit_fn minus the
+            # prefill)
+            self._paged_install = jax.jit(_paged_install_fn,
+                                          donate_argnums=(0,))
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -335,6 +365,109 @@ class Engine:
         n_emit, t0n, pcache, pos, keys = self._paged_slot_verify(
             self.model, pcache, pos, active, tokens, q_lens, keys)
         return n_emit, t0n, pcache, pos, keys
+
+    # ------------------------------------------------------------------
+    # chunked prefill (Sarathi-Serve, 2403.02310 — PAPERS.md): the
+    # scheduler's mixed prefill+decode ticks. One forward covers every
+    # live decode slot (q_len = 1) AND up to prefill_budget tokens of
+    # in-progress prefills (q_len = chunk), riding the verify paths'
+    # per-slot q_lens/kv_lens masks: chunk rows write their KV
+    # (contiguous columns or pages) exactly like a verify window, but
+    # their "acceptance" is unconditional (they are prompt tokens) and
+    # they emit a next-token logit only when the final chunk lands —
+    # the slot then arms and joins decode (scheduler._arm_slot).
+    # ------------------------------------------------------------------
+
+    def slot_mixed_chunk(self, logits, cache, pos, active, prefilling,
+                         tokens, q_lens, *, keys=None):
+        """One MIXED prefill+decode tick over the CONTIGUOUS slot cache.
+
+        tokens [B, S] / q_lens [B]: row b of a PREFILLING slot holds
+        its next q_lens[b] prompt tokens (positions pos[b] ..
+        pos[b] + q_lens[b] - 1; q_lens[b] == 0 is a budget-starved
+        prefill that makes no progress this tick); a decode row's
+        column 0 is filled IN-PROGRAM from its own carry logits
+        (argmax, or one per-slot key split — exactly one scan step of
+        the plain chunk path) and q_lens[b] == 1. prefilling [B] bool
+        marks the chunk rows (always disjoint from `active`: a
+        prefilling slot is not armed). Returns (tok [B] — the token
+        each decode row emitted this tick, sel_logits [B, V] — the
+        logits at each row's last valid window position (a decode
+        row's next carry; a final-chunk prefill row's ARMING logits),
+        cache, pos, keys). pos advances by q_lens for prefill rows and
+        by 1 for active decode rows."""
+        if self.backend == "mega":
+            raise ValueError("backend='mega' carries no resumable slot "
+                             "state; use the per-op backends")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        prefilling = jnp.asarray(prefilling, bool)
+        if self.sampling == "greedy":
+            assert keys is None
+        return self._slot_mixed(self.model, logits, cache, pos, active,
+                                prefilling, tokens, q_lens, keys)
+
+    def paged_slot_mixed_chunk(self, logits, pcache, pos, active,
+                               prefilling, tokens, q_lens, *, keys=None):
+        """slot_mixed_chunk over the PAGED pool: identical contract,
+        chunk rows scatter their KV through the page table (padded rows
+        drop out of bounds) and attention walks the pool with per-slot
+        kv_lens AND q_lens."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        prefilling = jnp.asarray(prefilling, bool)
+        if self.sampling == "greedy":
+            assert keys is None
+        return self._paged_slot_mixed(self.model, logits, pcache, pos,
+                                      active, prefilling, tokens, q_lens,
+                                      keys)
+
+    def slot_mixed_verify_chunk(self, cache, pos, active, prefilling,
+                                tokens, q_lens, *, keys=None):
+        """Spec-mode mixed tick (CONTIGUOUS): decode rows carry their
+        draft-verify windows (seed at column 0, q_lens up to spec+1 —
+        the _slot_verify contract) while prefill rows carry prompt
+        chunks; ONE forward scores everything. The acceptance epilogue
+        runs for decode rows only; prefill rows advance by their full
+        chunk unconditionally. Returns (n_emit [B], t0_next [B],
+        sel_logits [B, V] — arming logits at each row's last valid
+        window position, cache, pos, keys)."""
+        if self.backend == "mega":
+            raise ValueError("backend='mega' carries no resumable slot "
+                             "state; use the per-op backends")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        prefilling = jnp.asarray(prefilling, bool)
+        if self.sampling == "greedy":
+            assert keys is None
+        return self._slot_mixed_verify(self.model, cache, pos, active,
+                                       prefilling, tokens, q_lens, keys)
+
+    def paged_slot_mixed_verify_chunk(self, pcache, pos, active,
+                                      prefilling, tokens, q_lens, *,
+                                      keys=None):
+        """slot_mixed_verify_chunk over the PAGED pool."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        q_lens = jnp.asarray(q_lens, jnp.int32)
+        prefilling = jnp.asarray(prefilling, bool)
+        if self.sampling == "greedy":
+            assert keys is None
+        return self._paged_slot_mixed_verify(self.model, pcache, pos,
+                                             active, prefilling, tokens,
+                                             q_lens, keys)
+
+    def install_slot_paged(self, pcache, slot: int, rows, cow_src,
+                           cow_dst, cow_rows: int):
+        """Chunk 0 of a CHUNKED paged admission: install the slot's
+        table row block and copy-on-write the partially matched
+        boundary page — the one-time half of admit_slot_paged, with the
+        suffix prefill left to the mixed-chunk ticks (which resolve
+        their KV scatter and attention through the table just
+        installed). Same rows/cow contract as admit_slot_paged."""
+        return self._paged_install(
+            pcache, jnp.asarray(rows, jnp.int32), jnp.int32(slot),
+            jnp.asarray(cow_src, jnp.int32),
+            jnp.asarray(cow_dst, jnp.int32), jnp.int32(cow_rows))
 
     # ------------------------------------------------------------------
     # paged slot path (shared-prefix serving; models/prefix_cache.py
@@ -639,6 +772,110 @@ def _sampled_paged_slot_verify_fn(backend, sampling, params, model,
         sampling, params, logits_all, tokens, q_lens, active, pos,
         pcache.capacity, keys)
     return n_emit, t0n, pcache, pos, keys
+
+
+def _mixed_forward(backend, paged, model, cache, pos, tokens, q_lens):
+    """Shared forward of the mixed-tick programs: the verify-shaped
+    per-slot-window pass (write window KV, attend kv_len prior tokens +
+    causal-within-window), returning (per-position logits [B, S, V],
+    cache, capacity)."""
+    if paged:
+        logits_all, cache = model.forward_tokens_slots_paged_verify(
+            tokens, cache, pos, q_lens, mode=backend)
+        return logits_all, cache, cache.capacity
+    logits_all, cache = model.forward_tokens_slots_verify(
+        tokens, cache, pos, q_lens, mode=backend)
+    return logits_all, cache, cache.k[0].shape[2]
+
+
+def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
+                   cache, pos, active, prefilling, tokens, q_lens, keys):
+    """Non-spec MIXED prefill+decode tick (chunked prefill,
+    models/scheduler.py step_mixed): decode rows behave as exactly one
+    step of the plain slot scan (sample from the carry logits — one key
+    split per row under the sampled modes, same chain as
+    _sampled_slot_scan_decode_fn — write KV at pos, advance 1); prefill
+    rows feed their prompt chunk through the verify-window machinery
+    (KV written at pos .. pos + q_len - 1, attention over the kv_len
+    prior tokens + causal within the window) and advance by q_len. The
+    returned sel_logits take each row's LAST valid window position:
+    a decode row's next carry, a final-chunk prefill row's arming
+    logits (non-final chunks return live-but-unused logits the
+    scheduler overwrites on the next tick). A budget-starved prefill
+    row (q_len == 0) writes nothing (its padded rows scatter out of
+    bounds) and advances 0."""
+    from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
+    B, S = tokens.shape
+    if sampling is None or max(params["temperature"], 0.0) == 0.0:
+        tok = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+    else:
+        temp = max(params["temperature"], 0.0)
+
+        def sample_one(k, logits):
+            if sampling == "top_k":
+                return sample_top_k(k, logits, k=params["k"],
+                                    temperature=temp)
+            return sample_top_p(k, logits, p=params["p"],
+                                temperature=temp)
+
+        split = jax.vmap(functools.partial(jax.random.split, num=2))
+        ks = split(keys)
+        keys, subs = ks[:, 0], ks[:, 1]
+        tok = jax.vmap(sample_one)(subs, logits0).astype(jnp.int32)
+    tok = jnp.where(active, tok, 0)
+    toks = tokens.at[:, 0].set(jnp.where(active, tok, tokens[:, 0]))
+    logits_all, cache, cap = _mixed_forward(backend, paged, model, cache,
+                                            pos, toks, q_lens)
+    sel = jnp.maximum(q_lens - 1, 0)
+    sel_logits = logits_all[jnp.arange(B), sel]            # [B, V]
+    adv = jnp.where(prefilling, q_lens, active.astype(jnp.int32))
+    pos = jnp.minimum(pos + adv, cap - 1)
+    return tok, sel_logits, cache, pos, keys
+
+
+def _mixed_verify_fn(backend, sampling, params, paged, model, cache, pos,
+                     active, prefilling, tokens, q_lens, keys):
+    """Spec-mode mixed tick: one verify-shaped forward over decode
+    draft windows AND prefill chunks; the acceptance epilogue
+    (_verify_accept) applies to decode rows only (n_emit masked by
+    `active`, which is False for prefilling slots), then prefill rows
+    advance unconditionally by their chunk length. sel_logits are the
+    per-row last-valid-position logits (the arming logits when a final
+    chunk lands)."""
+    B, S = tokens.shape
+    logits_all, cache, cap = _mixed_forward(backend, paged, model, cache,
+                                            pos, tokens, q_lens)
+    n_emit, t0n, pos, keys = _verify_accept(
+        sampling, params, logits_all, tokens, q_lens, active, pos, cap,
+        keys)
+    pos = jnp.minimum(pos + jnp.where(prefilling, q_lens, 0), cap - 1)
+    sel = jnp.maximum(q_lens - 1, 0)
+    sel_logits = logits_all[jnp.arange(B), sel]            # [B, V]
+    return n_emit, t0n, sel_logits, cache, pos, keys
+
+
+def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
+    """Table install + boundary-page copy-on-write for a CHUNKED paged
+    admission (chunk 0): exactly the pre-forward half of
+    _paged_admit_fn. The CoW must happen before ANY chunk forward reads
+    the slot's table — the boundary page's valid rows [0, cow_r) are
+    copied from the shared original into the slot's own fresh page,
+    which then receives the request's diverging writes."""
+    import dataclasses
+    page = pcache.page
+    Hkv = rows.shape[0]
+    table = jax.lax.dynamic_update_slice(pcache.table, rows,
+                                         (slot * Hkv, 0))
+    rowmask = (jnp.arange(page) < cow_r)[None, :, None]
+    pk, pv = [], []
+    for li in range(len(pcache.pages_k)):
+        k, v = pcache.pages_k[li], pcache.pages_v[li]
+        pk.append(k.at[cow_dst].set(
+            jnp.where(rowmask, k[cow_src], k[cow_dst])))
+        pv.append(v.at[cow_dst].set(
+            jnp.where(rowmask, v[cow_src], v[cow_dst])))
+    return dataclasses.replace(pcache, pages_k=tuple(pk),
+                               pages_v=tuple(pv), table=table)
 
 
 def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,

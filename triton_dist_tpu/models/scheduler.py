@@ -34,6 +34,29 @@ paged_slot_verify_chunk) scores every slot's padded window, and each
 slot emits its seed token plus the accepted prefix (1..K+1 tokens per
 forward). Greedy streams stay bitwise identical to spec=0.
 
+Chunked prefill (Sarathi-Serve, Agrawal et al. 2403.02310 — PAPERS.md):
+with `prefill_budget` set, an admission no longer runs its prompt's
+prefill as one monolithic program that stalls every live decode stream
+for its duration (the head-of-line blocking Sarathi-Serve measures as
+inter-token latency spikes). Instead the slot enters a PREFILLING
+state (host-resumable offset into the uncached prompt suffix) and the
+scheduler runs MIXED ticks: ONE forward per poll covers every live
+decode slot (q_len = 1, or its spec window) AND up to `prefill_budget`
+tokens of in-progress prefills (q_len = chunk), riding the per-slot
+`q_lens`+`kv_lens` verify masks of kernels/flash_attn.py /
+kernels/paged_kv.py. Chunk rows write their KV (contiguous columns or
+pages) but emit a next-token logit only when the FINAL chunk lands —
+the slot then arms (_arm_slot) and joins decode. The paged admission's
+prefix-cache lookup and boundary-page copy-on-write happen ONCE at
+chunk 0 (engine.install_slot_paged); the prompt is inserted into the
+radix tree only when its KV is fully computed (arming), and a
+preempted/cancelled mid-prefill slot donates exactly its VALID extent.
+Streams are bitwise identical chunked vs monolithic across
+{greedy, sampled, spec=K} x {contiguous, paged+prefix-cache}
+(tests/test_chunked_prefill.py), and the maximum prefill work a live
+stream waits on between its tokens drops from the full prompt length
+to `prefill_budget` (stats(): max_prefill_tokens_per_poll).
+
 Resilience (the degradation ladder under pressure — vLLM's
 preemption/recompute design over the Orca operational model,
 PAPERS.md):
@@ -143,6 +166,17 @@ class DecodeSlots:
         self.reqs: List[Optional[Request]] = [None] * batch
         self.admit_tick = np.zeros((batch,), np.int64)
         self._admit_seq = 0
+        # chunked prefill (step_mixed): per-slot PREFILLING state — the
+        # full prompt and the resumable offset of the next un-prefilled
+        # token. A slot with _pf_ids[b] set is occupied (rids[b] set)
+        # but NOT active: it joins decode only when its final chunk
+        # lands and _arm_slot runs. prefill_forwarded counts every
+        # prompt token actually pushed through a forward (monolithic
+        # admissions included) — the scheduler derives its per-poll
+        # stall bound from it.
+        self._pf_ids: List[Optional[np.ndarray]] = [None] * batch
+        self._pf_off = np.zeros((batch,), np.int64)
+        self.prefill_forwarded = 0
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
@@ -191,6 +225,18 @@ class DecodeSlots:
     @property
     def occupied(self) -> List[int]:
         return [b for b in range(self.batch) if self.rids[b] is not None]
+
+    @property
+    def prefill_slots(self) -> List[int]:
+        """Slots mid-chunked-prefill (occupied but not yet armed)."""
+        return [b for b in range(self.batch)
+                if self._pf_ids[b] is not None]
+
+    @property
+    def decode_slots(self) -> List[int]:
+        """Occupied slots that are ARMED (emitting tokens) — the rows
+        the per-tick emission/retirement bookkeeping covers."""
+        return [b for b in self.occupied if self._pf_ids[b] is None]
 
     def _arm_slot(self, slot: int, req: Request, row_logits, n: int
                   ) -> None:
@@ -245,7 +291,41 @@ class DecodeSlots:
                 f"exceeds slot capacity {self.capacity}")
         row, self.cache = self.engine.prefill_into_slot(
             self.cache, slot, req.ids)
+        self.prefill_forwarded += n
         self._arm_slot(slot, req, row, n)
+
+    def admit_chunked(self, slot: int, req: Request) -> None:
+        """Chunked admission (prefill_budget mode): validate and park
+        the request in a PREFILLING slot — NO forward runs here. The
+        prompt prefills chunk by chunk inside subsequent step_mixed
+        ticks (each one fused with the live decode step), and the slot
+        arms when the final chunk lands. Live slots never wait on a
+        monolithic prompt program."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        ids = np.asarray(req.ids, np.int32).reshape(-1)
+        n = len(ids)
+        if n == 0:
+            raise ValueError(f"request {req.rid!r}: empty prompt")
+        if n + req.gen_len > self.capacity:
+            raise ValueError(
+                f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
+                f"exceeds slot capacity {self.capacity}")
+        self._park_prefilling(slot, req, ids, 0)
+
+    def _park_prefilling(self, slot: int, req: Request, ids: np.ndarray,
+                         start: int) -> None:
+        """Shared tail of the chunked admissions: register the
+        PREFILLING state (pos at the first position to compute —
+        `start` is the cached-prefix length on the paged path)."""
+        self.pos = self.pos.at[slot].set(start)
+        self.active = self.active.at[slot].set(False)
+        self.remaining[slot] = 0
+        self.rids[slot] = req.rid
+        self.reqs[slot] = req
+        self._admit_seq += 1
+        self.admit_tick[slot] = self._admit_seq
+        self._pf_ids[slot] = ids
+        self._pf_off[slot] = start
 
     def emitted(self, slot: int) -> int:
         """Tokens this slot's request has streamed since its ORIGINAL
@@ -254,7 +334,30 @@ class DecodeSlots:
         victim policy, deadline messages, and preemption snapshots."""
         req = self.reqs[slot]
         base = req.resume.emitted if req.resume is not None else 0
+        if self._pf_ids[slot] is not None:
+            # still prefilling: nothing streamed since this admission
+            # (remaining is 0 until the slot arms — without this guard
+            # the formula below would claim the whole budget emitted)
+            return base
         return base + req.gen_len - int(self.remaining[slot])
+
+    def emitted_since_admit(self, slot: int) -> int:
+        """Tokens streamed since this slot's CURRENT admission (a
+        resumed request's pre-preemption span excluded — gen_len is
+        already the residual budget). The preemption LIVENESS gate:
+        only a slot whose progress is banked in its request (>= 1 token
+        folded into ids on preempt) may be displaced, otherwise
+        admissions under chunked prefill could displace each other's
+        in-progress prefills forever — prefill progress lives in
+        EVICTABLE tree pages, so a mid-prefill victim can lose
+        everything and the system livelocks (monolithic admissions
+        never exposed this: their prefill completes inside the
+        admission call, so a resident always reaches emission before
+        the next admission phase can displace it)."""
+        if self._pf_ids[slot] is not None:
+            return 0
+        req = self.reqs[slot]
+        return req.gen_len - int(self.remaining[slot])
 
     def retire(self, slot: int) -> None:
         """Free a slot: mask it out of the scan. Its cache row and
@@ -264,6 +367,8 @@ class DecodeSlots:
         self.remaining[slot] = 0
         self.rids[slot] = None
         self.reqs[slot] = None
+        self._pf_ids[slot] = None
+        self._pf_off[slot] = 0
         if self.spec:
             self._hist[slot] = []
 
@@ -290,6 +395,62 @@ class DecodeSlots:
                                           keys=self.keys)
         return np.asarray(n_emit), np.asarray(t0n)
 
+    def _draft_into(self, tokens: np.ndarray, q_lens: np.ndarray,
+                    b: int) -> None:
+        """Fill row b of a verify window: the slot's pending seed token
+        at column 0 plus up to `spec` drafter proposals (capped at
+        remaining - 1, so a slot never writes past its budget). Shared
+        by the pure-spec step and the mixed prefill+decode tick."""
+        tokens[b, 0] = self._t0[b]
+        kmax = min(self.spec, int(self.remaining[b]) - 1)
+        if kmax > 0:
+            # append the pending seed for the lookup, then undo —
+            # no per-step copy of the (growing) history list
+            h = self._hist[b]
+            h.append(int(self._t0[b]))
+            try:
+                d = [int(t) for t in
+                     self.drafter.propose(h, kmax)][:kmax]
+                if any(not 0 <= t < self._vocab for t in d):
+                    raise ValueError(f"draft token out of vocab "
+                                     f"range [0, {self._vocab})")
+            except Exception:
+                # a broken drafter degrades to plain decode for
+                # this window (the verify still emits the seed
+                # token) — it must never take down the model loop
+                self._drafter_errors += 1
+                d = []
+            finally:
+                h.pop()
+        else:
+            d = []
+        tokens[b, 1:1 + len(d)] = d
+        q_lens[b] = 1 + len(d)
+
+    def _account_spec(self, b: int, tokens, q_lens, n_emit, t0n,
+                      out: Dict[int, np.ndarray],
+                      finished: List[Tuple[int, object]]) -> None:
+        """Post-verify bookkeeping for one DECODE slot (shared by the
+        pure-spec step and the mixed tick): trim the accepted window to
+        the remaining budget, thread counters/history, stage the next
+        seed token."""
+        keep = int(min(self.remaining[b], n_emit[b]))
+        if keep:
+            kept = tokens[b, :keep].copy()
+            out[b] = kept
+            self.remaining[b] -= keep
+            self._hist[b].extend(int(t) for t in kept)
+            self._record(b, kept)
+            self._spec_slot_steps += 1
+            self._spec_emitted += keep
+            self._spec_drafted[b] += int(q_lens[b]) - 1
+            self._spec_accepted[b] += keep - 1
+            self._spec_drafted_total += int(q_lens[b]) - 1
+            self._spec_accepted_total += keep - 1
+            self._t0[b] = int(t0n[b])
+        if self.remaining[b] == 0:
+            finished.append((b, self.rids[b]))
+
     def _step_spec(self) -> Tuple[Dict[int, np.ndarray],
                                   List[Tuple[int, object]]]:
         """One speculative draft-then-verify iteration
@@ -302,53 +463,15 @@ class DecodeSlots:
         S = self.spec + 1
         tokens = np.zeros((self.batch, S), np.int32)
         q_lens = np.ones((self.batch,), np.int32)
-        for b in self.occupied:
-            tokens[b, 0] = self._t0[b]
-            kmax = min(self.spec, int(self.remaining[b]) - 1)
-            if kmax > 0:
-                # append the pending seed for the lookup, then undo —
-                # no per-step copy of the (growing) history list
-                h = self._hist[b]
-                h.append(int(self._t0[b]))
-                try:
-                    d = [int(t) for t in
-                         self.drafter.propose(h, kmax)][:kmax]
-                    if any(not 0 <= t < self._vocab for t in d):
-                        raise ValueError(f"draft token out of vocab "
-                                         f"range [0, {self._vocab})")
-                except Exception:
-                    # a broken drafter degrades to plain decode for
-                    # this window (the verify still emits the seed
-                    # token) — it must never take down the model loop
-                    self._drafter_errors += 1
-                    d = []
-                finally:
-                    h.pop()
-            else:
-                d = []
-            tokens[b, 1:1 + len(d)] = d
-            q_lens[b] = 1 + len(d)
+        for b in self.decode_slots:
+            self._draft_into(tokens, q_lens, b)
         n_emit, t0n = self._run_verify(tokens, q_lens)
         self._spec_steps += 1
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
-        for b in self.occupied:
-            keep = int(min(self.remaining[b], n_emit[b]))
-            if keep:
-                kept = tokens[b, :keep].copy()
-                out[b] = kept
-                self.remaining[b] -= keep
-                self._hist[b].extend(int(t) for t in kept)
-                self._record(b, kept)
-                self._spec_slot_steps += 1
-                self._spec_emitted += keep
-                self._spec_drafted[b] += int(q_lens[b]) - 1
-                self._spec_accepted[b] += keep - 1
-                self._spec_drafted_total += int(q_lens[b]) - 1
-                self._spec_accepted_total += keep - 1
-                self._t0[b] = int(t0n[b])
-            if self.remaining[b] == 0:
-                finished.append((b, self.rids[b]))
+        for b in self.decode_slots:
+            self._account_spec(b, tokens, q_lens, n_emit, t0n, out,
+                               finished)
         return out, finished
 
     @property
@@ -393,7 +516,7 @@ class DecodeSlots:
         toks = self._run_chunk(chunk)
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
-        for b in self.occupied:
+        for b in self.decode_slots:
             keep = int(min(self.remaining[b], chunk))
             if keep:
                 out[b] = toks[b, :keep]
@@ -401,6 +524,109 @@ class DecodeSlots:
                 self._record(b, toks[b, :keep])
             if self.remaining[b] == 0:
                 finished.append((b, self.rids[b]))
+        return out, finished
+
+    # ------------------------------------------------------------------
+    # chunked prefill: the mixed prefill+decode tick (Sarathi-Serve)
+    # ------------------------------------------------------------------
+
+    def _run_mixed(self, tokens, q_lens, pf) -> np.ndarray:
+        """Engine hook: one non-spec mixed tick (paged variant swaps in
+        paged_slot_mixed_chunk). Updates the carry logits to each row's
+        last-valid-window-position logits — a decode row's next carry,
+        a final-chunk prefill row's arming logits."""
+        toks, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.slot_mixed_chunk(
+                self.logits, self.cache, self.pos, self.active, pf,
+                tokens, q_lens, keys=self.keys)
+        return np.asarray(toks)
+
+    def _run_mixed_verify(self, tokens, q_lens, pf):
+        """Engine hook: one spec-mode mixed tick. The returned arming
+        logits replace the (spec-unused) carry so _arm_slot can read
+        them per completed prefill."""
+        n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.slot_mixed_verify_chunk(
+                self.cache, self.pos, self.active, pf, tokens, q_lens,
+                keys=self.keys)
+        return np.asarray(n_emit), np.asarray(t0n)
+
+    def _pf_record(self, slot: int, toks) -> None:
+        """Hook: paged slots extend the VALID-extent token mirror as
+        prefill chunks land (retire/preempt mid-prefill must donate
+        only tokens whose KV was actually computed)."""
+
+    def _pf_armed(self, slot: int) -> None:
+        """Hook: paged slots insert the fully-prefilled prompt into the
+        radix tree here (only now is its KV complete — inserting at
+        admission, as the monolithic path does, would poison the cache
+        with pages the chunks have not written yet)."""
+
+    def step_mixed(self, budget: int) -> Tuple[Dict[int, np.ndarray],
+                                               List[Tuple[int, object]]]:
+        """One MIXED prefill+decode tick (chunked prefill): ONE forward
+        covers every armed decode slot (q_len = 1, or its spec draft
+        window) and up to `budget` prompt tokens of in-progress
+        prefills, split FIFO by admission order (the oldest admission
+        finishes its prefill — and starts streaming — soonest). A
+        prefill whose final chunk lands this tick ARMS: its
+        last-position logits become the slot's carry and it joins
+        decode next tick, exactly as if a monolithic admission had just
+        returned. Decode slots emit one token per tick (or their
+        accepted spec window) — the most prefill work any live stream
+        ever waits on between two of its tokens is `budget` tokens.
+        Same return contract as step_chunk."""
+        S = max(int(budget), (self.spec + 1) if self.spec else 1)
+        tokens = np.zeros((self.batch, S), np.int32)
+        q_lens = np.ones((self.batch,), np.int32)
+        pf = np.zeros((self.batch,), bool)
+        decode = self.decode_slots
+        left = int(budget)
+        chunks: Dict[int, int] = {}
+        for b in sorted(self.prefill_slots,
+                        key=lambda b: self.admit_tick[b]):
+            ids = self._pf_ids[b]
+            off = int(self._pf_off[b])
+            c = min(len(ids) - off, left, S)
+            pf[b] = True
+            q_lens[b] = c          # 0 = budget-starved, no progress
+            if c:
+                tokens[b, :c] = ids[off:off + c]
+                chunks[b] = c
+            left -= c
+        out: Dict[int, np.ndarray] = {}
+        finished: List[Tuple[int, object]] = []
+        if self.spec:
+            for b in decode:
+                self._draft_into(tokens, q_lens, b)
+            n_emit, t0n = self._run_mixed_verify(tokens, q_lens, pf)
+            self._spec_steps += 1
+            for b in decode:
+                self._account_spec(b, tokens, q_lens, n_emit, t0n, out,
+                                   finished)
+        else:
+            toks = self._run_mixed(tokens, q_lens, pf)
+            for b in decode:
+                if self.remaining[b] > 0:
+                    kept = toks[b:b + 1].copy()
+                    out[b] = kept
+                    self.remaining[b] -= 1
+                    self._record(b, kept)
+                if self.remaining[b] == 0:
+                    finished.append((b, self.rids[b]))
+        # advance the prefills; arm the ones whose final chunk landed
+        for b, c in chunks.items():
+            self.prefill_forwarded += c
+            ids = self._pf_ids[b]
+            off = int(self._pf_off[b])
+            self._pf_record(b, ids[off:off + c])
+            self._pf_off[b] = off + c
+            if self._pf_off[b] == len(ids):
+                req = self.reqs[b]
+                self._pf_ids[b] = None
+                self._pf_off[b] = 0
+                self._arm_slot(b, req, self.logits[b], len(ids))
+                self._pf_armed(b)
         return out, finished
 
 
@@ -458,12 +684,12 @@ class PagedDecodeSlots(DecodeSlots):
         out.update(self.prefix.stats())
         return out
 
-    def admit(self, slot: int, req: Request) -> None:
-        """Consult the radix tree, map the cached prefix read-only,
-        allocate fresh writable pages for the rest (evicting LRU tree
-        leaves under pressure), and prefill the uncached suffix."""
-        assert self.rids[slot] is None, f"slot {slot} is occupied"
-        tokens = np.asarray(req.ids, np.int32).reshape(-1)
+    def _reserve_pages(self, req: Request, tokens: np.ndarray):
+        """Validation + prefix lookup + page reservation shared by the
+        monolithic and CHUNKED paged admissions. Returns (slot_groups,
+        m, rows, cow_src, cow_dst, r, boundary) with every ref taken
+        (release `boundary` after the device-side CoW ran); raises with
+        everything released."""
         n = len(tokens)
         if n == 0:
             # reject before touching the pool: the suffix forward needs
@@ -525,11 +751,24 @@ class PagedDecodeSlots(DecodeSlots):
         trash_vec = np.full((Hkv,), self.cache.trash, np.int32)
         cow_src = boundary if r else trash_vec
         cow_dst = fresh[0] if r else trash_vec
+        return slot_groups, m, rows, cow_src, cow_dst, r, boundary
+
+    def admit(self, slot: int, req: Request) -> None:
+        """Consult the radix tree, map the cached prefix read-only,
+        allocate fresh writable pages for the rest (evicting LRU tree
+        leaves under pressure), and prefill the uncached suffix."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        tokens = np.asarray(req.ids, np.int32).reshape(-1)
+        n = len(tokens)
+        slot_groups, m, rows, cow_src, cow_dst, r, boundary = \
+            self._reserve_pages(req, tokens)
+        pool = self.prefix.pool
         row, self.cache = self.engine.admit_slot_paged(
             self.cache, slot, tokens, rows, m, cow_src, cow_dst, r)
         if boundary is not None:
             # only the CoW copy read it; the slot maps its own copy
             pool.release(boundary)
+        self.prefill_forwarded += n - m
         self._arm_slot(slot, req, row, n)
         self._groups[slot] = slot_groups
         self._tokens[slot] = tokens.tolist()
@@ -539,6 +778,32 @@ class PagedDecodeSlots(DecodeSlots):
         # them. N clients connecting at once with one system prompt is
         # the headline case, and they must not all prefill it.
         self.prefix.insert(tokens, slot_groups[:-(-n // self.page)])
+
+    def admit_chunked(self, slot: int, req: Request) -> None:
+        """Chunked paged admission: everything that must happen ONCE —
+        prefix lookup, page reservation, table install, boundary-page
+        copy-on-write (engine.install_slot_paged) — runs at chunk 0;
+        the uncached-suffix forward is left to the step_mixed ticks,
+        which scatter their KV through the table just installed. The
+        token mirror starts at the CACHED extent (tokens[:m] — their
+        pages already hold valid KV) and grows only as chunks land, so
+        a retire/preempt/cancel mid-prefill donates exactly what was
+        computed; the prompt joins the radix tree at ARMING
+        (_pf_armed), not at admission, because until the final chunk
+        its fresh pages hold garbage."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        tokens = np.asarray(req.ids, np.int32).reshape(-1)
+        n = len(tokens)
+        slot_groups, m, rows, cow_src, cow_dst, r, boundary = \
+            self._reserve_pages(req, tokens)
+        self.cache = self.engine.install_slot_paged(
+            self.cache, slot, rows, cow_src, cow_dst, r)
+        if boundary is not None:
+            self.prefix.pool.release(boundary)
+        self._groups[slot] = slot_groups
+        self._tokens[slot] = tokens[:m].tolist()
+        self.prefix.record(n, m)
+        self._park_prefilling(slot, req, tokens, m)
 
     def preempt(self, slot: int) -> Request:
         """Evict a LIVE slot under pool pressure (vLLM-style recompute
@@ -552,9 +817,25 @@ class PagedDecodeSlots(DecodeSlots):
         cannot encode: the evolved PRNG key (sampled chains continue
         exactly) and the pending spec seed token (already determined,
         never emitted). Works for slots that were themselves resumed —
-        ids and the emitted counter just keep accumulating."""
+        ids and the emitted counter just keep accumulating.
+
+        A slot preempted MID-PREFILL (chunked admissions) re-queues its
+        ORIGINAL request unchanged — nothing was emitted, so the prompt,
+        gen_len, PRNG chain and pending seed are exactly what they were
+        at submit (a previously-resumed request keeps its snapshot).
+        The computed extent of its prefill still goes into the radix
+        tree through retire, so re-admission skips recomputing it while
+        the pages survive eviction."""
         req = self.reqs[slot]
         assert req is not None, f"slot {slot} is empty"
+        if self._pf_ids[slot] is not None:
+            rs = req.resume
+            snap = dataclasses.replace(
+                rs, preemptions=rs.preemptions + 1) if rs is not None \
+                else ResumeState(key=None, t0=None, emitted=0,
+                                 preemptions=1)
+            self.retire(slot)  # donates the valid prefill extent
+            return dataclasses.replace(req, resume=snap)
         toks = np.asarray(self._tokens[slot], np.int32)
         remaining = int(self.remaining[slot])
         rs = req.resume
@@ -599,8 +880,36 @@ class PagedDecodeSlots(DecodeSlots):
                                                 q_lens, keys=self.keys)
         return np.asarray(n_emit), np.asarray(t0n)
 
+    def _run_mixed(self, tokens, q_lens, pf) -> np.ndarray:
+        toks, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.paged_slot_mixed_chunk(
+                self.logits, self.cache, self.pos, self.active, pf,
+                tokens, q_lens, keys=self.keys)
+        return np.asarray(toks)
+
+    def _run_mixed_verify(self, tokens, q_lens, pf):
+        n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
+            self.engine.paged_slot_mixed_verify_chunk(
+                self.cache, self.pos, self.active, pf, tokens, q_lens,
+                keys=self.keys)
+        return np.asarray(n_emit), np.asarray(t0n)
+
     def _record(self, slot: int, toks) -> None:
         self._tokens[slot].extend(int(t) for t in toks)
+
+    def _pf_record(self, slot: int, toks) -> None:
+        # a landed chunk extends the VALID extent — these tokens' KV is
+        # now in the slot's pages, so retire/preempt may donate them
+        self._tokens[slot].extend(int(t) for t in toks)
+
+    def _pf_armed(self, slot: int) -> None:
+        # the prompt's KV is complete only now — insert it so the next
+        # admission can share it (the monolithic path does this at
+        # admit time, where the KV is computed in the same program)
+        n = len(self._tokens[slot])
+        self.prefix.insert(
+            np.asarray(self._tokens[slot], np.int32),
+            self._groups[slot][:-(-n // self.page)])
 
 
 class ContinuousScheduler:
@@ -615,7 +924,8 @@ class ContinuousScheduler:
                  spec: int = 0, drafter=None,
                  max_queue: Optional[int] = None,
                  watchdog_s: Optional[float] = None,
-                 preempt: bool = True, fault=None):
+                 preempt: bool = True, fault=None,
+                 prefill_budget: Optional[int] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -644,7 +954,23 @@ class ContinuousScheduler:
         (pool exhaustion then hard-rejects as before — the differential
         baseline for the bitwise preemption tests); fault is an
         optional chaos hook (runtime/chaos.py::FaultInjector) consulted
-        before every admission."""
+        before every admission.
+
+        prefill_budget: CHUNKED PREFILL (Sarathi-Serve, 2403.02310 —
+        module docstring). None (default) keeps monolithic admissions;
+        an int caps the prompt tokens prefilled per poll across all
+        in-progress admissions — while any prefill is in flight, each
+        poll runs ONE mixed forward fusing the live decode step with up
+        to that many chunk tokens, so the longest stall a live stream
+        sees between its tokens is `prefill_budget` prompt tokens
+        instead of a whole prompt. Streams are bitwise identical either
+        way; tune it to the largest chunk whose added forward latency
+        you are willing to put on every live stream's inter-token path
+        (decode is bandwidth-bound, so chunks up to a few dozen tokens
+        ride the same weight read nearly for free)."""
+        if prefill_budget is not None and prefill_budget < 1:
+            raise ValueError(f"prefill_budget must be >= 1, got "
+                             f"{prefill_budget}")
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
@@ -654,6 +980,12 @@ class ContinuousScheduler:
             self.slots = DecodeSlots(engine, batch, spec=spec,
                                      drafter=drafter)
         self.chunk = chunk
+        self.prefill_budget = prefill_budget
+        # the stall bound the chunking buys: the most prefill tokens
+        # any single poll pushed through a forward while live streams
+        # waited on it (== the longest prompt suffix under monolithic
+        # admissions; <= prefill_budget under chunked ones)
+        self.max_prefill_tokens_per_poll = 0
         self.max_queue = max_queue
         self.watchdog_s = watchdog_s
         self.preempt = preempt
@@ -745,6 +1077,11 @@ class ContinuousScheduler:
             "preemptions": self.preemptions,
             "deadline_expired": self.deadline_expired,
             "busy_rejections": self.busy_rejections,
+            "prefill_budget": self.prefill_budget,
+            "prefill_tokens_forwarded": self.slots.prefill_forwarded,
+            "max_prefill_tokens_per_poll":
+                self.max_prefill_tokens_per_poll,
+            "prefills_in_progress": len(self.slots.prefill_slots),
         })
         if self._hang is not None:
             out["hang"] = self._hang
@@ -810,25 +1147,40 @@ class ContinuousScheduler:
                                   f"exceeded after {emitted} tokens")
                 done.append(rid)
 
-    def _pick_victim(self) -> int:
+    def _eligible_victims(self) -> List[int]:
+        """Slots that may be preempted: they emitted at least one token
+        since their current admission, so displacement banks real
+        progress in the re-queued request (see
+        DecodeSlots.emitted_since_admit — the liveness gate that keeps
+        chunked-prefill admissions from thrashing each other's
+        in-progress, eviction-fragile prefills forever)."""
+        slots = self.slots
+        return [b for b in slots.occupied
+                if slots.emitted_since_admit(b) > 0]
+
+    def _pick_victim(self, candidates: List[int]) -> int:
         """Preemption victim policy: fewest generated tokens (least
         recompute thrown away — the long-running streams finish), ties
         to the most recently admitted (it displaced the least)."""
         slots = self.slots
-        return min(slots.occupied,
+        return min(candidates,
                    key=lambda b: (slots.emitted(b),
                                   -int(slots.admit_tick[b])))
 
     def _admit(self, done: List[object]) -> None:
         """Refill free slots from the waiting line. A PoolExhausted
         admission PREEMPTS a victim and retries instead of rejecting,
-        whenever a victim exists — the victim's request re-queues right
-        behind the admission that displaced it, its pages now evictable
-        through the prefix tree. Hard rejection remains only when every
-        victim is gone and the pool still cannot fit the request (it
-        alone exceeds capacity). A request preempted within THIS poll
-        that immediately fails re-admission waits for the next chunk
-        instead of thrashing the slots it just lost."""
+        whenever an ELIGIBLE victim exists — one that emitted at least
+        a token since its current admission (_eligible_victims: the
+        liveness gate; a fresh or mid-chunked-prefill resident may not
+        be displaced, the admission waits a poll instead). The victim's
+        request re-queues right behind the admission that displaced it,
+        its pages now evictable through the prefix tree. Hard rejection
+        remains only when every victim is gone and the pool still
+        cannot fit the request (it alone exceeds capacity). A request
+        preempted within THIS poll that immediately fails re-admission
+        waits for the next chunk instead of thrashing the slots it just
+        lost."""
         from triton_dist_tpu.models.prefix_cache import PoolExhausted
         preempted_now: set = set()
         while self._queue:
@@ -839,7 +1191,10 @@ class ContinuousScheduler:
             try:
                 if self.fault is not None:
                     self.fault.admission(req)
-                self.slots.admit(free[0], req)
+                if self.prefill_budget is not None:
+                    self.slots.admit_chunked(free[0], req)
+                else:
+                    self.slots.admit(free[0], req)
                 self._queue.popleft()
             except PoolExhausted as e:
                 can_preempt = (self.preempt and self.slots.occupied
@@ -851,7 +1206,16 @@ class ContinuousScheduler:
                     continue
                 if req.rid in preempted_now:
                     return
-                victim = self.slots.preempt(self._pick_victim())
+                victims = self._eligible_victims()
+                if not victims:
+                    # in-flight slots exist but none has banked
+                    # progress yet (fresh admissions / mid-chunked-
+                    # prefill): WAIT a poll instead of displacing them
+                    # — the step below advances them to eligibility (or
+                    # retirement), where preempting now could throw
+                    # away eviction-fragile prefill work forever
+                    return
+                victim = self.slots.preempt(self._pick_victim(victims))
                 self.preemptions += 1
                 preempted_now.add(victim.rid)
                 self._queue.insert(1, victim)
@@ -872,21 +1236,38 @@ class ContinuousScheduler:
         serving loop. A PREEMPTED request is in neither list: it
         silently re-queues and its rid keeps streaming on resume."""
         done: List[object] = []
+        pf_before = self.slots.prefill_forwarded
         with self._lock:
             # the queue-mutating phases run under the submit lock; the
             # decode chunk below does not (submitters may enqueue while
-            # the model steps)
+            # the model steps). NOTE: under MONOLITHIC admissions the
+            # lock also covers each admission's whole prefill forward
+            # (+ first-call compile), stalling cross-thread submit()
+            # for its duration and outside the watchdog's reach —
+            # chunked prefill (prefill_budget) removes that hold time,
+            # since admit_chunked runs no forward at all
             self._expire_deadlines(done)
             self._admit(done)
         if not self.slots.occupied:
+            self.max_prefill_tokens_per_poll = max(
+                self.max_prefill_tokens_per_poll,
+                self.slots.prefill_forwarded - pf_before)
             return {}, done
+        # a poll with prefills in flight runs ONE mixed tick fusing the
+        # decode step with budgeted prompt chunks; otherwise the plain
+        # chunk-length slot scan
+        if self.slots.prefill_slots:
+            step = lambda: self.slots.step_mixed(self.prefill_budget)
+            label = (f"scheduler mixed tick "
+                     f"(prefill_budget={self.prefill_budget})")
+        else:
+            step = lambda: self.slots.step_chunk(self.chunk)
+            label = f"scheduler chunk (chunk={self.chunk})"
         if self.watchdog_s is not None:
             from triton_dist_tpu.runtime.stress import watchdog
             try:
-                by_slot, finished = watchdog(
-                    lambda: self.slots.step_chunk(self.chunk),
-                    self.watchdog_s,
-                    label=f"scheduler chunk (chunk={self.chunk})")
+                by_slot, finished = watchdog(step, self.watchdog_s,
+                                             label=label)
             except Exception as e:
                 from triton_dist_tpu.runtime.stress import HangError
                 if isinstance(e, HangError):
@@ -896,7 +1277,10 @@ class ContinuousScheduler:
                     self._hang = str(e)
                 raise
         else:
-            by_slot, finished = self.slots.step_chunk(self.chunk)
+            by_slot, finished = step()
+        self.max_prefill_tokens_per_poll = max(
+            self.max_prefill_tokens_per_poll,
+            self.slots.prefill_forwarded - pf_before)
         rid_of = self.slots.rids
         out = {rid_of[b]: t for b, t in by_slot.items()}
         for b, rid in finished:

@@ -44,10 +44,13 @@ class FaultInjector:
     ``exhaust_admissions`` names the 0-based admission ATTEMPT indices
     (every call into the hook counts, including retries after a
     preemption) at which the hook raises PoolExhausted — the scheduler
-    then runs its real pressure path: preempt a victim and retry, or
-    hard-reject when none exists. Because the schedule is index-based,
-    the retry that follows a forced failure sees a new index and
-    proceeds, so one entry forces exactly one preemption."""
+    then runs its real pressure path: preempt an ELIGIBLE victim (one
+    that emitted since its admission — the chunked-prefill liveness
+    gate) and retry, WAIT a poll when residents exist but none is
+    eligible yet, or hard-reject when nothing is in flight at all.
+    Because the schedule is index-based, the retry that follows a
+    forced failure sees a new index and proceeds, so one entry forces
+    exactly one preemption (or one deferred poll)."""
 
     def __init__(self, *, exhaust_admissions: Iterable[int] = ()):
         self.exhaust_admissions = {int(i) for i in exhaust_admissions}

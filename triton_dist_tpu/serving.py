@@ -135,7 +135,8 @@ class TokenServer:
                  prefix_cache: bool = True, page: int = 16,
                  num_pages: Optional[int] = None, spec: int = 0,
                  drafter=None, max_queue: Optional[int] = None,
-                 watchdog_s: Optional[float] = None, fault=None):
+                 watchdog_s: Optional[float] = None, fault=None,
+                 prefill_budget: Optional[int] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -154,7 +155,16 @@ class TokenServer:
         {"busy": true, "retry_after_ms": ...}); watchdog_s deadlines
         every decode chunk (a hang ends serve_forever with a clean
         error to every client); fault is a chaos hook
-        (runtime/chaos.py::FaultInjector) for resilience tests."""
+        (runtime/chaos.py::FaultInjector) for resilience tests.
+
+        prefill_budget enables CHUNKED PREFILL (Sarathi-Serve — the
+        models/scheduler.py docstring has the design): a long prompt's
+        admission no longer stalls every live client's stream for its
+        whole prefill; at most `prefill_budget` prompt tokens ride
+        each decode step until the prompt is absorbed and its slot
+        starts streaming. Token streams are bitwise identical either
+        way — this knob trades a bounded per-step latency bump for the
+        removal of multi-hundred-ms inter-token spikes under load."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -165,7 +175,8 @@ class TokenServer:
             engine, batch=batch, chunk=chunk, paged=paged,
             prefix_cache=prefix_cache, page=page, num_pages=num_pages,
             spec=spec, drafter=drafter, max_queue=max_queue,
-            watchdog_s=watchdog_s, fault=fault)
+            watchdog_s=watchdog_s, fault=fault,
+            prefill_budget=prefill_budget)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
