@@ -447,6 +447,46 @@ def test_fault_injector_forces_preemption_invisibly():
     _assert_no_leak(sched)
 
 
+def test_chaos_host_tier_exhaustion_no_leak():
+    """Tier-1 chaos smoke for the HOST KV TIER (models/kv_tier.py): a
+    pressure-sized device pool over a host pool that is BOTH
+    chaos-refused (FaultInjector.host_demotion) and genuinely tiny, so
+    demotions, promotions, true drops from host LRU, AND fault-forced
+    drops all fire in one workload. The server-side invariants: every
+    stream bitwise equal to the tierless cache-off run, and the
+    cross-tier zero-leak invariant — device
+    ``available + outstanding == num_pages`` AND host
+    ``pages_resident == sum(entries) <= capacity`` — after the dust
+    settles."""
+    cfg, model = _model()
+    eng = Engine(model, max_seq=64, backend="xla")
+    reqs = lambda: _mixed_requests(cfg, [(20, 8), (18, 6), (21, 7),
+                                         (20, 5), (18, 6)])
+    base = ContinuousScheduler(eng, batch=2, chunk=CHUNK, paged=True,
+                               prefix_cache=False, page=PAGE)
+    want = base.run(reqs())
+    fault = FaultInjector(exhaust_host_demotions=(1, 2))
+    sched = ContinuousScheduler(
+        eng, batch=2, chunk=CHUNK, paged=True, prefix_cache=True,
+        page=PAGE, num_pages=_small_pool(cfg, 21, 8) + cfg.num_kv_heads,
+        host_pool_pages=6 * cfg.num_kv_heads, fault=fault)
+    got = sched.run(reqs())
+    st = sched.stats()
+    assert st["demotions"] > 0, st
+    assert fault.injected["host_exhausted"] >= 1
+    assert st["evictions"] > 0, st       # fault-forced true drops ran
+    for r in reqs():
+        np.testing.assert_array_equal(got[r.rid], want[r.rid],
+                                      err_msg=f"rid={r.rid}")
+    _assert_no_leak(sched)
+    hp = sched.slots.prefix.host
+    assert hp.pages_resident == sum(
+        e.n_pages for e in hp._entries.values())
+    assert hp.pages_resident <= hp.capacity
+    assert set(sched.slots.prefix.tree._host_nodes) == \
+        set(hp._entries)
+
+
 # ----------------------------------------------------------------------
 # socket-level chaos against a live TokenServer
 # ----------------------------------------------------------------------

@@ -210,6 +210,17 @@ class Engine:
             # prefill)
             self._paged_install = jax.jit(_paged_install_fn,
                                           donate_argnums=(0,))
+            # host KV tier (models/kv_tier.py + models/prefix_cache.py
+            # residency machine): ONE gather program extracts a demoted
+            # span's pages across every layer's pool (d2h at evict
+            # time), ONE scatter installs a promoted span into freshly
+            # allocated pages (h2d before the uncached-suffix prefill).
+            # Page-id lists are trash-padded to pad_to buckets so the
+            # executable count is bounded (trash reads are discarded,
+            # trash writes are the sanctioned sink).
+            self._gather_pages = jax.jit(_gather_pages_fn)
+            self._restore_pages = jax.jit(_restore_pages_fn,
+                                          donate_argnums=(0,))
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -585,6 +596,67 @@ class Engine:
                         jnp.int32)
         return self._paged_set_table(pcache, rows, jnp.int32(slot))
 
+    # ------------------------------------------------------------------
+    # host KV tier (models/kv_tier.py): demote/promote page spans
+    # between the device pools and pinned host RAM. The prefix cache's
+    # residency machine (models/prefix_cache.py) drives these through
+    # the PagedDecodeSlots callbacks.
+    # ------------------------------------------------------------------
+
+    def extract_pages_host(self, pcache, page_ids, *, pad_to: int = 8):
+        """DEMOTION d2h: gather the listed physical pages out of every
+        layer's K/V pool and return them as host arrays
+        (k, v each [L, N, page, d], pool dtype — the raw bytes, so a
+        later restore is bitwise). The id list is trash-padded to a
+        pad_to bucket (bounded executable count; the padded reads are
+        sliced off before returning). The gather is dispatched async —
+        the device_get below is the synchronization point, i.e. the
+        copy overlaps whatever was already in flight."""
+        if self.backend == "mega":
+            raise ValueError("backend='mega' has no paged pool to "
+                             "demote from; use the per-op backends")
+        import numpy as np
+        ids = np.asarray(page_ids, np.int32).reshape(-1)
+        n = len(ids)
+        P = max(-(-n // pad_to) * pad_to, pad_to)
+        padded = np.full((P,), pcache.trash, np.int32)
+        padded[:n] = ids
+        k, v = self._gather_pages(pcache, jnp.asarray(padded))
+        # one device_get over both arrays: the K and V d2h transfers
+        # overlap instead of serializing on the eviction critical path
+        k, v = jax.device_get((k, v))
+        return (np.asarray(k)[:, :n].copy(),
+                np.asarray(v)[:, :n].copy())
+
+    def restore_pages_host(self, pcache, page_ids, host_k, host_v, *,
+                           pad_to: int = 8):
+        """PROMOTION h2d: install previously extracted page contents
+        (extract_pages_host's k/v arrays) into the listed freshly
+        allocated physical pages of every layer's pool — one scatter
+        program per bucket on the donated cache, run BEFORE the
+        promoted prefix is mapped into any slot's table. Padded tail
+        ids point at the trash page (zero payload — harmless)."""
+        if self.backend == "mega":
+            raise ValueError("backend='mega' has no paged pool to "
+                             "restore into; use the per-op backends")
+        import numpy as np
+        ids = np.asarray(page_ids, np.int32).reshape(-1)
+        n = len(ids)
+        if host_k.shape[1] != n or host_v.shape[1] != n:
+            raise ValueError(
+                f"payload covers {host_k.shape[1]} pages, ids list "
+                f"{n}")
+        P = max(-(-n // pad_to) * pad_to, pad_to)
+        padded = np.full((P,), pcache.trash, np.int32)
+        padded[:n] = ids
+        L, _, page, d = host_k.shape
+        hk = np.zeros((L, P, page, d), host_k.dtype)
+        hv = np.zeros((L, P, page, d), host_v.dtype)
+        hk[:, :n] = host_k
+        hv[:, :n] = host_v
+        return self._restore_pages(pcache, jnp.asarray(padded),
+                                   jnp.asarray(hk), jnp.asarray(hv))
+
 
 def _prefill_fn(model, ids, cache, *, mode):
     return model.forward_tokens(ids, cache, mode=mode)
@@ -937,6 +1009,27 @@ def _paged_set_table_fn(pcache, rows, slot):
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
     return dataclasses.replace(pcache, table=table)
+
+
+def _gather_pages_fn(pcache, ids):
+    """Host-tier demotion gather: the listed pages of every layer's
+    pool, stacked [L, N, page, d] (one program per id-bucket shape)."""
+    k = jnp.stack([p[ids] for p in pcache.pages_k])
+    v = jnp.stack([p[ids] for p in pcache.pages_v])
+    return k, v
+
+
+def _restore_pages_fn(pcache, ids, hk, hv):
+    """Host-tier promotion scatter: write hk/hv [L, N, page, d] into
+    the listed pages of every layer's pool (donated). Padded tail ids
+    all point at the trash page — duplicate scatter targets there are
+    fine, trash content is never read."""
+    import dataclasses
+    pk = tuple(p.at[ids].set(hk[li].astype(p.dtype))
+               for li, p in enumerate(pcache.pages_k))
+    pv = tuple(p.at[ids].set(hv[li].astype(p.dtype))
+               for li, p in enumerate(pcache.pages_v))
+    return dataclasses.replace(pcache, pages_k=pk, pages_v=pv)
 
 
 def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,

@@ -34,6 +34,25 @@ that pair for the TPU serving stack:
   a node only drops the TREE's refs — pages still mapped by in-flight
   slots survive until those slots retire.
 
+- Host-RAM tier (models/kv_tier.py `HostKVPool` — the SGLang/HiCache
+  hierarchical-cache layer; the design Mooncake, arXiv:2407.00079,
+  runs in production KV-centric serving and CachedAttention,
+  arXiv:2403.19708, applies to multi-turn sessions): with
+  `host_pool_pages` set, eviction DEMOTES a span instead of dropping
+  it — the node's page content is extracted to pinned host memory
+  (one d2h gather across every layer's pool, Engine.extract_pages_
+  host) and its device refs released; the node stays in the tree with
+  a HOST residency bit (`_Node.host` = the pool handle). A later
+  lookup on a host-resident path PROMOTES before matching: fresh
+  device pages are allocated (evicting/demoting colder spans if
+  needed — the matched path is pinned) and filled by one h2d install
+  program (Engine.restore_pages_host), after which the node is an
+  ordinary DEVICE node again and the existing CoW/refcount machinery
+  applies untouched. True drop happens only from the host tier's own
+  LRU (bounded by host_pool_pages). The d2h -> h2d round trip moves
+  raw pool-dtype bytes, so warm-from-host streams are BITWISE equal
+  to HBM-hit and cold-recompute streams (tests/test_kv_tier.py).
+
 Exactness contract (tests/test_prefix_cache.py): reused prefix KV is
 bitwise the KV the donor request computed for the same (token, position)
 pairs, and the suffix forward runs the same program as a cache-off
@@ -46,11 +65,13 @@ All host-side numpy: policy changes page TABLES (data), never programs.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from triton_dist_tpu.kernels.paged_kv import PageAllocator
+from triton_dist_tpu.models.kv_tier import HostKVPool
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -145,10 +166,19 @@ class _Node:
     group per page index floor(start/page) .. ceil(end/page)-1. When
     start is mid-page the first group is a page SHARED in span with the
     parent's last group (the same physical page after a pure split, or
-    the diverging request's copy-on-write page)."""
+    the diverging request's copy-on-write page).
+
+    Residency state machine (host tier, models/kv_tier.py): `host` is
+    None for a DEVICE-resident node (groups hold device page ids) and
+    a HostKVPool handle for a HOST-resident one (groups is empty — the
+    span's bytes live in the host pool until promotion restores them
+    into fresh device pages, or the host LRU truly drops them). Host
+    nodes are opaque to insert (no descend, no split), so no DEVICE
+    descendant can ever appear below one — the invariant that makes a
+    host drop a clean subtree removal."""
 
     __slots__ = ("parent", "children", "start", "key", "groups",
-                 "last_use")
+                 "last_use", "host")
 
     def __init__(self, parent: Optional["_Node"], start: int,
                  key: np.ndarray, groups: List[np.ndarray]):
@@ -158,6 +188,7 @@ class _Node:
         self.key = key
         self.groups = groups
         self.last_use = 0
+        self.host: Optional[int] = None
 
 
 class RadixPrefixTree:
@@ -165,12 +196,34 @@ class RadixPrefixTree:
     holds one pool ref per group it references; matching never touches
     refcounts (callers retain what they map)."""
 
-    def __init__(self, pool: RefcountedPages, page: int):
+    def __init__(self, pool: RefcountedPages, page: int, *,
+                 host_pool=None, fault=None):
         self.pool = pool
         self.page = page
         self.root = _Node(None, 0, np.zeros((0,), np.int32), [])
         self._tick = 0
         self.evictions = 0
+        # host tier (models/kv_tier.py): the bounded host pool, the
+        # engine-wired copy callbacks (PrefixCache.attach_host_tier),
+        # the handle -> node map driving true drops, and the pin set
+        # protecting a promotion's matched path from the demotions its
+        # own page allocation can trigger. fault: chaos hook
+        # (runtime/chaos.py::FaultInjector.host_demotion) forcing the
+        # true-drop path without actually filling the host pool.
+        self.host_pool = host_pool
+        self.fault = fault
+        self._extract_fn = None    # groups -> payload (d2h gather)
+        self._restore_fn = None    # (payload, groups) -> None (h2d)
+        # per-promote_path restore time (alloc + h2d install only —
+        # NOT the victim demotions evict_until may run to make room),
+        # accumulated here so PrefixCache's EMA reports what the
+        # gauge's name claims
+        self.restore_ms_accum = 0.0
+        self._host_nodes: Dict[int, _Node] = {}
+        self._pinned: Dict[int, _Node] = {}
+        self.demotions = 0
+        self.promotions = 0
+        self.host_drops = 0
 
     def _touch(self, node: _Node) -> None:
         self._tick += 1
@@ -186,14 +239,19 @@ class RadixPrefixTree:
         (m, groups) with groups covering page indices
         0 .. ceil(m/page)-1. When m is mid-page the last group is only
         partially valid — the caller must copy-on-write it before the
-        slot writes anything. Touches the matched path for LRU."""
+        slot writes anything. Touches the matched path for LRU.
+
+        A HOST-resident child ends the match (its pages are not on the
+        device): callers that want host spans promoted first run
+        promote_path (PrefixCache.lookup does) — after promotion the
+        node is an ordinary device node and matches normally."""
         tokens = np.asarray(tokens, np.int32)
         node = self.root
         m = 0
         groups: List[np.ndarray] = []
         while m < len(tokens):
             child = node.children.get(int(tokens[m]))
-            if child is None:
+            if child is None or child.host is not None:
                 break
             L = _common_prefix(child.key, tokens[m:m + len(child.key)])
             if child.start % self.page:
@@ -230,6 +288,12 @@ class RadixPrefixTree:
         m = 0
         while m < len(tokens):
             child = node.children.get(int(tokens[m]))
+            if child is not None and child.host is not None:
+                # host-resident nodes are opaque to insert (splitting
+                # or descending would need pages that are not on the
+                # device): stop — caching the remainder is best-effort
+                # bookkeeping, never a correctness requirement
+                return 0
             if child is None:
                 leaf_groups = [
                     np.asarray(g, np.int32).copy()
@@ -282,38 +346,213 @@ class RadixPrefixTree:
     # ------------------------------------------------------------------
 
     def evict_until(self, pages_needed: int) -> bool:
-        """Evict least-recently-matched leaves until the allocator has
-        `pages_needed` free pages (or nothing evictable remains —
-        returns False, the admission's rejection signal). Releasing a
-        leaf's groups only drops the tree's refs; a page still mapped
-        read-only by an in-flight slot stays allocated until that slot
-        retires.
+        """Evict least-recently-matched device spans until the
+        allocator has `pages_needed` free pages (or nothing evictable
+        remains — returns False, the admission's rejection signal).
+        With a host tier attached each victim is DEMOTED (d2h snapshot
+        + device refs released, node stays in the tree host-resident)
+        and only falls back to a true drop when demotion is refused
+        (host pool too small for the span, or a chaos fault).
+        Releasing a span's groups only drops the tree's refs; a page
+        still mapped read-only by an in-flight slot stays allocated
+        until that slot retires.
 
-        One tree walk seeds a min-heap of leaves by last_use; a parent
-        joins the heap the moment its last child is evicted — O(n +
-        k log n) for k evictions instead of a full rescan per leaf."""
+        One tree walk seeds a min-heap of nodes whose SUBTREES hold no
+        other device pages (plain leaves, and parents whose children
+        were all demoted earlier) by last_use; a parent joins the heap
+        the moment its last device-holding child is demoted or dropped
+        — O(n + k log n) for k evictions instead of a full rescan.
+        Nodes pinned by an in-flight promotion are skipped."""
         import heapq
         if self.pool.available >= pages_needed:
             return True
         heap = []
+        order = []
         stack = [self.root]
         while stack:
             nd = stack.pop()
-            if nd is not self.root and not nd.children:
-                heap.append((nd.last_use, id(nd), nd))
+            order.append(nd)
             stack.extend(nd.children.values())
+        # children appear after their parent in the DFS order, so the
+        # reverse sweep sees children first: a node "blocks" its parent
+        # while its subtree still holds device pages
+        blockers: Dict[int, int] = {}
+        subtree_dev: Dict[int, bool] = {}
+        for nd in reversed(order):
+            pend = sum(1 for c in nd.children.values()
+                       if subtree_dev[id(c)])
+            blockers[id(nd)] = pend
+            subtree_dev[id(nd)] = bool(nd.groups) or pend > 0
+            if nd is not self.root and nd.groups and pend == 0:
+                heap.append((nd.last_use, id(nd), nd))
         heapq.heapify(heap)
         while self.pool.available < pages_needed and heap:
-            _, _, leaf = heapq.heappop(heap)
-            parent = leaf.parent
-            for g in leaf.groups:
-                self.pool.release(g)
-            del parent.children[int(leaf.key[0])]
-            self.evictions += 1
-            if parent is not self.root and not parent.children:
+            _, _, nd = heapq.heappop(heap)
+            if id(nd) in self._pinned:
+                continue
+            parent = nd.parent
+            if self._try_demote(nd):
+                self.demotions += 1
+            else:
+                self._drop_node(nd)
+                self.evictions += 1
+            blockers[id(parent)] -= 1
+            if parent is not self.root and parent.groups \
+                    and blockers[id(parent)] == 0:
                 heapq.heappush(heap, (parent.last_use, id(parent),
                                       parent))
         return self.pool.available >= pages_needed
+
+    def _try_demote(self, nd: _Node) -> bool:
+        """Demote one device span to the host tier: make room in the
+        host pool (true-dropping ITS least-recently-used spans — the
+        only place KV is actually forgotten), snapshot the span's pages
+        (the wired d2h gather), release the device refs, and flip the
+        node's residency bit. False = demotion unavailable (no tier,
+        span too big for the whole host pool, everything pinned, or a
+        chaos-injected host exhaustion) — the caller true-drops."""
+        hp = self.host_pool
+        if hp is None or self._extract_fn is None or not nd.groups:
+            return False
+        n_pages = sum(len(g) for g in nd.groups)
+        if n_pages > hp.capacity:
+            return False
+        if self.fault is not None and \
+                not getattr(self.fault, "host_demotion",
+                            lambda n: True)(n_pages):
+            return False
+        pinned_handles = {n.host for n in self._pinned.values()
+                          if n.host is not None}
+        while hp.room < n_pages:
+            h = hp.victim(pinned=pinned_handles)
+            if h is None:
+                return False
+            self._drop_host_subtree(self._host_nodes[h])
+        payload = self._extract_fn(nd.groups)
+        h = hp.put(payload, n_pages=n_pages, n_groups=len(nd.groups))
+        self._host_nodes[h] = nd
+        for g in nd.groups:
+            self.pool.release(g)
+        nd.groups = []
+        nd.host = h
+        return True
+
+    def _drop_node(self, nd: _Node) -> None:
+        """True-drop a device span (no tier, or demotion refused):
+        release its device refs and remove it from the tree. Any
+        children are host-resident (the eligibility sweep guarantees
+        the subtree holds no other device pages) and go with it —
+        orphaned host spans could never be matched again."""
+        for g in nd.groups:
+            self.pool.release(g)
+        nd.groups = []
+        for c in list(nd.children.values()):
+            self._drop_host_subtree(c)
+        del nd.parent.children[int(nd.key[0])]
+
+    def _drop_host_subtree(self, nd: _Node) -> None:
+        """Remove a host-resident node AND its subtree from tree and
+        host pool (descendants of a host node are host-resident by the
+        insert-opacity invariant — see _Node)."""
+        del nd.parent.children[int(nd.key[0])]
+        stack = [nd]
+        while stack:
+            x = stack.pop()
+            stack.extend(x.children.values())
+            if x.groups:         # defensive: never true by invariant
+                for g in x.groups:
+                    self.pool.release(g)
+                x.groups = []
+                self.evictions += 1
+            if x.host is not None:
+                self.host_pool.drop(x.host)
+                del self._host_nodes[x.host]
+                x.host = None
+                self.host_drops += 1
+
+    # ------------------------------------------------------------------
+    # promotion (host -> device)
+    # ------------------------------------------------------------------
+
+    def promote_path(self, tokens, cap: int) -> int:
+        """Walk the match path of `tokens` (up to `cap`) and PROMOTE
+        every host-resident node on it back to device residency, in
+        path order, so the match that follows sees ordinary device
+        nodes. The whole visited path is PINNED while promoting: the
+        page allocation a promotion needs may itself evict/demote, and
+        must not cannibalize the spans this lookup is about to map.
+        Returns the number of nodes promoted (0 = pure HBM path).
+        Stops early when a promotion fails (device pool too small even
+        after eviction) — the match then ends at that node, exactly as
+        if the span had been dropped."""
+        if self.host_pool is None or not self._host_nodes:
+            return 0           # nothing demoted: skip the extra walk
+        tokens = np.asarray(tokens, np.int32)
+        # pre-walk the WHOLE path and pin it before promoting anything:
+        # an early promotion's room-making may otherwise true-drop the
+        # deeper host spans this same lookup is about to restore
+        node, m = self.root, 0
+        path: List[_Node] = []
+        while m < cap:
+            child = node.children.get(int(tokens[m]))
+            if child is None:
+                break
+            L = _common_prefix(child.key, tokens[m:m + len(child.key)])
+            if L == 0:
+                break
+            path.append(child)
+            m += L
+            if L < len(child.key):
+                break
+            node = child
+        if not any(c.host is not None for c in path):
+            return 0
+        self._pinned = {id(c): c for c in path}
+        try:
+            promoted = 0
+            for child in path:
+                if child.host is not None:
+                    if not self._promote(child):
+                        break
+                    promoted += 1
+            return promoted
+        finally:
+            self._pinned = {}
+
+    def _promote(self, nd: _Node) -> bool:
+        """Restore one host span into fresh device pages: free-list
+        headroom (evicting/demoting unpinned spans), alloc the groups,
+        run the wired h2d install, and flip residency. The host entry
+        is popped only after the install is dispatched — a failure
+        leaves the span host-resident (and LRU-touched) for the next
+        attempt."""
+        if self._restore_fn is None:
+            return False
+        entry = self.host_pool.get(nd.host)        # touches host LRU
+        need = entry.n_groups * self.pool.n_kv_heads
+        if not self.evict_until(need):
+            return False
+        groups: List[np.ndarray] = []
+        t0 = time.perf_counter()
+        try:
+            for _ in range(entry.n_groups):
+                groups.append(self.pool.alloc_group())
+            self._restore_fn(entry.payload, groups)
+        except Exception:
+            # release-before-raise (the _reserve_pages convention):
+            # groups referenced by neither the node nor any slot would
+            # otherwise leak past every drain
+            for g in groups:
+                self.pool.release(g)
+            raise
+        self.restore_ms_accum += (time.perf_counter() - t0) * 1e3
+        self.host_pool.pop(nd.host)
+        del self._host_nodes[nd.host]
+        nd.host = None
+        nd.groups = groups
+        self.promotions += 1
+        self._touch(nd)
+        return True
 
     # introspection (tests)
 
@@ -336,24 +575,65 @@ class PrefixCache:
     comparison meaningful."""
 
     def __init__(self, num_pages: int, n_kv_heads: int, page: int, *,
-                 enabled: bool = True):
+                 enabled: bool = True, host_pool_pages: int = 0,
+                 fault=None):
+        """host_pool_pages > 0 attaches the host-RAM capacity tier
+        (models/kv_tier.py): eviction demotes spans to a host pool of
+        that many (device-page-sized) buffers instead of dropping, and
+        lookups on host-resident paths promote them back. The owner
+        must also wire the device copy callbacks (attach_host_tier) —
+        until then demotion stays disabled and eviction drops as
+        before. fault: chaos hook (runtime/chaos.py::FaultInjector)
+        whose host_demotion() can force the true-drop path."""
         self.pool = RefcountedPages(num_pages, n_kv_heads)
         self.page = page
         self.enabled = enabled
-        self.tree = RadixPrefixTree(self.pool, page)
+        self.host = HostKVPool(host_pool_pages) if host_pool_pages \
+            else None
+        self.tree = RadixPrefixTree(self.pool, page,
+                                    host_pool=self.host, fault=fault)
         self.admissions = 0
         self.hits = 0
+        self.host_hits = 0
+        self.restore_latency_ms = 0.0   # EMA over promoting lookups
         self.prompt_tokens = 0
         self.prefill_tokens_skipped = 0
         self.tokens_inserted = 0
 
+    def attach_host_tier(self, extract, restore) -> None:
+        """Wire the device-side copy callbacks into the residency
+        machine: `extract(groups) -> payload` gathers the groups'
+        pages to host memory (demotion), `restore(payload, groups)`
+        installs a payload into freshly allocated device pages
+        (promotion). PagedDecodeSlots binds these to
+        Engine.extract_pages_host / restore_pages_host over its own
+        paged cache."""
+        self.tree._extract_fn = extract
+        self.tree._restore_fn = restore
+
     def lookup(self, prompt) -> Tuple[int, List[np.ndarray]]:
         """Longest cached prefix for an admission (capped to n-1: the
         last prompt token is always recomputed so the slot has fresh
-        next-token logits)."""
+        next-token logits). With the host tier attached, host-resident
+        spans on the path are PROMOTED first (h2d install into fresh
+        pages), so the returned groups are always device pages and the
+        caller's CoW/refcount flow is tier-oblivious."""
         if not self.enabled:
             return 0, []
-        return self.tree.match(prompt, cap=max(len(prompt) - 1, 0))
+        cap = max(len(prompt) - 1, 0)
+        if self.host is not None:
+            self.tree.restore_ms_accum = 0.0
+            if self.tree.promote_path(prompt, cap):
+                self.host_hits += 1
+                # EMA over the pure restore work (alloc + h2d install)
+                # of this lookup's promotions — victim-demotion time
+                # evict_until spends making room is excluded, so the
+                # gauge reports what its name claims
+                dt = self.tree.restore_ms_accum
+                self.restore_latency_ms = dt \
+                    if self.restore_latency_ms == 0.0 \
+                    else 0.9 * self.restore_latency_ms + 0.1 * dt
+        return self.tree.match(prompt, cap=cap)
 
     def record(self, n_prompt: int, n_matched: int) -> None:
         """Count one SUCCESSFUL admission (rejected requests don't
@@ -381,7 +661,7 @@ class PrefixCache:
 
     def stats(self) -> dict:
         total = max(self.prompt_tokens, 1)
-        return {
+        out = {
             "enabled": self.enabled,
             "admissions": self.admissions,
             "hits": self.hits,
@@ -393,4 +673,18 @@ class PrefixCache:
             "pages_in_use": self.pool.pages_in_use,
             "pages_free": self.pool.available,
             "pages_outstanding": self.pool.outstanding,
+            # host tier gauges (zeros when the tier is off, via the
+            # pool's canonical key set) — the operator's live view of
+            # demote/promote behaviour
+            **HostKVPool.empty_stats(),
+            "host_hits": self.host_hits,
+            "demotions": self.tree.demotions,
+            "promotions": self.tree.promotions,
+            "host_drops": self.tree.host_drops,
+            "restore_latency_ms": round(self.restore_latency_ms, 3),
         }
+        # NB the pool defines __len__, so this must test `is not None`
+        # (an EMPTY pool is falsy)
+        if self.host is not None:
+            out.update(self.host.stats())
+        return out

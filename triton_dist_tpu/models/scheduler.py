@@ -651,7 +651,19 @@ class PagedDecodeSlots(DecodeSlots):
     def __init__(self, engine, batch: int, *, page: int = 16,
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True, margin: int = 4,
-                 spec: int = 0, drafter=None):
+                 spec: int = 0, drafter=None,
+                 host_pool_pages: int = 0, fault=None):
+        """host_pool_pages > 0 attaches the HOST-RAM KV TIER
+        (models/kv_tier.py): LRU eviction demotes unreferenced spans
+        to a host pool of that many device-page-sized buffers (d2h
+        gather at evict time) instead of dropping them, and a prefix
+        match on a host-resident path promotes the span back into
+        fresh device pages (h2d install) before the suffix prefill —
+        the effective cache grows to num_pages + host_pool_pages while
+        streams stay bitwise identical (tests/test_kv_tier.py).
+        Meaningful only with prefix_cache=True (a never-matching tree
+        never demotes). fault: chaos hook consulted on demotions
+        (runtime/chaos.py::FaultInjector.host_demotion)."""
         from triton_dist_tpu.models.prefix_cache import PrefixCache
         self.page = page
         self.margin = margin
@@ -659,7 +671,12 @@ class PagedDecodeSlots(DecodeSlots):
         super().__init__(engine, batch, spec=spec, drafter=drafter)
         Hkv = engine.model.config.num_kv_heads
         self.prefix = PrefixCache(self.cache.num_pages, Hkv, page,
-                                  enabled=prefix_cache)
+                                  enabled=prefix_cache,
+                                  host_pool_pages=host_pool_pages,
+                                  fault=fault)
+        if host_pool_pages:
+            self.prefix.attach_host_tier(self._tier_extract,
+                                         self._tier_restore)
         # both sides reserve the same trash page (pool page 0)
         assert self.prefix.pool.trash == self.cache.trash
         # per-slot host mirrors: mapped page groups (absolute page
@@ -671,6 +688,24 @@ class PagedDecodeSlots(DecodeSlots):
     def _make_cache(self):
         return self.engine.make_paged_slot_cache(
             self.batch, page=self.page, num_pages=self._num_pages)
+
+    # host KV tier copy callbacks (prefix_cache.attach_host_tier):
+    # the residency machine calls these from inside evict_until /
+    # promote_path — always on the driver thread, with self.cache the
+    # live paged pool, so the jitted gather/scatter sequence correctly
+    # with the admission/decode programs through data dependence.
+
+    def _tier_extract(self, groups):
+        """Demotion d2h: snapshot the span's pages (all layers)."""
+        ids = np.concatenate([np.asarray(g, np.int32) for g in groups])
+        k, v = self.engine.extract_pages_host(self.cache, ids)
+        return {"k": k, "v": v}
+
+    def _tier_restore(self, payload, groups) -> None:
+        """Promotion h2d: install a snapshot into fresh pages."""
+        ids = np.concatenate([np.asarray(g, np.int32) for g in groups])
+        self.cache = self.engine.restore_pages_host(
+            self.cache, ids, payload["k"], payload["v"])
 
     @property
     def capacity(self) -> int:
@@ -925,7 +960,8 @@ class ContinuousScheduler:
                  max_queue: Optional[int] = None,
                  watchdog_s: Optional[float] = None,
                  preempt: bool = True, fault=None,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 host_pool_pages: int = 0):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -967,7 +1003,16 @@ class ContinuousScheduler:
         way; tune it to the largest chunk whose added forward latency
         you are willing to put on every live stream's inter-token path
         (decode is bandwidth-bound, so chunks up to a few dozen tokens
-        ride the same weight read nearly for free)."""
+        ride the same weight read nearly for free).
+
+        host_pool_pages: HOST-RAM KV TIER (paged path only —
+        models/kv_tier.py; PagedDecodeSlots docstring has the design).
+        0 (default) keeps single-tier LRU eviction; N > 0 demotes
+        evicted spans to a host pool of N device-page-sized buffers
+        and promotes them back on a prefix hit, multiplying the
+        effective cache to num_pages + N while every stream stays
+        bitwise identical. Size it to the host RAM you can pin — tens
+        to hundreds of x the HBM pool is the regime it exists for."""
         if prefill_budget is not None and prefill_budget < 1:
             raise ValueError(f"prefill_budget must be >= 1, got "
                              f"{prefill_budget}")
@@ -975,7 +1020,8 @@ class ContinuousScheduler:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
                 prefix_cache=prefix_cache, margin=chunk,
-                spec=spec, drafter=drafter)
+                spec=spec, drafter=drafter,
+                host_pool_pages=host_pool_pages, fault=fault)
         else:
             self.slots = DecodeSlots(engine, batch, spec=spec,
                                      drafter=drafter)

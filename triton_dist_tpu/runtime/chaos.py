@@ -50,12 +50,25 @@ class FaultInjector:
     eligible yet, or hard-reject when nothing is in flight at all.
     Because the schedule is index-based, the retry that follows a
     forced failure sees a new index and proceeds, so one entry forces
-    exactly one preemption (or one deferred poll)."""
+    exactly one preemption (or one deferred poll).
 
-    def __init__(self, *, exhaust_admissions: Iterable[int] = ()):
+    ``exhaust_host_demotions`` names the 0-based DEMOTION attempt
+    indices at which the host KV tier (models/kv_tier.py) refuses to
+    take a span — forcing the TRUE-DROP path (host tier full) without
+    actually filling the host pool. The span is dropped exactly as a
+    tierless eviction would, so the cross-tier zero-leak invariant
+    (device ``available + outstanding == num_pages`` AND host
+    ``pages_resident == sum(entries)``) must survive
+    (tests/test_resilience.py, tests/test_kv_tier.py)."""
+
+    def __init__(self, *, exhaust_admissions: Iterable[int] = (),
+                 exhaust_host_demotions: Iterable[int] = ()):
         self.exhaust_admissions = {int(i) for i in exhaust_admissions}
+        self.exhaust_host_demotions = {int(i)
+                                       for i in exhaust_host_demotions}
         self.admissions_seen = 0
-        self.injected = {"pool_exhausted": 0}
+        self.host_demotions_seen = 0
+        self.injected = {"pool_exhausted": 0, "host_exhausted": 0}
 
     def admission(self, req) -> None:
         i = self.admissions_seen
@@ -66,6 +79,16 @@ class FaultInjector:
             raise PoolExhausted(
                 f"request {req.rid!r}: page pool exhausted "
                 f"(chaos injection, admission attempt {i})")
+
+    def host_demotion(self, n_pages: int) -> bool:
+        """Consulted by the radix tree before each demotion; False =
+        behave as if the host pool had no room (true drop)."""
+        i = self.host_demotions_seen
+        self.host_demotions_seen += 1
+        if i in self.exhaust_host_demotions:
+            self.injected["host_exhausted"] += 1
+            return False
+        return True
 
 
 class FlakyDrafter:

@@ -136,7 +136,8 @@ class TokenServer:
                  num_pages: Optional[int] = None, spec: int = 0,
                  drafter=None, max_queue: Optional[int] = None,
                  watchdog_s: Optional[float] = None, fault=None,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 host_pool_pages: int = 0):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -164,7 +165,16 @@ class TokenServer:
         each decode step until the prompt is absorbed and its slot
         starts streaming. Token streams are bitwise identical either
         way — this knob trades a bounded per-step latency bump for the
-        removal of multi-hundred-ms inter-token spikes under load."""
+        removal of multi-hundred-ms inter-token spikes under load.
+
+        host_pool_pages enables the HOST-RAM KV TIER on the paged path
+        (models/kv_tier.py): evicted prefix spans demote to a host
+        pool of that many device-page-sized buffers instead of being
+        dropped, and a returning tenant's prefix promotes back into
+        fresh device pages — the effective cache becomes
+        num_pages + host_pool_pages. stats() (and each done message's
+        "cache" dict) then reports host_hits / host_pages_resident /
+        demotions / promotions / restore_latency_ms live."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -176,7 +186,8 @@ class TokenServer:
             prefix_cache=prefix_cache, page=page, num_pages=num_pages,
             spec=spec, drafter=drafter, max_queue=max_queue,
             watchdog_s=watchdog_s, fault=fault,
-            prefill_budget=prefill_budget)
+            prefill_budget=prefill_budget,
+            host_pool_pages=host_pool_pages)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -395,6 +406,15 @@ class TokenServer:
                         k: st[k] for k in ("hit_rate",
                                            "prefill_tokens_skipped",
                                            "prefill_skip_frac")}
+                    if st.get("host_pool_pages"):
+                        # host-tier gauges: the operator's live view
+                        # of demote/promote behaviour per reply
+                        msg["cache"].update({
+                            k: st[k] for k in ("host_hits",
+                                               "host_pages_resident",
+                                               "demotions",
+                                               "promotions",
+                                               "restore_latency_ms")})
                 cs.fh.write(json.dumps(msg) + "\n")
                 cs.fh.flush()
         except OSError:
