@@ -261,3 +261,52 @@ def test_page_allocator_in_use_invariant():
         assert alloc.outstanding == len(held)
     alloc.free(held)
     assert alloc.available == 32 and alloc.outstanding == 0
+
+
+def test_paged_decode_int8_scales_vs_dequant_oracle():
+    """INT8 pool (kv_cache.PagedSlotCache layout): per-position scale
+    planes ride the same table indirection as the payload, and the
+    kernel's logit/P-scaling dequant must equal attending the
+    explicitly dequantized values — exactly (the dequant is linear, so
+    the only difference vs the oracle is float accumulation order)."""
+    from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
+                                               quantize_kv_int8)
+    B, Hq, Hkv, d, page, T = 2, 4, 2, 128, 16, 64
+    rng = np.random.RandomState(3)
+    maxp = T // page
+    X = B * Hkv
+    NP = 1 + X * maxp                    # page 0 = trash
+    lens = [37, 23]
+    ks = rng.randn(B, Hkv, T, d).astype(np.float32) * 0.5
+    vs = rng.randn(B, Hkv, T, d).astype(np.float32) * 0.5
+    k8, k_s = quantize_kv_int8(jnp.asarray(ks))
+    v8, v_s = quantize_kv_int8(jnp.asarray(vs))
+    # lay the quantized streams out as pages + scale planes behind a
+    # sequential table (stream x, tile t -> page 1 + x*maxp + t)
+    pk = np.zeros((NP, page, d), np.int8)
+    pv = np.zeros((NP, page, d), np.int8)
+    sk = np.zeros((NP, page), np.float32)
+    sv = np.zeros((NP, page), np.float32)
+    table = np.zeros((X, maxp), np.int32)
+    for b in range(B):
+        for h in range(Hkv):
+            x = b * Hkv + h
+            for t in range(maxp):
+                pid = 1 + x * maxp + t
+                table[x, t] = pid
+                sl = slice(t * page, (t + 1) * page)
+                pk[pid] = np.asarray(k8)[b, h, sl]
+                pv[pid] = np.asarray(v8)[b, h, sl]
+                sk[pid] = np.asarray(k_s)[b, h, sl]
+                sv[pid] = np.asarray(v_s)[b, h, sl]
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.5
+    kvl = jnp.asarray(lens, jnp.int32)
+    out = jax.jit(lambda q, l: flash_decode_paged(
+        q, jnp.asarray(pk), jnp.asarray(pv), jnp.asarray(table),
+        jnp.max(l), kv_lens=l, k_scale=jnp.asarray(sk),
+        v_scale=jnp.asarray(sv)))(q, kvl)
+    kd = dequantize_kv_int8(k8, k_s)     # [B, Hkv, T, d] f32, exact
+    vd = dequantize_kv_int8(v8, v_s)
+    ref = attention_cached_ref(q, kd, vd, kvl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)

@@ -1,0 +1,97 @@
+"""Property tests for the shared per-position int8 KV quantizer
+(kernels/quant.quantize_kv_int8 / dequantize_kv_int8) — the one
+quantizer behind BOTH int8 KV layouts (the contiguous cache's insert
+paths in layers/tp_attn.py and the paged pool's scale planes in
+models/kv_cache.PagedSlotCache), so the bitwise paged==contiguous
+contract (tests/test_overlap.py) reduces to these invariants:
+
+- error bound: |x - deq(q, s)| <= s/2 per element (round-to-nearest
+  over a symmetric scale; s = max|x|/127 per position);
+- exact scale reconstruction: re-quantizing the dequantized value
+  reproduces (q, s) EXACTLY — the max-abs element maps to ±127, so
+  s' = s bit-for-bit and q' = q (the round trip is idempotent, which
+  is what makes the host-tier d2h/h2d byte round trip sufficient for
+  bitwise restores);
+- zero rows: the 1e-8 floor keeps all-zero positions finite (scale is
+  the floor, dequant exactly zero).
+
+Parametrized over the activation dtypes the paged pool stores
+(bfloat16 compute, float32 oracle paths).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
+                                           quantize_kv_int8)
+
+DTYPES = [jnp.bfloat16, jnp.float32]
+
+
+def _cases(dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    d = 16
+    base = [
+        rng.normal(0, 1, size=(4, 7, d)),            # typical KV block
+        rng.normal(0, 1e-3, size=(3, d)),            # tiny magnitudes
+        rng.normal(0, 1e3, size=(3, d)),             # huge magnitudes
+        np.zeros((2, d)),                            # all-zero rows
+        np.concatenate([np.zeros((1, d)),
+                        rng.normal(0, 1, (1, d))]),  # mixed zero/real
+    ]
+    return [jnp.asarray(x, dtype) for x in base]
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=["bf16", "f32"])
+def test_roundtrip_error_bound(dtype):
+    for x in _cases(dtype):
+        q, s = quantize_kv_int8(x)
+        assert q.dtype == jnp.int8
+        assert s.dtype == jnp.float32
+        assert s.shape == x.shape[:-1]
+        xf = np.asarray(x, np.float32)
+        deq = np.asarray(dequantize_kv_int8(q, s))
+        err = np.abs(xf - deq)
+        # round-to-nearest over step s: half a step per element (tiny
+        # epsilon for the f32 division/multiplication rounding)
+        bound = 0.5 * np.asarray(s)[..., None] * (1 + 1e-5) + 1e-12
+        assert (err <= bound).all(), \
+            f"max err {err.max()} exceeds bound {bound.max()}"
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=["bf16", "f32"])
+def test_exact_scale_reconstruction(dtype):
+    """quantize(dequantize(q, s)) == (q, s) exactly: the max-abs
+    element of every position is ±127 * s, so the re-derived scale is
+    bit-identical and every q re-rounds to itself. This idempotence is
+    the paged pool's storage invariant — pages can be demoted/promoted
+    (raw bytes) and re-quantized windows can overlap-rewrite rows
+    without drift."""
+    for x in _cases(dtype, seed=1):
+        q, s = quantize_kv_int8(x)
+        deq = dequantize_kv_int8(q, s)          # f32
+        q2, s2 = quantize_kv_int8(deq)
+        np.testing.assert_array_equal(np.asarray(s2), np.asarray(s))
+        np.testing.assert_array_equal(np.asarray(q2), np.asarray(q))
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=["bf16", "f32"])
+def test_zero_rows_finite_and_exact(dtype):
+    x = jnp.zeros((3, 8), dtype)
+    q, s = quantize_kv_int8(x)
+    assert np.isfinite(np.asarray(s)).all()
+    assert (np.asarray(s) > 0).all()            # the 1e-8 floor
+    np.testing.assert_array_equal(np.asarray(q), 0)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_kv_int8(q, s)), 0.0)
+
+
+def test_q_range_and_max_hits_127():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.normal(0, 5, size=(9, 32)), jnp.float32)
+    q, s = quantize_kv_int8(x)
+    qn = np.asarray(q)
+    assert qn.min() >= -127 and qn.max() <= 127
+    # every position's max-abs element quantizes to exactly +/-127
+    assert (np.abs(qn).max(-1) == 127).all()

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Tier-1 gate — the EXACT command from ROADMAP.md ("Tier-1 verify"),
 # plus a --durations report so builders and reviewers see the same
-# timing picture they would use to (re)assign `slow` marks (pytest.ini).
+# timing picture they would use to (re)assign `slow` marks (pytest.ini),
+# and a DOTS_PASSED delta vs the previous run (count stored next to the
+# log) so a regression is one glance, not two terminal scrollbacks.
 # Run from the repo root: bash tools/tier1.sh
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -9,5 +11,15 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly --durations=20 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
-echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+last_file=/tmp/_t1.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "DOTS_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "DOTS_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
 exit $rc

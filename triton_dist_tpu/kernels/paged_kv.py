@@ -43,12 +43,13 @@ from triton_dist_tpu.runtime import interpret_mode
 
 
 def _paged_kernel(scale: float, rep: int, page: int, W: int,
-                  per_stream: bool, len_ref, *refs):
+                  per_stream: bool, quant: bool, len_ref, *refs):
     """Grid (X // W, max_pages); W (batch, kv-head) streams per grid
-    step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, [lens], o, m/l/acc
-    scratch). Same online softmax as _flash_decode_kernel, block = one
-    page; the W streams' pages DMA in parallel under the step and each
-    keeps its own accumulator row.
+    step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, [ks_0..ks_{W-1},
+    vs_0..vs_{W-1}], [lens], o, m/l/acc scratch). Same online softmax
+    as _flash_decode_kernel, block = one page; the W streams' pages
+    DMA in parallel under the step and each keeps its own accumulator
+    row.
 
     per_stream=True (continuous batching): a [W, 2] int32 lens block
     of (kv length, query length) pairs rides as the last input and
@@ -62,15 +63,33 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
     (models/scheduler.py step_mixed): row s of the stream's q_len
     query rows sits at kv_len - q_len + s and attends causally within
     the window; padded rows clamp to the last valid row (outputs
-    discarded by the caller)."""
+    discarded by the caller).
+
+    quant=True (int8 pool — kv_cache.PagedSlotCache scale planes):
+    each stream also carries [1, page] f32 scale blocks resolved
+    through the SAME page-table index maps as its payload. Dequant
+    mirrors the contiguous kernel (_flash_decode_kernel) exactly: K's
+    per-position scale multiplies the logits column-wise, V's folds
+    into p before the PV contraction — the int8->bf16 convert happens
+    in VMEM, so KV HBM traffic is halved. Scale rows of never-written
+    positions are finite (pool-init zeros or stale real scales, never
+    NaN), so the length mask that zeroes their p entries needs no
+    extra guard."""
     q_ref = refs[0]
     k_refs = refs[1:1 + W]
     v_refs = refs[1 + W:1 + 2 * W]
+    rest = refs[1 + 2 * W:]
+    if quant:
+        ks_refs = rest[:W]
+        vs_refs = rest[W:2 * W]
+        rest = rest[2 * W:]
+    else:
+        ks_refs = vs_refs = None
     if per_stream:
-        lens_ref, o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
+        lens_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
         lens_ref = None
-        o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * W:]
+        o_ref, m_scr, l_scr, acc_scr = rest
     t = pl.program_id(1)
     nt = pl.num_programs(1)
     rows = q_ref.shape[1]
@@ -98,10 +117,17 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
                 ql = lens_ref[j, 1]
                 mask = col <= (kvl - ql + jnp.minimum(row, ql - 1))
             q = q_ref[pl.ds(j, 1)]                       # [1, rows, d]
+            kj = k_refs[j][...]
+            if quant:
+                kj = kj.astype(q.dtype)
             s = jax.lax.dot_general(
-                q, k_refs[j][...], (((2,), (2,)), ((0,), (0,))),
+                q, kj, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32
                 ) * scale                                # [1, rows, page]
+            if quant:
+                # K's per-position scale multiplies the logits
+                # column-wise (exact: (q . k_int8) * s == q . k_deq)
+                s = s * ks_refs[j][...][:, None, :]
             m_prev = m_scr[pl.ds(j, 1)]
             m_new = jnp.maximum(
                 m_prev, jnp.max(jnp.where(mask[None], s, -1e30), -1))
@@ -109,8 +135,14 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
             p = jnp.where(mask[None], jnp.exp(s - m_new[..., None]), 0.0)
             l_scr[pl.ds(j, 1)] = (l_scr[pl.ds(j, 1)] * alpha
                                   + jnp.sum(p, -1))
+            vj = v_refs[j][...]
+            if quant:
+                # V's scale folds into p (diag(sv) V == V rows scaled);
+                # the convert to the compute dtype happens in VMEM
+                vj = vj.astype(q.dtype)
+                p = p * vs_refs[j][...][:, None, :]
             pv = jax.lax.dot_general(
-                p.astype(v_refs[j].dtype), v_refs[j][...],
+                p.astype(vj.dtype), vj,
                 (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)
             acc_scr[pl.ds(j, 1)] = (acc_scr[pl.ds(j, 1)]
@@ -126,7 +158,7 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
 
 def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
                        scale: Optional[float] = None, kv_lens=None,
-                       q_lens=None):
+                       q_lens=None, k_scale=None, v_scale=None):
     """Cached GQA decode attention through a page table.
 
     q: [B, S, Hq, d] (S == 1 unless q_lens is given); pages_k/v:
@@ -134,6 +166,15 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     of each logical tile; rows beyond ceil(kv_len/page) may hold
     anything); kv_len: traced scalar — valid positions INCLUDING the
     current query. Returns [B, S, Hq, d].
+
+    k_scale/v_scale: per-position dequant scale planes [NP, page] f32
+    for an INT8 page pool (pages_k/v int8 —
+    kv_cache.PagedSlotCache.scales_k/v): a page's scales ride behind
+    the same table indirection as its payload, and dequant folds into
+    the logits / the P matrix inside the kernel exactly as the
+    contiguous int8 path does (kernels/flash_attn.py) — halving the
+    decode step's paged-KV HBM traffic without changing a single
+    emitted token (the quantizer is shared: quantize_kv_int8).
 
     kv_lens: optional per-BATCH-ROW lengths [B] int32 (continuous
     batching: each slot is a different request at a different sequence
@@ -158,6 +199,9 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         assert kv_lens is not None, "q_lens rides on per-slot kv_lens"
     else:
         assert S == 1, "paged walk without q_lens is decode (S == 1)"
+    quant = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), \
+        "int8 pool carries BOTH scale planes"
     NP, page, _ = pages_k.shape
     X, maxp = page_table.shape
     Hkv = X // B
@@ -190,14 +234,24 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
          + ([lens_x] if per_stream else [])
          + [page_table.reshape(-1).astype(jnp.int32)]))
 
+    def page_of(j, x, t, s_ref):
+        """Physical page of stream x*W+j's logical tile t, clamped to
+        the stream's own last valid tile (shared by the payload and
+        scale index maps — a page's scales always travel with it)."""
+        own = (s_ref[2 + x * W + j] if per_stream else s_ref[0])
+        last = jnp.maximum((own + page - 1) // page - 1, 0)
+        return s_ref[2 + n_lens + (x * W + j) * maxp
+                     + jnp.minimum(t, last)]
+
     def kv_map_j(j):
         def kv_map(x, t, s_ref):
-            own = (s_ref[2 + x * W + j] if per_stream else s_ref[0])
-            last = jnp.maximum((own + page - 1) // page - 1, 0)
-            return (s_ref[2 + n_lens + (x * W + j) * maxp
-                          + jnp.minimum(t, last)],
-                    0, 0)
+            return page_of(j, x, t, s_ref), 0, 0
         return kv_map
+
+    def sc_map_j(j):
+        def sc_map(x, t, s_ref):
+            return page_of(j, x, t, s_ref), 0
+        return sc_map
 
     def q_map(x, t, s_ref):
         return (x, 0, 0)
@@ -206,14 +260,18 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
         return (x, 0)
 
     kv_specs = [pl.BlockSpec((1, page, d), kv_map_j(j)) for j in range(W)]
+    sc_specs = ([pl.BlockSpec((1, page), sc_map_j(j)) for j in range(W)]
+                if quant else [])
     in_specs = ([pl.BlockSpec((W, rows, d), q_map)] + kv_specs + kv_specs
+                + sc_specs + sc_specs
                 + ([pl.BlockSpec((W, 2), lens_map)] if per_stream else []))
     args = ([qx] + [pages_k] * W + [pages_v] * W
+            + ([k_scale] * W + [v_scale] * W if quant else [])
             + ([jnp.stack([lens_x, qlens_x], axis=1)]
                if per_stream else []))
     out = pl.pallas_call(
         functools.partial(_paged_kernel, float(scale), rep, page, W,
-                          per_stream),
+                          per_stream, quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(X // W, maxp),

@@ -47,6 +47,37 @@ def quantize_int8(w) -> QuantW:
     return QuantW(q=q, s=s)
 
 
+def quantize_kv_int8(x):
+    """Per-POSITION symmetric int8 KV quantization: reduce |x| over the
+    trailing head_dim axis, so every cached position carries its own
+    f32 scale. x: [..., d] -> (q int8 [..., d], s f32 [...]).
+
+    This is the one quantizer every int8 KV store in the repo shares —
+    the contiguous cache's insert paths (layers/tp_attn.py) and the
+    paged pool's page writes (kv_cache.PagedSlotCache scale planes) —
+    so the paged-int8 stream is bitwise identical to the contiguous
+    int8 reference by construction: the same position quantizes to the
+    same (q, s) pair no matter which layout stores it.
+
+    The 1e-8 floor keeps an all-zero position's scale finite (its
+    dequant is exactly zero either way); round-to-nearest-even is
+    jnp.round's default and both layouts inherit it."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.0
+    return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+
+def dequantize_kv_int8(q, s):
+    """Exact inverse map of quantize_kv_int8's storage: q int8 [..., d]
+    with per-position scales s [...] -> f32. The flash kernels never
+    call this (they fold s into the logits / the P matrix —
+    kernels/flash_attn.py, kernels/paged_kv.py); it is the oracle the
+    ref paths and the round-trip property test
+    (tests/test_quant_roundtrip.py) compare against."""
+    return q.astype(jnp.float32) * jnp.asarray(s,
+                                               jnp.float32)[..., None]
+
+
 def qspec(w, spec2d, sspec):
     """shard_map in_spec for a maybe-quantized weight: the spec pytree
     mirrors QuantW's structure when quantized (scale lives on the

@@ -398,11 +398,11 @@ class TP_Attn:
             if quant:
                 ks_loc, vs_loc = scales
 
-                def q8(x):   # per-(b, head, position) symmetric int8
-                    xf = x.astype(jnp.float32)
-                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
-                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
-                            s)
+                # the repo-wide per-position KV quantizer
+                # (kernels/quant.quantize_kv_int8 — shared with the
+                # int8 paged pool, so the two layouts can never drift)
+                from triton_dist_tpu.kernels.quant import \
+                    quantize_kv_int8 as q8
 
                 k8, k_s = q8(kT)
                 v8, v_s = q8(vT)
@@ -495,11 +495,11 @@ class TP_Attn:
             if quant:
                 ks_loc, vs_loc = scales
 
-                def q8(x):   # per-(b, head, position) symmetric int8
-                    xf = x.astype(jnp.float32)
-                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
-                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
-                            s)
+                # the repo-wide per-position KV quantizer
+                # (kernels/quant.quantize_kv_int8 — shared with the
+                # int8 paged pool, so the two layouts can never drift)
+                from triton_dist_tpu.kernels.quant import \
+                    quantize_kv_int8 as q8
 
                 k8, k_s = q8(kT)
                 v8, v_s = q8(vT)
@@ -595,11 +595,11 @@ class TP_Attn:
             if quant:
                 ks_loc, vs_loc = scales
 
-                def q8(x):
-                    xf = x.astype(jnp.float32)
-                    s = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.
-                    return (jnp.round(xf / s[..., None]).astype(jnp.int8),
-                            s)
+                # the repo-wide per-position KV quantizer
+                # (kernels/quant.quantize_kv_int8 — shared with the
+                # int8 paged pool, so the two layouts can never drift)
+                from triton_dist_tpu.kernels.quant import \
+                    quantize_kv_int8 as q8
 
                 k8, k_s = q8(k)
                 v8, v_s = q8(v)
@@ -675,7 +675,15 @@ class TP_Attn:
         attention walks the pool through the table (flash_decode_paged,
         or a gather + contiguous oracle under impl="ref").
 
-        kv: (pages_k, pages_v) [NP, page, d] — ONE layer's pool;
+        kv: (pages_k, pages_v) [NP, page, d] — ONE layer's pool — or
+        (pages_k, pages_v, scales_k, scales_v) for the INT8 pool
+        (kv_cache.PagedSlotCache with dtype=int8): the new row
+        quantizes per position (kernels/quant.quantize_kv_int8 — the
+        contiguous cache's exact quantizer) and its scale lands in the
+        [NP, page] scale plane at the SAME page/row the payload takes,
+        so scales follow pages through sharing, CoW, eviction and the
+        host tier for free; attention dequants in-kernel
+        (flash_decode_paged k_scale/v_scale).
         table: [B*Hkv, max_pages] int32 shared by all layers. The pool
         is REPLICATED and this attend runs at the global level (GSPMD
         partitions it; a head-sharded pool with per-rank allocators is
@@ -685,10 +693,17 @@ class TP_Attn:
         """
         from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
         from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+        from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
+                                                   quantize_kv_int8)
         hd = self.head_dim
         Hkv = self.n_kv_heads
         scale = hd ** -0.5
-        ck, cv = kv
+        quant = len(kv) == 4
+        if quant:
+            ck, cv, sk, sv = kv
+        else:
+            ck, cv = kv
+            sk = sv = None
         page = ck.shape[1]
         B = qkv.shape[0]
         q, k, v = self._split_qkv_global(qkv)        # [B, 1, H, d]
@@ -703,20 +718,35 @@ class TP_Attn:
         pos_x = jnp.repeat(pos, Hkv)                     # [X]
         pidx = table[jnp.arange(X), pos_x // page]
         r = pos_x % page
-        ck = ck.at[pidx, r].set(k.reshape(X, hd).astype(ck.dtype))
-        cv = cv.at[pidx, r].set(v.reshape(X, hd).astype(cv.dtype))
+        if quant:
+            k8, k_s = quantize_kv_int8(k.reshape(X, hd))
+            v8, v_s = quantize_kv_int8(v.reshape(X, hd))
+            ck = ck.at[pidx, r].set(k8)
+            cv = cv.at[pidx, r].set(v8)
+            sk = sk.at[pidx, r].set(k_s)
+            sv = sv.at[pidx, r].set(v_s)
+        else:
+            ck = ck.at[pidx, r].set(k.reshape(X, hd).astype(ck.dtype))
+            cv = cv.at[pidx, r].set(v.reshape(X, hd).astype(cv.dtype))
         lens = pos + 1
+        qd = jnp.bfloat16 if quant else ck.dtype
         if impl == "flash":
-            o = flash_decode_paged(q.astype(ck.dtype), ck, cv, table,
+            o = flash_decode_paged(q.astype(qd), ck, cv, table,
                                    jnp.max(lens), scale=scale,
-                                   kv_lens=lens)
+                                   kv_lens=lens, k_scale=sk, v_scale=sv)
         else:
             T = table.shape[1] * page
-            kfull = ck[table].reshape(B, Hkv, T, hd)
-            vfull = cv[table].reshape(B, Hkv, T, hd)
-            o = attention_cached_ref(q.astype(ck.dtype), kfull, vfull,
-                                     lens, scale=scale)
-        return o.reshape(B, self.n_heads * hd), (ck, cv)
+            kd = dequantize_kv_int8(ck, sk) if quant else ck
+            vd = dequantize_kv_int8(cv, sv) if quant else cv
+            kfull = kd[table].reshape(B, Hkv, T, hd)
+            vfull = vd[table].reshape(B, Hkv, T, hd)
+            o = attention_cached_ref(q.astype(jnp.float32) if quant
+                                     else q.astype(ck.dtype),
+                                     kfull, vfull, lens, scale=scale)
+        o = o.reshape(B, self.n_heads * hd)
+        if quant:
+            return o.astype(qkv.dtype), (ck, cv, sk, sv)
+        return o, (ck, cv)
 
     def _attend_paged_slots_verify(self, qkv, cos, sin, batch: int, kv,
                                    table, pos, q_lens,
@@ -727,13 +757,24 @@ class TP_Attn:
         pos[b] .. pos[b] + q_lens[b] - 1; padded rows scatter to an
         out-of-bounds page id and are dropped, so they can never touch
         a live or cached page. Attention walks the pool through the
-        table with per-slot kv_lens AND q_lens (flash_decode_paged)."""
+        table with per-slot kv_lens AND q_lens (flash_decode_paged).
+        An INT8 pool (kv = 4-tuple with scale planes) quantizes the
+        window per position and scatters the scales to the same
+        (page, row) destinations — OOB-dropped alongside the payload —
+        exactly like _attend_paged_slots."""
         from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
         from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+        from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
+                                                   quantize_kv_int8)
         hd = self.head_dim
         Hkv = self.n_kv_heads
         scale = hd ** -0.5
-        ck, cv = kv
+        quant = len(kv) == 4
+        if quant:
+            ck, cv, sk, sv = kv
+        else:
+            ck, cv = kv
+            sk = sv = None
         NP, page, _ = ck.shape
         B = batch
         S = qkv.shape[0] // B
@@ -756,20 +797,37 @@ class TP_Attn:
         # invalid rows scatter to page NP (out of bounds -> dropped)
         dest = jnp.where(valid[:, :, None], pidx, NP)          # [B, S, Hkv]
         r = (p % page)[:, :, None]
-        ck = ck.at[dest, r].set(k.astype(ck.dtype))
-        cv = cv.at[dest, r].set(v.astype(cv.dtype))
+        if quant:
+            k8, k_s = quantize_kv_int8(k)          # [B, S, Hkv, d] / [..]
+            v8, v_s = quantize_kv_int8(v)
+            ck = ck.at[dest, r].set(k8)
+            cv = cv.at[dest, r].set(v8)
+            sk = sk.at[dest, r].set(k_s)
+            sv = sv.at[dest, r].set(v_s)
+        else:
+            ck = ck.at[dest, r].set(k.astype(ck.dtype))
+            cv = cv.at[dest, r].set(v.astype(cv.dtype))
         lens = pos + q_lens
+        qd = jnp.bfloat16 if quant else ck.dtype
         if impl == "flash":
-            o = flash_decode_paged(q.astype(ck.dtype), ck, cv, table,
+            o = flash_decode_paged(q.astype(qd), ck, cv, table,
                                    jnp.max(lens), scale=scale,
-                                   kv_lens=lens, q_lens=q_lens)
+                                   kv_lens=lens, q_lens=q_lens,
+                                   k_scale=sk, v_scale=sv)
         else:
             T = maxp * page
-            kfull = ck[table].reshape(B, Hkv, T, hd)
-            vfull = cv[table].reshape(B, Hkv, T, hd)
-            o = attention_cached_ref(q.astype(ck.dtype), kfull, vfull,
-                                     lens, scale=scale, q_lens=q_lens)
-        return o.reshape(B * S, self.n_heads * hd), (ck, cv)
+            kd = dequantize_kv_int8(ck, sk) if quant else ck
+            vd = dequantize_kv_int8(cv, sv) if quant else cv
+            kfull = kd[table].reshape(B, Hkv, T, hd)
+            vfull = vd[table].reshape(B, Hkv, T, hd)
+            o = attention_cached_ref(q.astype(jnp.float32) if quant
+                                     else q.astype(ck.dtype),
+                                     kfull, vfull, lens, scale=scale,
+                                     q_lens=q_lens)
+        o = o.reshape(B * S, self.n_heads * hd)
+        if quant:
+            return o.astype(qkv.dtype), (ck, cv, sk, sv)
+        return o, (ck, cv)
 
     def fwd_cached_slots_paged_verify(self, x, cos, sin, batch: int, kv,
                                       table, pos, q_lens,

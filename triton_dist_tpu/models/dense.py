@@ -282,10 +282,10 @@ class DenseLLM:
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         for li, layer in enumerate(self.layers):
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, (ck, cv) = layer.attn.fwd_cached_slots_paged_verify(
+            a, kv = layer.attn.fwd_cached_slots_paged_verify(
                 h, self.cos, self.sin, B, pcache.layer(li),
                 pcache.table, pos, q_lens, mode)
-            pcache = pcache.set_layer(li, ck, cv)
+            pcache = pcache.set_layer(li, *kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
             x = x + layer.mlp(h, mlp_mode)
@@ -312,10 +312,10 @@ class DenseLLM:
         x = self.embed[ids].reshape(B, self.config.hidden_size)
         for li, layer in enumerate(self.layers):
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, (ck, cv) = layer.attn.fwd_cached_slots_paged(
+            a, kv = layer.attn.fwd_cached_slots_paged(
                 h, self.cos, self.sin, B, pcache.layer(li),
                 pcache.table, pos, mode)
-            pcache = pcache.set_layer(li, ck, cv)
+            pcache = pcache.set_layer(li, *kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
             x = x + layer.mlp(h, mlp_mode)

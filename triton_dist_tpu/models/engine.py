@@ -304,7 +304,16 @@ class Engine:
         ONE XLA program per chunk length; admission/retirement happen
         between chunks on the host. keys: per-slot PRNG keys [B]
         (typed key array) for the sampled modes; None under greedy.
-        Returns (toks [B, chunk], logits, cache, pos, keys)."""
+        Returns (toks [B, chunk], logits, cache, pos, keys).
+
+        Dispatch contract (the overlap scheduler rides this): the call
+        returns device FUTURES — the donated carry (logits/cache/pos/
+        keys) can be fed straight into the next chunk's dispatch with
+        NO host round-trip, and only reading `toks` blocks. The same
+        holds for every slot program below (verify, mixed, paged):
+        scheduler.DecodeSlots defers that read to one coalesced
+        device_get per poll (_fetch), and overlap=True moves it past
+        the next dispatch."""
         if self.backend == "mega":
             raise ValueError("backend='mega' carries no resumable "
                              "slot state; use the per-op backends")
@@ -501,17 +510,17 @@ class Engine:
         page table (kv_cache.PagedSlotCache). num_pages defaults to the
         no-sharing worst case (every slot full) + the reserved trash
         page; pass fewer to let prefix sharing carry the load (and the
-        LRU evictor handle the pressure)."""
+        LRU evictor handle the pressure).
+
+        kv_dtype=int8 engines get the INT8 POOL (per-position scale
+        planes riding the page payload — kv_cache.PagedSlotCache):
+        half the decode KV read, double the resident pages, streams
+        bitwise equal to the contiguous int8 cache."""
         from triton_dist_tpu.models.kv_cache import PagedSlotCache
         if self.backend == "mega":
             raise ValueError("backend='mega' has no resumable slot "
                              "state; paged serving uses the per-op "
                              "backends")
-        if self.kv_dtype is not None and \
-                jnp.dtype(self.kv_dtype) == jnp.int8:
-            raise ValueError(
-                "paged slot serving stores the raw-dtype pool; paging "
-                "the int8 cache's per-position scales is an open item")
         if not hasattr(self.model, "forward_tokens_slots_paged"):
             raise ValueError(
                 f"{type(self.model).__name__} has no paged slot decode "
@@ -607,11 +616,13 @@ class Engine:
         """DEMOTION d2h: gather the listed physical pages out of every
         layer's K/V pool and return them as host arrays
         (k, v each [L, N, page, d], pool dtype — the raw bytes, so a
-        later restore is bitwise). The id list is trash-padded to a
-        pad_to bucket (bounded executable count; the padded reads are
-        sliced off before returning). The gather is dispatched async —
-        the device_get below is the synchronization point, i.e. the
-        copy overlaps whatever was already in flight."""
+        later restore is bitwise; an int8 pool appends its scale
+        planes (k, v, ks, vs) so the scales ride the same transfer).
+        The id list is trash-padded to a pad_to bucket (bounded
+        executable count; the padded reads are sliced off before
+        returning). The gather is dispatched async — the device_get
+        below is the synchronization point, i.e. the copy overlaps
+        whatever was already in flight."""
         if self.backend == "mega":
             raise ValueError("backend='mega' has no paged pool to "
                              "demote from; use the per-op backends")
@@ -621,21 +632,23 @@ class Engine:
         P = max(-(-n // pad_to) * pad_to, pad_to)
         padded = np.full((P,), pcache.trash, np.int32)
         padded[:n] = ids
-        k, v = self._gather_pages(pcache, jnp.asarray(padded))
-        # one device_get over both arrays: the K and V d2h transfers
-        # overlap instead of serializing on the eviction critical path
-        k, v = jax.device_get((k, v))
-        return (np.asarray(k)[:, :n].copy(),
-                np.asarray(v)[:, :n].copy())
+        out = self._gather_pages(pcache, jnp.asarray(padded))
+        # one device_get over every array: the K/V (and scale) d2h
+        # transfers overlap instead of serializing on the eviction
+        # critical path
+        out = jax.device_get(out)
+        return tuple(np.asarray(a)[:, :n].copy() for a in out)
 
-    def restore_pages_host(self, pcache, page_ids, host_k, host_v, *,
+    def restore_pages_host(self, pcache, page_ids, host_k, host_v,
+                           host_ks=None, host_vs=None, *,
                            pad_to: int = 8):
         """PROMOTION h2d: install previously extracted page contents
-        (extract_pages_host's k/v arrays) into the listed freshly
-        allocated physical pages of every layer's pool — one scatter
-        program per bucket on the donated cache, run BEFORE the
-        promoted prefix is mapped into any slot's table. Padded tail
-        ids point at the trash page (zero payload — harmless)."""
+        (extract_pages_host's k/v arrays — plus its ks/vs scale planes
+        for an int8 pool) into the listed freshly allocated physical
+        pages of every layer's pool — one scatter program per bucket
+        on the donated cache, run BEFORE the promoted prefix is mapped
+        into any slot's table. Padded tail ids point at the trash page
+        (zero payload — harmless)."""
         if self.backend == "mega":
             raise ValueError("backend='mega' has no paged pool to "
                              "restore into; use the per-op backends")
@@ -646,6 +659,10 @@ class Engine:
             raise ValueError(
                 f"payload covers {host_k.shape[1]} pages, ids list "
                 f"{n}")
+        if bool(pcache.scales_k) != (host_ks is not None):
+            raise ValueError(
+                "int8 pools restore payloads WITH scale planes; bf16 "
+                "pools without — the payload does not match this pool")
         P = max(-(-n // pad_to) * pad_to, pad_to)
         padded = np.full((P,), pcache.trash, np.int32)
         padded[:n] = ids
@@ -654,8 +671,16 @@ class Engine:
         hv = np.zeros((L, P, page, d), host_v.dtype)
         hk[:, :n] = host_k
         hv[:, :n] = host_v
+        hsk = hsv = None
+        if host_ks is not None:
+            hsk = np.zeros((L, P, page), host_ks.dtype)
+            hsv = np.zeros((L, P, page), host_vs.dtype)
+            hsk[:, :n] = host_ks
+            hsv[:, :n] = host_vs
+            hsk, hsv = jnp.asarray(hsk), jnp.asarray(hsv)
         return self._restore_pages(pcache, jnp.asarray(padded),
-                                   jnp.asarray(hk), jnp.asarray(hv))
+                                   jnp.asarray(hk), jnp.asarray(hv),
+                                   hsk, hsv)
 
 
 def _prefill_fn(model, ids, cache, *, mode):
@@ -932,22 +957,31 @@ def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
     _paged_admit_fn. The CoW must happen before ANY chunk forward reads
     the slot's table — the boundary page's valid rows [0, cow_r) are
     copied from the shared original into the slot's own fresh page,
-    which then receives the request's diverging writes."""
+    which then receives the request's diverging writes. An int8 pool
+    copies the boundary page's scale rows alongside."""
     import dataclasses
     page = pcache.page
     Hkv = rows.shape[0]
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
     rowmask = (jnp.arange(page) < cow_r)[None, :, None]
-    pk, pv = [], []
+    rowmask2 = rowmask[..., 0]
+    pk, pv, psk, psv = [], [], [], []
     for li in range(len(pcache.pages_k)):
         k, v = pcache.pages_k[li], pcache.pages_v[li]
         pk.append(k.at[cow_dst].set(
             jnp.where(rowmask, k[cow_src], k[cow_dst])))
         pv.append(v.at[cow_dst].set(
             jnp.where(rowmask, v[cow_src], v[cow_dst])))
+        if pcache.scales_k:
+            s_k, s_v = pcache.scales_k[li], pcache.scales_v[li]
+            psk.append(s_k.at[cow_dst].set(
+                jnp.where(rowmask2, s_k[cow_src], s_k[cow_dst])))
+            psv.append(s_v.at[cow_dst].set(
+                jnp.where(rowmask2, s_v[cow_src], s_v[cow_dst])))
     return dataclasses.replace(pcache, pages_k=tuple(pk),
-                               pages_v=tuple(pv), table=table)
+                               pages_v=tuple(pv), scales_k=tuple(psk),
+                               scales_v=tuple(psv), table=table)
 
 
 def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
@@ -958,15 +992,26 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
     run the suffix forward from offset m (the prefill-from-offset —
     positions [m, n) only), and scatter the computed suffix KV back
     into the slot's writable pages (pad-bucket tail rows are redirected
-    to the trash page)."""
+    to the trash page).
+
+    INT8 pool: the scale planes ride every hop — boundary-page CoW
+    copies the scale rows with the payload rows, the gather fills the
+    int8 scratch's ks/vs (so the suffix forward attends the prefix
+    through the contiguous int8 dequant path), and the suffix scatter
+    writes the scales the forward's quantizer produced back beside the
+    payload. The scratch is an int8 KVCache whenever the pool is (both
+    derive from engine.kv_dtype), so the two branches can never be
+    mismatched."""
     import dataclasses
     page = pcache.page
     Hkv, maxp = rows.shape
     T_pool = maxp * page
     d = pcache.pages_k[0].shape[2]
+    quant = bool(pcache.scales_k)
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
     rowmask = (jnp.arange(page) < cow_r)[None, :, None]
+    rowmask2 = rowmask[..., 0]                       # [1, page] (scales)
     S_pad = ids.shape[1]
     p = m + jnp.arange(S_pad)
     valid = p < n
@@ -974,7 +1019,9 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
     ri = p % page
     dest = jnp.where(valid[None], rows[:, pi], pcache.trash)  # [Hkv, S_pad]
     pk, pv = list(pcache.pages_k), list(pcache.pages_v)
+    psk, psv = list(pcache.scales_k), list(pcache.scales_v)
     sk, sv = list(scratch.k), list(scratch.v)
+    ssk, ssv = list(scratch.ks), list(scratch.vs)
     for li in range(len(pk)):
         pk[li] = pk[li].at[cow_dst].set(
             jnp.where(rowmask, pk[li][cow_src], pk[li][cow_dst]))
@@ -986,11 +1033,23 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
             sk[li], kf.astype(sk[li].dtype), (0, 0, 0, 0))
         sv[li] = jax.lax.dynamic_update_slice(
             sv[li], vf.astype(sv[li].dtype), (0, 0, 0, 0))
+        if quant:
+            psk[li] = psk[li].at[cow_dst].set(
+                jnp.where(rowmask2, psk[li][cow_src], psk[li][cow_dst]))
+            psv[li] = psv[li].at[cow_dst].set(
+                jnp.where(rowmask2, psv[li][cow_src], psv[li][cow_dst]))
+            ksf = psk[li][rows].reshape(1, Hkv, T_pool)
+            vsf = psv[li][rows].reshape(1, Hkv, T_pool)
+            ssk[li] = jax.lax.dynamic_update_slice(ssk[li], ksf,
+                                                   (0, 0, 0))
+            ssv[li] = jax.lax.dynamic_update_slice(ssv[li], vsf,
+                                                   (0, 0, 0))
     scratch = dataclasses.replace(scratch, k=tuple(sk), v=tuple(sv),
+                                  ks=tuple(ssk), vs=tuple(ssv),
                                   offset=m)
     logits, scratch = model.forward_tokens(ids, scratch, mode=mode,
                                            last_pos=(n - 1) - m)
-    pk2, pv2 = [], []
+    pk2, pv2, psk2, psv2 = [], [], [], []
     for li in range(len(pk)):
         ks = jax.lax.dynamic_slice(scratch.k[li], (0, 0, m, 0),
                                    (1, Hkv, S_pad, d))[0]
@@ -998,8 +1057,17 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
                                    (1, Hkv, S_pad, d))[0]
         pk2.append(pk[li].at[dest, ri[None]].set(ks.astype(pk[li].dtype)))
         pv2.append(pv[li].at[dest, ri[None]].set(vs.astype(pv[li].dtype)))
+        if quant:
+            kss = jax.lax.dynamic_slice(scratch.ks[li], (0, 0, m),
+                                        (1, Hkv, S_pad))[0]
+            vss = jax.lax.dynamic_slice(scratch.vs[li], (0, 0, m),
+                                        (1, Hkv, S_pad))[0]
+            psk2.append(psk[li].at[dest, ri[None]].set(kss))
+            psv2.append(psv[li].at[dest, ri[None]].set(vss))
     pcache = dataclasses.replace(pcache, pages_k=tuple(pk2),
-                                 pages_v=tuple(pv2), table=table)
+                                 pages_v=tuple(pv2),
+                                 scales_k=tuple(psk2),
+                                 scales_v=tuple(psv2), table=table)
     return logits, scratch, pcache
 
 
@@ -1013,23 +1081,37 @@ def _paged_set_table_fn(pcache, rows, slot):
 
 def _gather_pages_fn(pcache, ids):
     """Host-tier demotion gather: the listed pages of every layer's
-    pool, stacked [L, N, page, d] (one program per id-bucket shape)."""
+    pool, stacked [L, N, page, d] (one program per id-bucket shape).
+    An int8 pool also gathers the scale planes [L, N, page] — a
+    demoted page's scales are part of its bytes."""
     k = jnp.stack([p[ids] for p in pcache.pages_k])
     v = jnp.stack([p[ids] for p in pcache.pages_v])
+    if pcache.scales_k:
+        sk = jnp.stack([s[ids] for s in pcache.scales_k])
+        sv = jnp.stack([s[ids] for s in pcache.scales_v])
+        return k, v, sk, sv
     return k, v
 
 
-def _restore_pages_fn(pcache, ids, hk, hv):
+def _restore_pages_fn(pcache, ids, hk, hv, hsk=None, hsv=None):
     """Host-tier promotion scatter: write hk/hv [L, N, page, d] into
     the listed pages of every layer's pool (donated). Padded tail ids
     all point at the trash page — duplicate scatter targets there are
-    fine, trash content is never read."""
+    fine, trash content is never read. Int8 pools restore the scale
+    planes from hsk/hsv [L, N, page] in the same program."""
     import dataclasses
     pk = tuple(p.at[ids].set(hk[li].astype(p.dtype))
                for li, p in enumerate(pcache.pages_k))
     pv = tuple(p.at[ids].set(hv[li].astype(p.dtype))
                for li, p in enumerate(pcache.pages_v))
-    return dataclasses.replace(pcache, pages_k=pk, pages_v=pv)
+    out = dataclasses.replace(pcache, pages_k=pk, pages_v=pv)
+    if pcache.scales_k:
+        psk = tuple(s.at[ids].set(hsk[li])
+                    for li, s in enumerate(pcache.scales_k))
+        psv = tuple(s.at[ids].set(hsv[li])
+                    for li, s in enumerate(pcache.scales_v))
+        out = dataclasses.replace(out, scales_k=psk, scales_v=psv)
+    return out
 
 
 def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,

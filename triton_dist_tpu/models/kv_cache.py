@@ -117,11 +117,31 @@ class PagedSlotCache:
     same property is what makes PREEMPTION (models/scheduler.py) safe:
     a preempted slot's pages live on inside the radix tree while its
     table row points at trash, so the still-stepping masked row cannot
-    scribble on KV a future re-admission will map back."""
+    scribble on KV a future re-admission will map back.
+
+    INT8 POOL (dtype=jnp.int8 — the KV-quantization design of KIVI,
+    arXiv:2402.02750, specialized to per-position symmetric scales;
+    PAPERS.md): the page payload stores int8 and per-layer scale
+    planes scales_k/scales_v [NP, page] f32 ride ALONGSIDE it — a
+    physical page id addresses its payload AND its scales in every
+    layer, so the host allocator, the radix prefix tree, the
+    copy-on-write boundary copy and the host-tier d2h/h2d extract/
+    restore (models/kv_tier.py) are all layout-oblivious: whatever
+    moves a page moves its scales with the same id. Quantization is
+    kernels/quant.quantize_kv_int8 — the exact quantizer of the
+    contiguous int8 cache — and kernels/paged_kv.flash_decode_paged
+    dequants in-kernel by logit/P scaling, so paged-int8 streams are
+    bitwise identical to the contiguous-int8 reference while the
+    decode step's dominant HBM read halves and the same pool holds
+    ~2x the resident pages."""
 
     pages_k: Tuple[jax.Array, ...]   # L x [NP, page, d]
     pages_v: Tuple[jax.Array, ...]
     table: jax.Array                 # [B*Hkv, max_pages] int32
+    # int8 pool only: per-position dequant scales, L x [NP, page] f32
+    # (empty tuples for the bf16 pool — a pytree-stable "absent")
+    scales_k: Tuple[jax.Array, ...] = ()
+    scales_v: Tuple[jax.Array, ...] = ()
     trash: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @staticmethod
@@ -135,11 +155,23 @@ class PagedSlotCache:
             jax.device_put(jnp.zeros((num_pages, page, head_dim), dtype),
                            rep)
             for _ in range(num_layers))
+        sk = sv = ()
+        if jnp.dtype(dtype) == jnp.int8:
+            s_rep = NamedSharding(mesh, P(None, None))
+            mks = lambda: tuple(
+                jax.device_put(jnp.zeros((num_pages, page), jnp.float32),
+                               s_rep)
+                for _ in range(num_layers))
+            sk, sv = mks(), mks()
         table = jax.device_put(
             jnp.full((X, maxp), trash, jnp.int32),
             NamedSharding(mesh, P(None, None)))
         return PagedSlotCache(pages_k=mk(), pages_v=mk(), table=table,
-                              trash=trash)
+                              scales_k=sk, scales_v=sv, trash=trash)
+
+    @property
+    def quantized(self) -> bool:
+        return bool(self.scales_k)
 
     @property
     def page(self) -> int:
@@ -155,11 +187,22 @@ class PagedSlotCache:
         return self.table.shape[1] * self.page
 
     def layer(self, idx: int):
+        """Per-layer pool tuple for the paged attends: (pages_k,
+        pages_v) — or (pages_k, pages_v, scales_k, scales_v) when
+        int8 (mirrors KVCache.layer's 2-vs-4 contract)."""
+        if self.quantized:
+            return (self.pages_k[idx], self.pages_v[idx],
+                    self.scales_k[idx], self.scales_v[idx])
         return self.pages_k[idx], self.pages_v[idx]
 
-    def set_layer(self, idx: int, ck, cv) -> "PagedSlotCache":
+    def set_layer(self, idx: int, *kv) -> "PagedSlotCache":
         def put(t, x):
             return t[:idx] + (x,) + t[idx + 1:]
 
-        return dataclasses.replace(self, pages_k=put(self.pages_k, ck),
-                                   pages_v=put(self.pages_v, cv))
+        out = dataclasses.replace(self, pages_k=put(self.pages_k, kv[0]),
+                                  pages_v=put(self.pages_v, kv[1]))
+        if len(kv) == 4:
+            out = dataclasses.replace(
+                out, scales_k=put(self.scales_k, kv[2]),
+                scales_v=put(self.scales_v, kv[3]))
+        return out

@@ -57,6 +57,38 @@ Streams are bitwise identical chunked vs monolithic across
 stream waits on between its tokens drops from the full prompt length
 to `prefill_budget` (stats(): max_prefill_tokens_per_poll).
 
+Overlap scheduling (the SGLang zero-overhead overlap scheduler —
+Zheng et al. 2312.07104, PAPERS.md — over this repo's slot machinery):
+with ``ContinuousScheduler(overlap=True)`` the driver DISPATCHES the
+device program for tick N+1 BEFORE reading back tick N's results, so
+every poll's host bookkeeping (admit/retire, radix-tree inserts,
+drafting, stats, the serving layer's socket writes) runs while the
+device is busy — at large slot counts host time is otherwise the
+inter-token floor. Mechanics:
+
+- every blocking readback rides ``DecodeSlots._fetch`` and is timed
+  into ``device_wait_s``, so ``stats()["host_ms_per_poll"]`` reports
+  dispatch-to-dispatch host time with device wait subtracted; the
+  tick's readback is ONE coalesced ``jax.device_get`` per poll (spec
+  arming adds a small per-armed-slot seed fetch on top);
+- the non-spec emission plan is HOST-DETERMINISTIC (each active slot
+  emits min(remaining, chunk) tokens), so ``begin_chunk``/
+  ``begin_mixed`` account budgets and clear finished slots' active
+  masks at DISPATCH time and defer only the token VALUES — streaming,
+  the paged token mirrors, and retirement (the radix-tree insert needs
+  the values) — to ``land`` one poll later;
+- spec=K drafts need the landed history, so the spec pipeline lands
+  within its own poll and instead DEFERS the retire/admit work of the
+  previous tick to run between dispatch and land (staged retires);
+- admissions see a slot freed by tick N only after N lands — the
+  one-tick admission delay — and any path that must mutate an
+  in-flight slot (preemption, cancel-on-disconnect, an in-flight
+  deadline expiry) DRAINS the pipeline first, so token streams stay
+  BITWISE identical overlap-on vs overlap-off across every mode
+  (tests/test_overlap.py);
+- the watchdog and deadline checks move to LANDED-tick boundaries
+  (a dispatch cannot hang; the readback can).
+
 Resilience (the degradation ladder under pressure — vLLM's
 preemption/recompute design over the Orca operational model,
 PAPERS.md):
@@ -127,6 +159,99 @@ class Request:
     resume: Optional[ResumeState] = None
 
 
+class _TokenLog:
+    """Incrementally grown int32 token log (amortized-doubling numpy
+    buffer) backing the per-slot history/token mirrors. Replaces the
+    Python-list mirrors whose drafter/retire paths rebuilt a fresh
+    array from the whole list every time (O(generated^2) host work over
+    a stream's life): appends are amortized O(1) numpy copies and
+    ``view()`` is a zero-copy slice the drafter's n-gram scan and the
+    radix-tree insert consume directly."""
+
+    __slots__ = ("_buf", "_n")
+
+    def __init__(self, init=None, cap: int = 64):
+        self._buf = np.empty((max(int(cap), 8),), np.int32)
+        self._n = 0
+        if init is not None:
+            self.extend(init)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def extend(self, toks) -> None:
+        toks = np.asarray(toks, np.int32).reshape(-1)
+        need = self._n + len(toks)
+        if need > len(self._buf):
+            buf = np.empty((max(need, 2 * len(self._buf)),), np.int32)
+            buf[:self._n] = self._buf[:self._n]
+            self._buf = buf
+        self._buf[self._n:need] = toks
+        self._n = need
+
+    def append(self, t: int) -> None:
+        if self._n == len(self._buf):
+            buf = np.empty((2 * len(self._buf),), np.int32)
+            buf[:self._n] = self._buf[:self._n]
+            self._buf = buf
+        self._buf[self._n] = t
+        self._n += 1
+
+    def pop(self) -> None:
+        self._n -= 1
+
+    def view(self) -> np.ndarray:
+        """Zero-copy window over the valid extent. Treat as read-only;
+        it aliases the growing buffer (``.copy()`` anything that must
+        outlive the next append). Note in-place appends only ever
+        write PAST the window (growth reallocates), so a view's
+        contents are stable even while the log keeps growing."""
+        return self._buf[:self._n]
+
+    # sequence protocol + zero-copy numpy conversion: drafters receive
+    # the log itself (Drafter.propose takes a Sequence[int]), so both
+    # `history[-1]`-style scalar access and np.asarray(history) work
+    # without rebuilding a list
+    def __getitem__(self, i):
+        return self.view()[i]
+
+    def __array__(self, dtype=None):
+        v = self.view()
+        return v if dtype is None else v.astype(dtype)
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-unlanded tick (the overlap scheduler's
+    pipeline register). ``arrs`` are the device arrays the landing
+    fetches in ONE coalesced device_get; ``plan`` is the emission plan
+    fixed at dispatch time — (slot, rid, keep) rows for the
+    deterministic non-spec modes, (slot, rid) verify rows for spec
+    (whose keeps are data-dependent). ``finishing`` (non-spec) lists
+    the slots the plan determined will have exhausted their budget
+    when this tick lands. ``arm`` (spec mixed ticks) lists prefills
+    whose final chunk is in this tick — arming needs the landed
+    logits, so it runs at land. rids ride along purely as a guard: the
+    drain-before-retire invariant means a slot in an unlanded tick is
+    never reassigned, and ``land`` asserts it."""
+    kind: str                  # "chunk" | "mixed" | "spec" | "mixed_spec"
+    arrs: tuple                     # device arrays to fetch
+    plan: list
+    finishing: list
+    tokens: Optional[np.ndarray] = None    # spec: the verify window
+    q_lens: Optional[np.ndarray] = None
+    arm: list = dataclasses.field(default_factory=list)
+
+
+def _merge_out(acc: Dict[object, np.ndarray], rid, toks) -> None:
+    """Append landed tokens for one rid to a poll's output dict (a
+    drained tick and a freshly landed one can both deliver in the same
+    poll — order preserved: drained is older)."""
+    toks = np.asarray(toks)
+    acc[rid] = (np.concatenate([acc[rid], toks]) if rid in acc
+                else toks)
+
+
 class DecodeSlots:
     """Per-slot decode state: device-side carry (last logits, per-slot
     position, active mask, per-slot PRNG keys) + host-side bookkeeping
@@ -177,6 +302,13 @@ class DecodeSlots:
         self._pf_ids: List[Optional[np.ndarray]] = [None] * batch
         self._pf_off = np.zeros((batch,), np.int64)
         self.prefill_forwarded = 0
+        # overlap scheduling (module docstring): the pipeline register
+        # holding one dispatched-but-unlanded tick, and the cumulative
+        # time spent BLOCKED on device readbacks (every blocking fetch
+        # goes through _fetch) — the scheduler subtracts it from the
+        # dispatch-to-dispatch interval to report host_ms_per_poll
+        self._inflight: Optional[_InFlight] = None
+        self.device_wait_s = 0.0
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
@@ -189,8 +321,11 @@ class DecodeSlots:
             self._vocab = V
             # per-slot token history (prompt + emitted) — the drafter's
             # lookup corpus — and the pending seed token each verify
-            # window starts with
-            self._hist: List[List[int]] = [[] for _ in range(batch)]
+            # window starts with. _TokenLog: amortized-O(1) appends and
+            # a zero-copy view per draft, instead of list mirrors whose
+            # per-step conversions cost O(generated^2) over a stream
+            self._hist: List[_TokenLog] = [_TokenLog()
+                                           for _ in range(batch)]
             self._t0 = np.zeros((batch,), np.int64)
             # accept counters (stats(): spec_accept_rate /
             # tokens_per_step, surfaced through TokenServer). The
@@ -267,15 +402,19 @@ class DecodeSlots:
             # seed token = what spec=0 would emit first from these
             # logits (greedy argmax on the host; sampled draws through
             # the slot's PRNG chain so the chain stays per-slot)
-            self._hist[slot] = [int(t) for t in np.asarray(req.ids)]
+            self._hist[slot] = _TokenLog(req.ids)
             if rs is not None and rs.t0 is not None:
                 self._t0[slot] = int(rs.t0)
             elif self.engine.sampling == "greedy":
-                self._t0[slot] = int(np.argmax(np.asarray(row_logits)))
+                # arming readbacks ride _fetch so their device wait is
+                # not misattributed as host time (host_ms_per_poll)
+                (row,) = self._fetch((row_logits,))
+                self._t0[slot] = int(np.argmax(row))
             else:
                 t0, k2 = self.engine.spec_seed(row_logits,
                                                self.keys[slot])
                 self.keys = self.keys.at[slot].set(k2)
+                (t0,) = self._fetch((t0,))
                 self._t0[slot] = int(t0)
             self._spec_drafted[slot] = 0
             self._spec_accepted[slot] = 0
@@ -370,30 +509,45 @@ class DecodeSlots:
         self._pf_ids[slot] = None
         self._pf_off[slot] = 0
         if self.spec:
-            self._hist[slot] = []
+            self._hist[slot] = _TokenLog()
 
-    def _run_chunk(self, chunk: int) -> np.ndarray:
-        """Engine-call hook: one chunk of the slot scan (paged variant
-        swaps in paged_slot_chunk)."""
+    def _fetch(self, arrs: tuple) -> tuple:
+        """The ONE blocking readback of a tick: a single coalesced
+        jax.device_get over every array the tick hands back, timed
+        into device_wait_s (the scheduler reports host_ms_per_poll =
+        dispatch-to-dispatch interval minus this). Shared by the sync
+        steps (fetch right after dispatch) and the overlap land (fetch
+        one poll later)."""
+        import jax
+        t0 = time.perf_counter()
+        out = jax.device_get(arrs)
+        self.device_wait_s += time.perf_counter() - t0
+        return out
+
+    def _run_chunk(self, chunk: int):
+        """Engine-call hook: DISPATCH one chunk of the slot scan (paged
+        variant swaps in paged_slot_chunk). Returns the tick's token
+        array still on device — the caller lands it through _fetch
+        (sync: immediately; overlap: one poll later)."""
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_chunk(self.logits, self.cache, self.pos,
                                    self.active, chunk=chunk,
                                    keys=self.keys)
-        return np.asarray(toks)
+        return toks
 
     def _record(self, slot: int, toks) -> None:
         """Hook: paged slots record kept tokens for the retire-time
         prefix-tree insert; the contiguous path keeps nothing."""
 
     def _run_verify(self, tokens, q_lens):
-        """Engine-call hook for one spec verify forward (paged variant
-        swaps in paged_slot_verify_chunk). Returns host (n_emit,
-        t0_next)."""
+        """Engine-call hook: DISPATCH one spec verify forward (paged
+        variant swaps in paged_slot_verify_chunk). Returns device
+        (n_emit, t0_next) — landed via _fetch."""
         n_emit, t0n, self.cache, self.pos, self.keys = \
             self.engine.slot_verify_chunk(self.cache, self.pos,
                                           self.active, tokens, q_lens,
                                           keys=self.keys)
-        return np.asarray(n_emit), np.asarray(t0n)
+        return n_emit, t0n
 
     def _draft_into(self, tokens: np.ndarray, q_lens: np.ndarray,
                     b: int) -> None:
@@ -404,8 +558,9 @@ class DecodeSlots:
         tokens[b, 0] = self._t0[b]
         kmax = min(self.spec, int(self.remaining[b]) - 1)
         if kmax > 0:
-            # append the pending seed for the lookup, then undo —
-            # no per-step copy of the (growing) history list
+            # append the pending seed for the lookup, then undo — the
+            # drafter sees a ZERO-COPY window over the log (no per-step
+            # rebuild of the growing history)
             h = self._hist[b]
             h.append(int(self._t0[b]))
             try:
@@ -439,7 +594,7 @@ class DecodeSlots:
             kept = tokens[b, :keep].copy()
             out[b] = kept
             self.remaining[b] -= keep
-            self._hist[b].extend(int(t) for t in kept)
+            self._hist[b].extend(kept)
             self._record(b, kept)
             self._spec_slot_steps += 1
             self._spec_emitted += keep
@@ -465,7 +620,8 @@ class DecodeSlots:
         q_lens = np.ones((self.batch,), np.int32)
         for b in self.decode_slots:
             self._draft_into(tokens, q_lens, b)
-        n_emit, t0n = self._run_verify(tokens, q_lens)
+        n_emit, t0n = self._fetch(self._run_verify(tokens, q_lens))
+        n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
         self._spec_steps += 1
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
@@ -513,43 +669,62 @@ class DecodeSlots:
         emits 1..K+1 tokens per call (seed + accepted drafts)."""
         if self.spec:
             return self._step_spec()
-        toks = self._run_chunk(chunk)
+        (toks,) = self._fetch((self._run_chunk(chunk),))
+        toks = np.asarray(toks)
+        plan, finished = self._plan_chunk(chunk)
         out: Dict[int, np.ndarray] = {}
-        finished: List[Tuple[int, object]] = []
+        for b, _, keep in plan:
+            out[b] = toks[b, :keep]
+            self._record(b, toks[b, :keep])
+        return out, finished
+
+    def _plan_chunk(self, chunk: int, skip=frozenset()
+                    ) -> Tuple[list, list]:
+        """The deterministic non-spec emission plan of one chunk tick:
+        charge each armed slot min(remaining, chunk) and list the
+        (slot, rid, keep) rows plus the slots that finish. ONE copy of
+        the budget arithmetic, shared by the sync step (which fills in
+        the landed token values immediately) and the overlap dispatch
+        (which defers them to land()) — the bitwise overlap-on==off
+        contract rides on these never drifting."""
+        plan, finishing = [], []
         for b in self.decode_slots:
+            if b in skip:
+                continue
             keep = int(min(self.remaining[b], chunk))
             if keep:
-                out[b] = toks[b, :keep]
+                plan.append((b, self.rids[b], keep))
                 self.remaining[b] -= keep
-                self._record(b, toks[b, :keep])
             if self.remaining[b] == 0:
-                finished.append((b, self.rids[b]))
-        return out, finished
+                finishing.append((b, self.rids[b]))
+        return plan, finishing
 
     # ------------------------------------------------------------------
     # chunked prefill: the mixed prefill+decode tick (Sarathi-Serve)
     # ------------------------------------------------------------------
 
-    def _run_mixed(self, tokens, q_lens, pf) -> np.ndarray:
-        """Engine hook: one non-spec mixed tick (paged variant swaps in
-        paged_slot_mixed_chunk). Updates the carry logits to each row's
-        last-valid-window-position logits — a decode row's next carry,
-        a final-chunk prefill row's arming logits."""
+    def _run_mixed(self, tokens, q_lens, pf):
+        """Engine hook: DISPATCH one non-spec mixed tick (paged variant
+        swaps in paged_slot_mixed_chunk). Updates the carry logits to
+        each row's last-valid-window-position logits — a decode row's
+        next carry, a final-chunk prefill row's arming logits. Returns
+        the device token array (landed via _fetch)."""
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_mixed_chunk(
                 self.logits, self.cache, self.pos, self.active, pf,
                 tokens, q_lens, keys=self.keys)
-        return np.asarray(toks)
+        return toks
 
     def _run_mixed_verify(self, tokens, q_lens, pf):
-        """Engine hook: one spec-mode mixed tick. The returned arming
-        logits replace the (spec-unused) carry so _arm_slot can read
-        them per completed prefill."""
+        """Engine hook: DISPATCH one spec-mode mixed tick. The returned
+        arming logits replace the (spec-unused) carry so _arm_slot can
+        read them per completed prefill. Returns device
+        (n_emit, t0_next) — landed via _fetch."""
         n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_mixed_verify_chunk(
                 self.cache, self.pos, self.active, pf, tokens, q_lens,
                 keys=self.keys)
-        return np.asarray(n_emit), np.asarray(t0n)
+        return n_emit, t0n
 
     def _pf_record(self, slot: int, toks) -> None:
         """Hook: paged slots extend the VALID-extent token mirror as
@@ -576,11 +751,42 @@ class DecodeSlots:
         accepted spec window) — the most prefill work any live stream
         ever waits on between two of its tokens is `budget` tokens.
         Same return contract as step_chunk."""
+        tokens, q_lens, pf, chunks = self._build_mixed_window(budget)
+        decode = self.decode_slots
+        out: Dict[int, np.ndarray] = {}
+        finished: List[Tuple[int, object]] = []
+        if self.spec:
+            for b in decode:
+                self._draft_into(tokens, q_lens, b)
+            n_emit, t0n = self._fetch(
+                self._run_mixed_verify(tokens, q_lens, pf))
+            n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
+            self._spec_steps += 1
+            for b in decode:
+                self._account_spec(b, tokens, q_lens, n_emit, t0n, out,
+                                   finished)
+        else:
+            (toks,) = self._fetch((self._run_mixed(tokens, q_lens, pf),))
+            toks = np.asarray(toks)
+            plan, finished = self._plan_mixed_decode(decode)
+            for b, _, _ in plan:
+                kept = toks[b:b + 1].copy()
+                out[b] = kept
+                self._record(b, kept)
+        # advance the prefills; arm the ones whose final chunk landed
+        self._advance_prefills(chunks)
+        return out, finished
+
+    def _build_mixed_window(self, budget: int):
+        """One mixed tick's window: prefill chunk rows split FIFO by
+        admission order under the token budget (q_len 0 = starved, no
+        progress). ONE copy of the split arithmetic, shared by the
+        sync step and the overlap dispatch. Returns (tokens, q_lens,
+        pf mask, {slot: chunk len})."""
         S = max(int(budget), (self.spec + 1) if self.spec else 1)
         tokens = np.zeros((self.batch, S), np.int32)
         q_lens = np.ones((self.batch,), np.int32)
         pf = np.zeros((self.batch,), bool)
-        decode = self.decode_slots
         left = int(budget)
         chunks: Dict[int, int] = {}
         for b in sorted(self.prefill_slots,
@@ -594,27 +800,28 @@ class DecodeSlots:
                 tokens[b, :c] = ids[off:off + c]
                 chunks[b] = c
             left -= c
-        out: Dict[int, np.ndarray] = {}
-        finished: List[Tuple[int, object]] = []
-        if self.spec:
-            for b in decode:
-                self._draft_into(tokens, q_lens, b)
-            n_emit, t0n = self._run_mixed_verify(tokens, q_lens, pf)
-            self._spec_steps += 1
-            for b in decode:
-                self._account_spec(b, tokens, q_lens, n_emit, t0n, out,
-                                   finished)
-        else:
-            toks = self._run_mixed(tokens, q_lens, pf)
-            for b in decode:
-                if self.remaining[b] > 0:
-                    kept = toks[b:b + 1].copy()
-                    out[b] = kept
-                    self.remaining[b] -= 1
-                    self._record(b, kept)
-                if self.remaining[b] == 0:
-                    finished.append((b, self.rids[b]))
-        # advance the prefills; arm the ones whose final chunk landed
+        return tokens, q_lens, pf, chunks
+
+    def _plan_mixed_decode(self, decode) -> Tuple[list, list]:
+        """Mixed-tick twin of _plan_chunk: each live decode row emits
+        exactly one token. Shared by the sync step and the overlap
+        dispatch."""
+        plan, finishing = [], []
+        for b in decode:
+            if self.remaining[b] > 0:
+                plan.append((b, self.rids[b], 1))
+                self.remaining[b] -= 1
+            if self.remaining[b] == 0:
+                finishing.append((b, self.rids[b]))
+        return plan, finishing
+
+    def _advance_prefills(self, chunks: Dict[int, int],
+                          arm: Optional[list] = None) -> None:
+        """Advance the dispatched prefill chunks' offsets/mirrors and
+        handle completions: arm immediately (sync, and the non-spec
+        overlap dispatch — arming is sync-free there), or defer by
+        appending (slot, req, n) to `arm` (spec overlap: the arming
+        logits have not landed yet)."""
         for b, c in chunks.items():
             self.prefill_forwarded += c
             ids = self._pf_ids[b]
@@ -625,7 +832,133 @@ class DecodeSlots:
                 req = self.reqs[b]
                 self._pf_ids[b] = None
                 self._pf_off[b] = 0
-                self._arm_slot(b, req, self.logits[b], len(ids))
+                if arm is not None:
+                    arm.append((b, req, len(ids)))
+                else:
+                    self._arm_slot(b, req, self.logits[b], len(ids))
+                    self._pf_armed(b)
+
+    # ------------------------------------------------------------------
+    # overlap scheduling: the dispatch/land split (module docstring).
+    # begin_* dispatches the SAME engine program its sync step_* twin
+    # runs (identical shapes — no new executables) and fixes the
+    # emission plan on the host; land() fetches the landed values ONE
+    # coalesced device_get later and finishes the bookkeeping that
+    # needed them. ContinuousScheduler(overlap=True) drives these.
+    # ------------------------------------------------------------------
+
+    def begin_chunk(self, chunk: int, skip=frozenset()) -> None:
+        """Dispatch one decode tick WITHOUT reading it back. Non-spec:
+        the emission plan is host-deterministic (each armed slot emits
+        min(remaining, chunk) tokens), so budgets are charged and
+        finishing slots' active masks cleared NOW — the next dispatch
+        can run before this tick lands — and only the token VALUES
+        (streaming, the paged token mirrors, retirement) wait for
+        land(). spec=K delegates to begin_spec (drafts need landed
+        history, so the spec pipeline lands within its own poll and
+        overlaps the deferred bookkeeping instead). `skip`: slots that
+        landed as finished but are not yet retired — no part of this
+        tick."""
+        assert self._inflight is None, "land() the previous tick first"
+        if self.spec:
+            self.begin_spec(skip)
+            return
+        toks_dev = self._run_chunk(chunk)
+        plan, finishing = self._plan_chunk(chunk, skip)
+        for b, _ in finishing:
+            # masked out of the NEXT tick at dispatch time (sync
+            # retires between ticks; the retire itself waits for
+            # land — the radix-tree insert needs the token values)
+            self.active = self.active.at[b].set(False)
+        self._inflight = _InFlight("chunk", (toks_dev,), plan, finishing)
+
+    def begin_spec(self, skip=frozenset()) -> None:
+        """Dispatch one spec verify tick: drafting reads the LANDED
+        history (that is why the spec pipeline cannot dispatch-ahead
+        across polls), accept counts are data-dependent, so the whole
+        emission plan defers to land()."""
+        assert self._inflight is None, "land() the previous tick first"
+        S = self.spec + 1
+        tokens = np.zeros((self.batch, S), np.int32)
+        q_lens = np.ones((self.batch,), np.int32)
+        plan = []
+        for b in self.decode_slots:
+            if b in skip:
+                continue
+            self._draft_into(tokens, q_lens, b)
+            plan.append((b, self.rids[b]))
+        arrs = self._run_verify(tokens, q_lens)
+        self._spec_steps += 1
+        self._inflight = _InFlight("spec", arrs, plan, [],
+                                   tokens=tokens, q_lens=q_lens)
+
+    def begin_mixed(self, budget: int, skip=frozenset()) -> None:
+        """Dispatch one mixed prefill+decode tick (step_mixed's
+        dispatch half). Prefill offsets/mirrors advance NOW (the chunk
+        contents are host-known prompt tokens) and a completed
+        prefill's arming is sync-free under non-spec (the carry rows
+        are device futures); spec arming needs the landed logits so it
+        rides the pipeline register to land()."""
+        assert self._inflight is None, "land() the previous tick first"
+        tokens, q_lens, pf, chunks = self._build_mixed_window(budget)
+        decode = [b for b in self.decode_slots if b not in skip]
+        if self.spec:
+            for b in decode:
+                self._draft_into(tokens, q_lens, b)
+            arrs = self._run_mixed_verify(tokens, q_lens, pf)
+            self._spec_steps += 1
+            inf = _InFlight("mixed_spec", arrs,
+                            [(b, self.rids[b]) for b in decode], [],
+                            tokens=tokens, q_lens=q_lens)
+        else:
+            toks_dev = self._run_mixed(tokens, q_lens, pf)
+            plan, finishing = self._plan_mixed_decode(decode)
+            for b, _ in finishing:
+                self.active = self.active.at[b].set(False)
+            inf = _InFlight("mixed", (toks_dev,), plan, finishing)
+        # advance the prefills at dispatch time (host-deterministic);
+        # spec arming waits for the landed logits (inf.arm)
+        self._advance_prefills(chunks, inf.arm if self.spec else None)
+        self._inflight = inf
+
+    def land(self) -> Tuple[Dict[int, np.ndarray],
+                            List[Tuple[int, object]]]:
+        """Fetch the in-flight tick (ONE coalesced device_get) and run
+        the value-dependent half of its bookkeeping. Same return
+        contract as step_chunk — finished slots are NOT retired here;
+        the caller streams their tail first, then retires. No-op
+        ({}, []) when nothing is in flight."""
+        inf, self._inflight = self._inflight, None
+        if inf is None:
+            return {}, []
+        out: Dict[int, np.ndarray] = {}
+        finished: List[Tuple[int, object]] = []
+        if inf.kind in ("chunk", "mixed"):
+            (toks,) = self._fetch(inf.arrs)
+            toks = np.asarray(toks)
+            for b, rid, keep in inf.plan:
+                assert self.rids[b] == rid, \
+                    "slot reassigned under an unlanded tick"
+                kept = (toks[b, :keep] if inf.kind == "chunk"
+                        else toks[b:b + 1]).copy()
+                out[b] = kept
+                self._record(b, kept)
+            finished = inf.finishing
+        else:                                  # "spec" / "mixed_spec"
+            n_emit, t0n = self._fetch(inf.arrs)
+            n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
+            for b, rid in inf.plan:
+                assert self.rids[b] == rid, \
+                    "slot reassigned under an unlanded tick"
+                self._account_spec(b, inf.tokens, inf.q_lens, n_emit,
+                                   t0n, out, finished)
+            for b, _ in finished:
+                # sync clears this inside retire(); the overlap spec
+                # pipeline STAGES the retire for the next poll, and the
+                # next verify dispatch must not step a finished slot
+                self.active = self.active.at[b].set(False)
+            for b, req, n in inf.arm:
+                self._arm_slot(b, req, self.logits[b], n)
                 self._pf_armed(b)
         return out, finished
 
@@ -681,9 +1014,13 @@ class PagedDecodeSlots(DecodeSlots):
         assert self.prefix.pool.trash == self.cache.trash
         # per-slot host mirrors: mapped page groups (absolute page
         # order) and the token stream (prompt + kept generated) whose
-        # KV those pages hold — the retire-time tree insert
+        # KV those pages hold — the retire-time tree insert. _TokenLog:
+        # amortized-O(1) appends + zero-copy views for the tree insert
+        # and the preemption snapshot (the list mirrors' per-call
+        # rebuilds were O(generated^2) host work over a stream)
         self._groups: List[List[np.ndarray]] = [[] for _ in range(batch)]
-        self._tokens: List[List[int]] = [[] for _ in range(batch)]
+        self._tokens: List[_TokenLog] = [_TokenLog()
+                                         for _ in range(batch)]
 
     def _make_cache(self):
         return self.engine.make_paged_slot_cache(
@@ -696,16 +1033,19 @@ class PagedDecodeSlots(DecodeSlots):
     # with the admission/decode programs through data dependence.
 
     def _tier_extract(self, groups):
-        """Demotion d2h: snapshot the span's pages (all layers)."""
+        """Demotion d2h: snapshot the span's pages (all layers). An
+        int8 pool's payload carries the scale planes too ("ks"/"vs")
+        — the d2h/h2d round trip stays bitwise for both layouts."""
         ids = np.concatenate([np.asarray(g, np.int32) for g in groups])
-        k, v = self.engine.extract_pages_host(self.cache, ids)
-        return {"k": k, "v": v}
+        out = self.engine.extract_pages_host(self.cache, ids)
+        return dict(zip(("k", "v", "ks", "vs"), out))
 
     def _tier_restore(self, payload, groups) -> None:
         """Promotion h2d: install a snapshot into fresh pages."""
         ids = np.concatenate([np.asarray(g, np.int32) for g in groups])
         self.cache = self.engine.restore_pages_host(
-            self.cache, ids, payload["k"], payload["v"])
+            self.cache, ids, payload["k"], payload["v"],
+            payload.get("ks"), payload.get("vs"))
 
     @property
     def capacity(self) -> int:
@@ -806,7 +1146,7 @@ class PagedDecodeSlots(DecodeSlots):
         self.prefill_forwarded += n - m
         self._arm_slot(slot, req, row, n)
         self._groups[slot] = slot_groups
-        self._tokens[slot] = tokens.tolist()
+        self._tokens[slot] = _TokenLog(tokens)
         self.prefix.record(n, m)
         # insert the PROMPT pages now (not just at retire): the next
         # admission — even one in the same poll — can already share
@@ -836,7 +1176,7 @@ class PagedDecodeSlots(DecodeSlots):
         if boundary is not None:
             self.prefix.pool.release(boundary)
         self._groups[slot] = slot_groups
-        self._tokens[slot] = tokens[:m].tolist()
+        self._tokens[slot] = _TokenLog(tokens[:m])
         self.prefix.record(n, m)
         self._park_prefilling(slot, req, tokens, m)
 
@@ -871,7 +1211,10 @@ class PagedDecodeSlots(DecodeSlots):
                                  preemptions=1)
             self.retire(slot)  # donates the valid prefill extent
             return dataclasses.replace(req, resume=snap)
-        toks = np.asarray(self._tokens[slot], np.int32)
+        # zero-copy: retire() below replaces the log, so the view's
+        # buffer is never appended to again — the re-queued request
+        # owns it alone
+        toks = self._tokens[slot].view()
         remaining = int(self.remaining[slot])
         rs = req.resume
         snap = ResumeState(
@@ -889,53 +1232,52 @@ class PagedDecodeSlots(DecodeSlots):
         the slot's page refs, and point its table rows at the trash
         page so the masked-out scan rows can never write into a page
         the allocator hands to someone else."""
-        if self._tokens[slot]:
+        if len(self._tokens[slot]):
             npg = -(-len(self._tokens[slot]) // self.page)
-            self.prefix.insert(
-                np.asarray(self._tokens[slot], np.int32),
-                self._groups[slot][:npg])
+            self.prefix.insert(self._tokens[slot].view(),
+                               self._groups[slot][:npg])
         for g in self._groups[slot]:
             self.prefix.pool.release(g)
         self.cache = self.engine.retire_slot_paged(self.cache, slot)
         self._groups[slot] = []
-        self._tokens[slot] = []
+        self._tokens[slot] = _TokenLog()
         super().retire(slot)
 
-    def _run_chunk(self, chunk: int) -> np.ndarray:
+    def _run_chunk(self, chunk: int):
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_chunk(self.logits, self.cache,
                                          self.pos, self.active,
                                          chunk=chunk, keys=self.keys)
-        return np.asarray(toks)
+        return toks
 
     def _run_verify(self, tokens, q_lens):
         n_emit, t0n, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_verify_chunk(self.cache, self.pos,
                                                 self.active, tokens,
                                                 q_lens, keys=self.keys)
-        return np.asarray(n_emit), np.asarray(t0n)
+        return n_emit, t0n
 
-    def _run_mixed(self, tokens, q_lens, pf) -> np.ndarray:
+    def _run_mixed(self, tokens, q_lens, pf):
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_mixed_chunk(
                 self.logits, self.cache, self.pos, self.active, pf,
                 tokens, q_lens, keys=self.keys)
-        return np.asarray(toks)
+        return toks
 
     def _run_mixed_verify(self, tokens, q_lens, pf):
         n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_mixed_verify_chunk(
                 self.cache, self.pos, self.active, pf, tokens, q_lens,
                 keys=self.keys)
-        return np.asarray(n_emit), np.asarray(t0n)
+        return n_emit, t0n
 
     def _record(self, slot: int, toks) -> None:
-        self._tokens[slot].extend(int(t) for t in toks)
+        self._tokens[slot].extend(toks)
 
     def _pf_record(self, slot: int, toks) -> None:
         # a landed chunk extends the VALID extent — these tokens' KV is
         # now in the slot's pages, so retire/preempt may donate them
-        self._tokens[slot].extend(int(t) for t in toks)
+        self._tokens[slot].extend(toks)
 
     def _pf_armed(self, slot: int) -> None:
         # the prompt's KV is complete only now — insert it so the next
@@ -943,7 +1285,7 @@ class PagedDecodeSlots(DecodeSlots):
         # admit time, where the KV is computed in the same program)
         n = len(self._tokens[slot])
         self.prefix.insert(
-            np.asarray(self._tokens[slot], np.int32),
+            self._tokens[slot].view(),
             self._groups[slot][:-(-n // self.page)])
 
 
@@ -961,7 +1303,7 @@ class ContinuousScheduler:
                  watchdog_s: Optional[float] = None,
                  preempt: bool = True, fault=None,
                  prefill_budget: Optional[int] = None,
-                 host_pool_pages: int = 0):
+                 host_pool_pages: int = 0, overlap: bool = False):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -1012,7 +1354,22 @@ class ContinuousScheduler:
         and promotes them back on a prefix hit, multiplying the
         effective cache to num_pages + N while every stream stays
         bitwise identical. Size it to the host RAM you can pin — tens
-        to hundreds of x the HBM pool is the regime it exists for."""
+        to hundreds of x the HBM pool is the regime it exists for.
+
+        overlap: DISPATCH-AHEAD OVERLAP SCHEDULING (the SGLang
+        zero-overhead overlap scheduler — module docstring has the
+        pipeline design). False (default) keeps the synchronous poll:
+        dispatch, block on the readback, then do host bookkeeping with
+        the device idle. True dispatches tick N+1 before reading back
+        tick N (non-spec; spec=K overlaps the deferred retire/admit
+        work with its in-poll verify instead), so admissions, the
+        radix-tree bookkeeping, drafting and the serving layer's
+        socket writes all run while the device computes. Streams are
+        BITWISE identical either way (tests/test_overlap.py) — tokens
+        just arrive one poll later at stream start, and a freed slot
+        re-admits one tick later. Watch stats()["host_ms_per_poll"]:
+        when it approaches the device step time, overlap=True is the
+        difference between host-bound and device-bound serving."""
         if prefill_budget is not None and prefill_budget < 1:
             raise ValueError(f"prefill_budget must be >= 1, got "
                              f"{prefill_budget}")
@@ -1036,13 +1393,27 @@ class ContinuousScheduler:
         self.watchdog_s = watchdog_s
         self.preempt = preempt
         self.fault = fault
+        self.overlap = bool(overlap)
+        # overlap pipeline state: spec-mode finished-but-unretired
+        # slots (their retire is deferred to overlap with the next
+        # verify), and the carry buffers a mid-phase/between-poll
+        # drain lands into (delivered by the next poll)
+        self._staged: List[Tuple[int, object]] = []
+        self._carry_out: Dict[object, np.ndarray] = {}
+        self._carry_done: List[object] = []
+        # host_ms_per_poll gauge: dispatch-to-dispatch wall time minus
+        # the device wait accumulated in between (DecodeSlots._fetch)
+        self._host_ms_ema: Optional[float] = None
+        self._last_mark: Optional[Tuple[float, float]] = None
         self._queue: deque = deque()
         # guards _queue/_deadline against cross-thread submit()/cancel()
         # racing the driver thread's poll() (the class contract allows
         # enqueueing from any thread; a bare deque.append was atomic
         # under the GIL, but the deadline stamp + max_queue bound are
-        # check-then-act sequences and _expire_deadlines iterates)
-        self._lock = threading.Lock()
+        # check-then-act sequences and _expire_deadlines iterates).
+        # Reentrant: the overlap drain paths pop finished deadlines
+        # from inside already-locked phases.
+        self._lock = threading.RLock()
         # rid -> absolute monotonic deadline for requests that carry a
         # deadline_ms budget; preserved across preemptions (keyed by
         # rid, stamped once at first submit)
@@ -1102,6 +1473,14 @@ class ContinuousScheduler:
                     del self._queue[i]
                     self._deadline.pop(rid, None)
                     return True
+        if self.overlap and not self._pipeline_idle() \
+                and any(self.slots.rids[b] == rid
+                        for b in self.slots.occupied):
+            # the rid's slot may be in the unlanded tick: land + retire
+            # first (other streams' landed tokens go to the carry
+            # buffers, delivered by the next poll), then cancel on
+            # consistent state — the rid may turn out to have finished
+            self._drain(self._carry_out, self._carry_done)
         for b in self.slots.occupied:
             if self.slots.rids[b] == rid:
                 self.slots.retire(b)
@@ -1128,14 +1507,36 @@ class ContinuousScheduler:
             "max_prefill_tokens_per_poll":
                 self.max_prefill_tokens_per_poll,
             "prefills_in_progress": len(self.slots.prefill_slots),
+            # host time per poll with device wait subtracted (EMA):
+            # the number overlap=True exists to hide behind the device
+            "overlap": self.overlap,
+            "host_ms_per_poll": (0.0 if self._host_ms_ema is None
+                                 else round(self._host_ms_ema, 3)),
+            "device_wait_s": round(self.slots.device_wait_s, 4),
         })
         if self._hang is not None:
             out["hang"] = self._hang
         return out
 
+    def _mark_dispatch(self) -> None:
+        """Stamp a device-step dispatch: host_ms_per_poll is the time
+        since the previous stamp minus the device wait accrued in
+        between (DecodeSlots._fetch) — i.e. what the HOST spent
+        scheduling, drafting, streaming and admitting per poll,
+        whether or not the device was busy under it."""
+        now = time.monotonic()
+        wait = self.slots.device_wait_s
+        if self._last_mark is not None:
+            t0, w0 = self._last_mark
+            host_ms = max(0.0, ((now - t0) - (wait - w0)) * 1e3)
+            self._host_ms_ema = host_ms if self._host_ms_ema is None \
+                else 0.8 * self._host_ms_ema + 0.2 * host_ms
+        self._last_mark = (now, wait)
+
     @property
     def idle(self) -> bool:
-        return not self._queue and not self.slots.occupied
+        return (not self._queue and not self.slots.occupied
+                and not self._carry_out and not self._carry_done)
 
     def _reject(self, rid, reason: str) -> None:
         import sys
@@ -1213,7 +1614,52 @@ class ContinuousScheduler:
                    key=lambda b: (slots.emitted(b),
                                   -int(slots.admit_tick[b])))
 
-    def _admit(self, done: List[object]) -> None:
+    def _pipeline_idle(self) -> bool:
+        """No dispatched-but-unlanded tick and no staged retires — the
+        host mirrors equal what sync mode would show at this poll
+        boundary, so preempt/cancel/deadline paths may mutate slots."""
+        return self.slots._inflight is None and not self._staged
+
+    def _drain(self, out_acc: Dict[object, np.ndarray],
+               done: List[object]) -> None:
+        """Collapse the overlap pipeline to the sync post-poll state:
+        land the in-flight tick (its tokens/done merge into the given
+        accumulators) and retire every finished-but-unretired slot —
+        staged spec finishers first, then the just-landed ones. The
+        drain-before-mutate rule (module docstring) routes every
+        preemption, cancel and in-flight deadline expiry through
+        here. The land runs watchdogged (_land_watchdog) — a drain's
+        readback can hang exactly like a poll's."""
+        out, finished = self._land_watchdog()
+        rid_of = self.slots.rids
+        for b, t in out.items():
+            _merge_out(out_acc, rid_of[b], t)
+        with self._lock:
+            for b, rid in finished:
+                self._deadline.pop(rid, None)
+                done.append(rid)
+        for b, rid in self._staged + finished:
+            if self.slots.rids[b] == rid:
+                self.slots.retire(b)
+        self._staged = []
+
+    def _expire_overlap(self, out_acc: Dict[object, np.ndarray],
+                        done: List[object]) -> None:
+        """_expire_deadlines behind the drain rule: an expired rid that
+        occupies a slot may be in the unlanded tick (its mirrors lag by
+        one tick), so the pipeline drains first. Queued-only expiries
+        never need the drain."""
+        if self._deadline and not self._pipeline_idle():
+            now = time.monotonic()
+            live = {r for r in self.slots.rids if r is not None}
+            if any(now >= dl and rid in live
+                   for rid, dl in self._deadline.items()):
+                self._drain(out_acc, done)
+        self._expire_deadlines(done)
+
+    def _admit(self, done: List[object],
+               out_acc: Optional[Dict[object, np.ndarray]] = None
+               ) -> None:
         """Refill free slots from the waiting line. A PoolExhausted
         admission PREEMPTS a victim and retries instead of rejecting,
         whenever an ELIGIBLE victim exists — one that emitted at least
@@ -1243,6 +1689,14 @@ class ContinuousScheduler:
                     self.slots.admit(free[0], req)
                 self._queue.popleft()
             except PoolExhausted as e:
+                if self.overlap and not self._pipeline_idle():
+                    # land + retire first: pages still held by the
+                    # in-flight tick's finishers may satisfy the
+                    # admission without preempting anyone — and
+                    # preempt() itself must only run on landed state
+                    self._drain(self._carry_out if out_acc is None
+                                else out_acc, done)
+                    continue
                 can_preempt = (self.preempt and self.slots.occupied
                                and hasattr(self.slots, "preempt"))
                 if not can_preempt:
@@ -1280,7 +1734,14 @@ class ContinuousScheduler:
         (e.g. prompt + gen beyond capacity) is reported as finished
         with no tokens — one bad request must never take down the
         serving loop. A PREEMPTED request is in neither list: it
-        silently re-queues and its rid keeps streaming on resume."""
+        silently re-queues and its rid keeps streaming on resume.
+
+        overlap=True swaps in the pipeline-aware iteration
+        (_poll_overlap): same contract, same streams, with the host
+        phases running under the device's compute instead of after
+        its readback."""
+        if self.overlap:
+            return self._poll_overlap()
         done: List[object] = []
         pf_before = self.slots.prefill_forwarded
         with self._lock:
@@ -1295,6 +1756,10 @@ class ContinuousScheduler:
             self._expire_deadlines(done)
             self._admit(done)
         if not self.slots.occupied:
+            # idle poll, nothing dispatched: drop the stamp so the idle
+            # gap is not charged as host time at the next burst's first
+            # dispatch (the EMA would jump by the whole wait)
+            self._last_mark = None
             self.max_prefill_tokens_per_poll = max(
                 self.max_prefill_tokens_per_poll,
                 self.slots.prefill_forwarded - pf_before)
@@ -1309,6 +1774,7 @@ class ContinuousScheduler:
         else:
             step = lambda: self.slots.step_chunk(self.chunk)
             label = f"scheduler chunk (chunk={self.chunk})"
+        self._mark_dispatch()
         if self.watchdog_s is not None:
             from triton_dist_tpu.runtime.stress import watchdog
             try:
@@ -1335,6 +1801,113 @@ class ContinuousScheduler:
                 self._deadline.pop(rid, None)
             done.append(rid)
         return out, done
+
+    def _land_watchdog(self) -> Tuple[Dict[int, np.ndarray],
+                                      List[Tuple[int, object]]]:
+        """Land the in-flight tick, watchdogged: under overlap the
+        DISPATCH cannot hang (it queues and returns) — the blocking
+        readback can, so the hang deadline moves to the landed-tick
+        boundary."""
+        if self.slots._inflight is None:
+            return {}, []
+        if self.watchdog_s is not None:
+            from triton_dist_tpu.runtime.stress import watchdog
+            try:
+                return watchdog(self.slots.land, self.watchdog_s,
+                                label="scheduler land (overlap)")
+            except Exception as e:
+                from triton_dist_tpu.runtime.stress import HangError
+                if isinstance(e, HangError):
+                    self._hang = str(e)
+                raise
+        return self.slots.land()
+
+    def _poll_overlap(self) -> Tuple[Dict[object, np.ndarray],
+                                     List[object]]:
+        """Pipeline-aware poll (overlap=True — module docstring).
+
+        Non-spec: this poll's bookkeeping (deadlines, admissions) runs
+        FIRST, while tick N-1 — dispatched at the end of the previous
+        poll — is still computing; only then does the one blocking
+        readback land it. Tick N dispatches immediately after, and the
+        retire work for N-1's finishers runs under it. Between polls
+        the in-flight tick also covers the serving layer's socket
+        writes and stats reads.
+
+        spec=K: drafting needs the LANDED history, so the pipeline
+        cannot cross the poll boundary. Instead the verify dispatches
+        first and the deferred work — the PREVIOUS tick's staged
+        retires, deadlines, admissions — runs between dispatch and
+        land (the host work hides under the verify forward)."""
+        slots = self.slots
+        out_acc: Dict[object, np.ndarray] = self._carry_out
+        done: List[object] = self._carry_done
+        self._carry_out, self._carry_done = {}, []
+        pf_before = slots.prefill_forwarded
+        if slots.spec:
+            skip = frozenset(b for b, _ in self._staged)
+            if any(b not in skip for b in slots.occupied):
+                if slots.prefill_slots:
+                    slots.begin_mixed(self.prefill_budget, skip=skip)
+                else:
+                    slots.begin_chunk(self.chunk, skip=skip)
+                self._mark_dispatch()
+            else:
+                self._last_mark = None  # idle tick: no dispatch stamp
+            # deferred bookkeeping — overlapped with the verify: the
+            # previous tick's retires (tree inserts + page releases),
+            # deadline expiry, admissions (one-tick slot-free delay)
+            for b, rid in self._staged:
+                if slots.rids[b] == rid:
+                    slots.retire(b)
+            self._staged = []
+            with self._lock:
+                self._expire_overlap(out_acc, done)
+                self._admit(done, out_acc)
+            out, finished = self._land_watchdog()
+            rid_of = slots.rids
+            for b, t in out.items():
+                _merge_out(out_acc, rid_of[b], t)
+            with self._lock:
+                for b, rid in finished:
+                    self._deadline.pop(rid, None)
+                    done.append(rid)
+            self._staged.extend(finished)
+        else:
+            with self._lock:
+                self._expire_overlap(out_acc, done)
+                self._admit(done, out_acc)
+            out, finished = self._land_watchdog()
+            rid_of = slots.rids
+            for b, t in out.items():
+                _merge_out(out_acc, rid_of[b], t)
+            # dispatch tick N before retiring N-1's finishers: the
+            # device starts immediately and the retire bookkeeping
+            # (radix-tree inserts, page releases) hides under it
+            skip = frozenset(b for b, _ in finished)
+            if any(b not in skip for b in slots.occupied):
+                if slots.prefill_slots:
+                    slots.begin_mixed(self.prefill_budget, skip=skip)
+                else:
+                    slots.begin_chunk(self.chunk, skip=skip)
+                self._mark_dispatch()
+            else:
+                self._last_mark = None  # idle tick: no dispatch stamp
+            for b, rid in finished:
+                if slots.rids[b] == rid:
+                    slots.retire(b)
+                with self._lock:
+                    self._deadline.pop(rid, None)
+                done.append(rid)
+        # drains during the phases above landed into the carry buffers
+        for rid, t in self._carry_out.items():
+            _merge_out(out_acc, rid, t)
+        done.extend(self._carry_done)
+        self._carry_out, self._carry_done = {}, []
+        self.max_prefill_tokens_per_poll = max(
+            self.max_prefill_tokens_per_poll,
+            slots.prefill_forwarded - pf_before)
+        return out_acc, done
 
     def run(self, requests) -> Dict[object, np.ndarray]:
         """Drive a batch of requests to completion (the test/bench

@@ -87,18 +87,33 @@ class NgramDrafter:
         self.window = window
 
     def propose(self, history: Sequence[int], k: int) -> List[int]:
-        h = history if isinstance(history, list) else list(history)
-        if k <= 0 or len(h) < self.min_n + 1:
-            return []
-        base = max(0, len(h) - self.window)
+        # numpy-native: the scheduler hands a ZERO-COPY int32 window
+        # over its per-slot token log (scheduler._TokenLog) — building
+        # a python list of the whole history here would cost O(len)
+        # per draft, O(generated^2) over a stream's life. The windowed
+        # equality below is vectorized per n (O(max_n * window) work,
+        # same bound as the scalar scan) and proposes EXACTLY what the
+        # scalar scan did: the continuation of the most recent prior
+        # occurrence of the longest matching trailing n-gram.
+        h = np.asarray(history)
         L = len(h)
+        if k <= 0 or L < self.min_n + 1:
+            return []
+        base = max(0, L - self.window)
+        win = h[base:]
+        W = len(win)
         for n in range(min(self.max_n, L - base - 1),
                        self.min_n - 1, -1):
-            tail = h[-n:]
-            # scan right-to-left for the most recent PRIOR occurrence
-            for i in range(L - n - 1, base - 1, -1):
-                if h[i:i + n] == tail:
-                    return h[i + n:i + n + k]
+            tail = win[-n:]
+            # candidate starts j = 0 .. W - n - 1 (the tail itself,
+            # at j = W - n, is excluded); hit <=> win[j:j+n] == tail
+            hit = np.ones((W - n,), bool)
+            for o in range(n):
+                hit &= win[o:W - n + o] == tail[o]
+            idx = np.nonzero(hit)[0]
+            if len(idx):
+                j = int(idx[-1])           # most recent occurrence
+                return [int(t) for t in win[j + n:j + n + k]]
         return []
 
 

@@ -137,7 +137,7 @@ class TokenServer:
                  drafter=None, max_queue: Optional[int] = None,
                  watchdog_s: Optional[float] = None, fault=None,
                  prefill_budget: Optional[int] = None,
-                 host_pool_pages: int = 0):
+                 host_pool_pages: int = 0, overlap: bool = False):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -174,7 +174,20 @@ class TokenServer:
         fresh device pages — the effective cache becomes
         num_pages + host_pool_pages. stats() (and each done message's
         "cache" dict) then reports host_hits / host_pages_resident /
-        demotions / promotions / restore_latency_ms live."""
+        demotions / promotions / restore_latency_ms live.
+
+        overlap enables the DISPATCH-AHEAD OVERLAP SCHEDULER
+        (models/scheduler.py module docstring): the driver dispatches
+        the next device tick before reading back the previous one, so
+        this server's per-poll host work — admissions, drafting, the
+        socket writes between polls — runs while the device computes
+        instead of serializing with it. Token streams are bitwise
+        identical either way; the watchdog and deadline checks move to
+        landed-tick boundaries (a dispatch cannot hang — the readback
+        can). The win is visible as stats()["host_ms_per_poll"] (also
+        in every done message): when that approaches the device step
+        time, overlap=True is the difference between host-bound and
+        device-bound serving."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -187,7 +200,7 @@ class TokenServer:
             spec=spec, drafter=drafter, max_queue=max_queue,
             watchdog_s=watchdog_s, fault=fault,
             prefill_budget=prefill_budget,
-            host_pool_pages=host_pool_pages)
+            host_pool_pages=host_pool_pages, overlap=overlap)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -400,8 +413,12 @@ class TokenServer:
                     # over capacity) must not look like a legitimate
                     # zero-token completion
                     msg["error"] = reason
+                st = self.sched.stats()
+                # host time per poll with device wait subtracted — the
+                # overlap scheduler's observable win (the EMA the
+                # operator compares overlap on vs off)
+                msg["host_ms_per_poll"] = st["host_ms_per_poll"]
                 if self.paged:
-                    st = self.sched.stats()
                     msg["cache"] = {
                         k: st[k] for k in ("hit_rate",
                                            "prefill_tokens_skipped",
