@@ -545,10 +545,10 @@ def _bench():
                         gen_len=ov_gen, seed=i)
                 for i in range(ov_n)]
 
-    def ov_run(overlap):
+    def ov_run(overlap, trace=False):
         mk = lambda: ContinuousScheduler(eng_o, batch=ov_batch,
                                          chunk=ov_chunk, paged=True,
-                                         overlap=overlap)
+                                         overlap=overlap, trace=trace)
         mk().run(ov_reqs()[:1])            # warm the programs
         sched = mk()
         for r in ov_reqs():
@@ -595,6 +595,34 @@ def _bench():
         "overlap_off_ms": ov[False][2]["host_ms_per_poll"],
         "device_wait_s_on": ov[True][2]["device_wait_s"],
         "device_wait_s_off": ov[False][2]["device_wait_s"],
+        "requests": ov_n, "slots": ov_batch,
+        "backend": jax.default_backend(),
+    })
+
+    # --- telemetry overhead row (runtime/telemetry.py): the SAME
+    # overlap workload with full tracing ON (registry + request event
+    # rings + poll-timeline spans + device-occupancy stamps) vs the
+    # trace-off run above. Tracing is host-side only and the hot-path
+    # records are O(1)/zero-alloc, so this should be noise — the row
+    # is the regression tripwire that keeps it that way. The traced
+    # run's LIVE latency histograms ride along (ttft/inter-token p99
+    # measured by the registry itself, vs this bench's own stopwatch).
+    # best-of-two per arm: on the CPU smoke single runs vary by >10%
+    # from scheduler-thread interference alone, which would swamp the
+    # signal (real chips pin the device side and shrink the noise)
+    tr1 = ov_run(True, trace=True)
+    tokps_traced = max(tr1[0], ov_run(True, trace=True)[0])
+    st_traced = tr1[2]
+    tokps_off = max(ov[True][0], ov_run(True)[0])
+    overhead = (tokps_off - tokps_traced) / tokps_off * 100.0
+    _emit_json({
+        "metric": "telemetry_overhead_pct",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "tok_per_s_traced": round(tokps_traced / ndev, 2),
+        "tok_per_s_off": round(tokps_off / ndev, 2),
+        "live_ttft_p99_ms": st_traced["ttft_ms"]["p99"],
+        "live_inter_token_p99_ms": st_traced["inter_token_ms"]["p99"],
         "requests": ov_n, "slots": ov_batch,
         "backend": jax.default_backend(),
     })

@@ -62,6 +62,10 @@ def test_overlap_scheduler_example_runs():
     _run_example("15_overlap_scheduler.py")
 
 
+def test_telemetry_example_runs():
+    _run_example("16_telemetry.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

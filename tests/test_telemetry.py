@@ -1,0 +1,416 @@
+"""Serving telemetry (runtime/telemetry.py): histogram math, the
+deep-snapshot thread contract, and the two hard guarantees the
+scheduler integration makes — telemetry-on token streams are BITWISE
+identical to telemetry-off across {greedy, sampled, spec=K} x
+{contiguous, paged+prefix-cache+host-tier, overlap}, and tracing
+compiles ZERO new XLA programs (same churn-guard style as
+test_overlap_no_new_programs).
+
+The TokenServer integration test drives a real socket burst and
+asserts the full surfacing story: live ttft_ms / inter_token_ms
+histograms in stats(), the in-protocol {"op": "stats"} fetch, the
+Prometheus /metrics exposition, and the TDTPU_TRACE dump being
+perfetto-loadable (traceEvents with poll + device spans) and
+summarizable by tools/trace_view.py.
+"""
+
+import json
+import logging
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import (AutoLLM, ContinuousScheduler, Engine,
+                                    Request)
+from triton_dist_tpu.models.config import tiny_qwen3
+from triton_dist_tpu.runtime.telemetry import (Counter, Gauge, Histogram,
+                                               MetricsRegistry, Telemetry,
+                                               prometheus_text)
+
+mesh = None
+_ENGINES = {}
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+def _engine(mode):
+    """One model + engine per sampling mode, shared across tests (the
+    compiled programs are the expensive part of this file)."""
+    if mode not in _ENGINES:
+        cfg = tiny_qwen3(mesh.shape["tp"])
+        model = AutoLLM.from_config(cfg, mesh)
+        ekw = dict(sampling="top_k", temperature=0.8) \
+            if mode == "sampled" else {}
+        _ENGINES[mode] = (cfg, Engine(model, max_seq=64, backend="xla",
+                                      **ekw))
+    return _ENGINES[mode]
+
+
+def _mixed_requests(cfg, shared_prefix=None, seed=0):
+    rng = np.random.RandomState(seed)
+    spec = [(5, 6), (20, 8), (3, 4), (12, 10), (7, 9)]
+    out = []
+    for i, (L, g) in enumerate(spec):
+        ids = rng.randint(0, cfg.vocab_size, size=(L,)).astype(np.int32)
+        if shared_prefix is not None and i % 2:
+            ids = np.concatenate([shared_prefix, ids]).astype(np.int32)
+        out.append(Request(rid=i, ids=ids, gen_len=g, seed=100 + i))
+    return out
+
+
+# ----------------------------------------------------------------------
+# histogram / registry unit tests
+# ----------------------------------------------------------------------
+
+def test_histogram_bucket_boundaries():
+    h = Histogram("h", lo=1.0, hi=16.0, growth=2.0)
+    # edges [1, 2, 4, 8, 16]; counts = [under, 4 buckets, over]
+    np.testing.assert_allclose(h.edges, [1.0, 2.0, 4.0, 8.0, 16.0])
+    assert h.counts.shape == (6,)
+    for v, want in [(0.5, 0), (0.0, 0), (-3.0, 0), (float("nan"), 0),
+                    (1.5, 1), (3.0, 2), (5.0, 3), (15.9, 4),
+                    (16.5, 5), (1e9, 5)]:
+        before = h.counts[want]
+        h.record(v)
+        assert h.counts[want] == before + 1, f"v={v} -> bucket {want}"
+    assert h.n == 10
+    # NaN/negative contribute 0 to the sum, not garbage
+    assert h.total == pytest.approx(0.5 + 1.5 + 3 + 5 + 15.9 + 16.5 + 1e9)
+    # +inf lands in the overflow sink with its sum clamped to the top
+    # edge (one bad sample must not poison the mean)
+    h.record(float("inf"))
+    assert h.counts[5] == 3
+    assert np.isfinite(h.total) and h.snapshot()["sum"] > 0
+
+
+def test_histogram_quantiles_vs_numpy():
+    """Geometric-midpoint quantiles land within sqrt(growth) (~9.3% at
+    the default growth) of the exact numpy sample percentile."""
+    rng = np.random.RandomState(0)
+    samples = rng.lognormal(mean=2.0, sigma=1.2, size=5000)
+    h = Histogram("lat")
+    for v in samples:
+        h.record(v)
+    tol = float(np.sqrt(h.growth)) + 1e-9
+    for q in (50, 95, 99):
+        exact = float(np.percentile(samples, q))
+        got = h.quantile(q / 100.0)
+        assert exact / tol <= got <= exact * tol, \
+            f"p{q}: got {got}, exact {exact}"
+    snap = h.snapshot()
+    assert snap["count"] == 5000
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    assert Histogram("empty").quantile(0.99) == 0.0
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    c = reg.counter("a")
+    assert reg.counter("a") is c
+    c.inc(3)
+    assert reg.snapshot()["a"] == 3
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+
+
+def test_registry_snapshot_is_deep():
+    """Nothing in snapshot() may alias live mutable state: histogram
+    entries are fresh dicts, and mutating the snapshot cannot leak
+    back into the registry."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    h.record(5.0)
+    s1 = reg.snapshot()
+    s1["lat"]["count"] = 999
+    s1["extra"] = 1
+    s2 = reg.snapshot()
+    assert s2["lat"]["count"] == 1 and "extra" not in s2
+    assert s1["lat"] is not s2["lat"]
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(7)
+    reg.gauge("depth").set(2.5)
+    h = reg.histogram("lat_ms", lo=1.0, hi=16.0, growth=2.0)
+    for v in (0.5, 3.0, 100.0):
+        h.record(v)
+    text = prometheus_text(reg)
+    assert "# TYPE tdtpu_reqs counter\ntdtpu_reqs 7" in text
+    assert "tdtpu_depth 2.5" in text
+    # bucket counts are CUMULATIVE and end at +Inf == _count
+    assert 'tdtpu_lat_ms_bucket{le="+Inf"} 3' in text
+    assert "tdtpu_lat_ms_count 3" in text
+    cums = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+            if l.startswith("tdtpu_lat_ms_bucket")]
+    assert cums == sorted(cums)
+
+
+def test_request_lifecycle_derivations():
+    """queued -> emit -> emit -> retire yields one ttft sample, one
+    inter-token sample, one e2e sample; repeat retires no-op; trace-off
+    keeps no event ring."""
+    t = Telemetry()
+    t.queued("r")
+    t.emit("r", 1)
+    t.emit("r", 2)
+    t.retire("r")
+    t.retire("r")                                  # repeat: no-op
+    assert t.h_ttft.n == 1 and t.h_itl.n == 1 and t.h_e2e.n == 1
+    assert t.registry.snapshot()["requests_retired"] == 1
+    assert t.export()["requests"] == {}            # trace off: no ring
+    tt = Telemetry(trace=True)
+    tt.queued("r")
+    tt.req_event("r", "admitted", 0)
+    tt.emit("r", 1)
+    tt.retire("r", "cancelled")
+    (req,) = tt.export()["requests"].values()
+    assert [e[1] for e in req["events"]] == \
+        ["queued", "admitted", "first_token", "cancelled"]
+    assert req["ttft_ms"] is not None
+
+
+# ----------------------------------------------------------------------
+# bitwise differential: telemetry/tracing must never touch the stream
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["contiguous", "paged", "overlap"])
+@pytest.mark.parametrize("mode", ["greedy", "sampled", "spec"])
+def test_streams_bitwise_trace_on_off(mode, kind):
+    cfg, eng = _engine(mode)
+    skw = {}
+    pre = None
+    if kind != "contiguous":
+        rng = np.random.RandomState(7)
+        pre = rng.randint(0, cfg.vocab_size, size=(11,)).astype(np.int32)
+        # paged pool + prefix cache + host tier in the mix
+        skw = dict(paged=True, page=8, host_pool_pages=16)
+    if kind == "overlap":
+        skw["overlap"] = True
+    if mode == "spec":
+        skw["spec"] = 2
+
+    def run(trace):
+        return ContinuousScheduler(eng, batch=3, chunk=4, trace=trace,
+                                   **skw).run(_mixed_requests(cfg, pre))
+
+    ref, got = run(False), run(True)
+    assert set(ref) == set(got)
+    for rid in ref:
+        np.testing.assert_array_equal(
+            got[rid], ref[rid],
+            err_msg=f"{mode}/{kind}: rid={rid} diverged trace-on vs off")
+
+
+def test_trace_no_new_programs():
+    """Jit-cache-churn guard: tracing is host-side only, so a traced
+    mixed refill/chunked-prefill soak must compile ZERO programs the
+    untraced soak did not already compile."""
+    cfg, eng = _engine("greedy")
+
+    def soak(trace):
+        sched = ContinuousScheduler(eng, batch=3, chunk=4, paged=True,
+                                    page=8, prefill_budget=3,
+                                    overlap=True, trace=trace)
+        return sched.run(_mixed_requests(cfg, seed=4)), sched
+
+    class _CompileCounter(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.names = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                self.names.append(msg.split()[1])
+
+    counter = _CompileCounter()
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    logger.addHandler(counter)
+    prev = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    try:
+        ref, _ = soak(trace=False)       # compiles + warms everything
+        n_off = len(counter.names)
+        got, sched = soak(trace=True)
+        new = counter.names[n_off:]
+        assert not new, (f"tracing compiled {len(new)} program(s) the "
+                         f"untraced loop never needed: {new}")
+    finally:
+        jax.config.update("jax_log_compiles", prev)
+        logger.removeHandler(counter)
+    for rid in ref:
+        np.testing.assert_array_equal(got[rid], ref[rid])
+    # the traced run produced a loadable timeline with both tracks
+    exp = sched.tele.export()
+    names = {e.get("name", "") for e in exp["traceEvents"]}
+    assert "poll" in names
+    assert any(n.startswith("device:") for n in names)
+
+
+def test_scheduler_stats_has_live_histograms():
+    cfg, eng = _engine("greedy")
+    sched = ContinuousScheduler(eng, batch=2, chunk=4)
+    sched.run(_mixed_requests(cfg)[:3])
+    st = sched.stats()
+    for key in ("ttft_ms", "inter_token_ms", "poll_ms",
+                "request_latency_ms"):
+        assert st[key]["count"] > 0, key
+        assert st[key]["p50"] <= st[key]["p95"] <= st[key]["p99"]
+    assert st["ttft_ms"]["count"] == 3       # one sample per stream
+    assert st["requests_retired"] == 3
+    json.dumps(st)                           # fully serializable
+
+
+# ----------------------------------------------------------------------
+# the deep-snapshot thread contract (satellite: the old shallow
+# dict(sched.stats()) race)
+# ----------------------------------------------------------------------
+
+def test_stats_cross_thread_hammer():
+    """stats() from a foreign thread while the driver polls: every
+    snapshot must serialize cleanly (no dict-resize races, no aliasing
+    of scheduler-side mutable state) and counters must be monotonic."""
+    cfg, eng = _engine("greedy")
+    sched = ContinuousScheduler(eng, batch=3, chunk=4, paged=True,
+                                page=8, host_pool_pages=16)
+    reqs = _mixed_requests(cfg, seed=2)
+    errors = []
+    stop = threading.Event()
+
+    def hammer():
+        last_retired = 0
+        while not stop.is_set():
+            try:
+                st = sched.stats()
+                json.dumps(st)
+                assert st["requests_retired"] >= last_retired
+                last_retired = st["requests_retired"]
+            except Exception as e:          # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        got = sched.run(reqs)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, f"stats() raced the driver: {errors[0]!r}"
+    assert len(got) == len(reqs)
+    st = sched.stats()
+    assert st["requests_retired"] == len(reqs)
+
+
+# ----------------------------------------------------------------------
+# TokenServer surfacing: live histograms, {"op": "stats"}, /metrics,
+# and the TDTPU_TRACE dump (the acceptance-criteria integration run)
+# ----------------------------------------------------------------------
+
+def test_token_server_telemetry_surfacing(tmp_path, monkeypatch):
+    from triton_dist_tpu.serving import ByteTokenizer, TokenServer, \
+        request_stream
+
+    trace_path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("TDTPU_TRACE", trace_path)
+
+    cfg, eng = _engine("greedy")
+    tok = ByteTokenizer(cfg.vocab_size)
+    srv = TokenServer(eng, tok, batch=4, chunk=4, paged=True, page=8,
+                      overlap=True, metrics_port=0)
+    assert srv.metrics_port
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    prompts = ["alpha prompt", "second one!", "and a third"]
+    results = {}
+
+    def client(i):
+        toks = []
+        for msg in request_stream("127.0.0.1", srv.port, prompts[i],
+                                  gen_len=12):
+            if msg.get("done"):
+                break
+            toks.extend(msg["token_ids"])
+        results[i] = toks
+
+    cts = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    for t in cts:
+        t.start()
+    for t in cts:
+        t.join(timeout=600)
+    assert all(len(results[i]) == 12 for i in range(3))
+
+    # live histograms through the server's stats()
+    st = srv.stats()
+    assert st["ttft_ms"]["count"] == 3
+    assert st["inter_token_ms"]["count"] > 0
+    assert st["ttft_ms"]["p50"] <= st["ttft_ms"]["p99"]
+
+    # in-protocol {"op": "stats"}: one JSON reply line, then close
+    with socket.create_connection(("127.0.0.1", srv.port),
+                                  timeout=30) as s:
+        f = s.makefile("rw", encoding="utf-8", newline="\n")
+        f.write(json.dumps({"op": "stats"}) + "\n")
+        f.flush()
+        reply = json.loads(f.readline())
+    assert reply["done"] is True
+    assert reply["stats"]["ttft_ms"]["count"] == 3
+    assert reply["stats"]["requests_retired"] == 3
+
+    # Prometheus text exposition over the metrics listener
+    with socket.create_connection(("127.0.0.1", srv.metrics_port),
+                                  timeout=30) as s:
+        s.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+        raw = b""
+        while True:
+            b_ = s.recv(65536)
+            if not b_:
+                break
+            raw += b_
+    head, body = raw.split(b"\r\n\r\n", 1)
+    assert b"200 OK" in head and b"version=0.0.4" in head
+    text = body.decode()
+    assert 'tdtpu_ttft_ms_bucket{le="+Inf"} 3' in text
+    assert "tdtpu_requests_retired 3" in text
+    # the process-global registry rides along (Engine dispatch mix)
+    assert "tdtpu_engine_prefill_dispatches" in text
+
+    srv.stop()
+    th.join(timeout=60)
+
+    # TDTPU_TRACE contract: perfetto-loadable dump on exit
+    with open(trace_path) as fh:
+        dump = json.load(fh)
+    names = [e.get("name", "") for e in dump["traceEvents"]]
+    assert "poll" in names, "no poll spans in the timeline"
+    assert any(n.startswith("device:") for n in names), \
+        "no device-occupancy spans"
+    assert any(e.get("ph") == "M" for e in dump["traceEvents"])
+    assert len(dump["requests"]) == 3
+    for req in dump["requests"].values():
+        kinds = [e[1] for e in req["events"]]
+        assert kinds[0] == "queued" and "first_token" in kinds \
+            and kinds[-1] == "retired"
+        assert req["ttft_ms"] is not None
+    assert dump["metrics"]["ttft_ms"]["count"] == 3
+
+    # ... and tools/trace_view.py can summarize it
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "trace_view.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    text = tv.summarize(dump, top_k=3)
+    assert "poll" in text and "ttft" in text.lower()

@@ -121,6 +121,57 @@ def test_perf_models_sanity():
     assert "80.0" in line and "%" in line
 
 
+def test_trace_view_cli(tmp_path):
+    """tools/trace_view.py (repo-root CLI, stdlib-only): summarizes a
+    TDTPU_TRACE dump — per-phase time shares, top-k slowest polls, the
+    per-request TTFT table and the embedded histogram snapshot."""
+    import subprocess
+    import sys
+
+    dump = {
+        "traceEvents": [
+            {"ph": "M", "pid": 0, "tid": 0, "name": "thread_name",
+             "args": {"name": "host phases"}},
+            {"name": "poll", "ph": "X", "pid": 0, "tid": 0, "ts": 0,
+             "dur": 1000, "args": {"seq": 1}},
+            {"name": "poll", "ph": "X", "pid": 0, "tid": 0, "ts": 1500,
+             "dur": 3000, "args": {"seq": 2}},
+            {"name": "bookkeep", "ph": "X", "pid": 0, "tid": 0,
+             "ts": 10, "dur": 200},
+            {"name": "dispatch", "ph": "X", "pid": 0, "tid": 0,
+             "ts": 300, "dur": 500},
+            {"name": "device:chunk", "ph": "X", "pid": 0, "tid": 1,
+             "ts": 320, "dur": 2400},
+            {"name": "preempt", "ph": "i", "s": "p", "pid": 0,
+             "tid": 0, "ts": 900},
+        ],
+        "requests": {
+            "0": {"status": "retired", "tokens": 12, "ttft_ms": 4.2,
+                  "events": [[0.0, "queued", None]]},
+            "1": {"status": "cancelled", "tokens": 3, "ttft_ms": None,
+                  "events": [[0.1, "queued", None]]},
+        },
+        "metrics": {"ttft_ms": {"count": 2, "sum": 8.4, "mean": 4.2,
+                                "p50": 4.2, "p95": 4.3, "p99": 4.3}},
+    }
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(dump))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_view.py"),
+         str(path), "--top", "1"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    text = out.stdout
+    assert "polls: 2" in text
+    assert "bookkeep" in text and "dispatch" in text
+    assert "device occupancy" in text
+    assert "poll #2" in text and "poll #1" not in text   # --top 1
+    assert "preempt=1" in text
+    assert "retired" in text and "cancelled" in text
+    assert "ttft_ms: n=2" in text
+
+
 def test_kernel_context_tune_cold_and_warm(cache_path, monkeypatch):
     """The wired path (VERDICT r2 #7): create_ag_gemm_context(tune=True)
     cold-tunes over the block space and caches; a second creation with

@@ -6,8 +6,14 @@
 # log) so a regression is one glance, not two terminal scrollbacks.
 # Run from the repo root: bash tools/tier1.sh
 set -o pipefail
-rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+rm -f /tmp/_t1.log /tmp/_t1.trace.json
+# TDTPU_TRACE: poll-loop tracing ON for every serving test (telemetry
+# is stream-exact by contract, so this doubles as a suite-wide
+# integration check); the last TokenServer to exit leaves its
+# perfetto-loadable timeline next to this log — inspect with
+# python tools/trace_view.py /tmp/_t1.trace.json
+timeout -k 10 870 env JAX_PLATFORMS=cpu TDTPU_TRACE=/tmp/_t1.trace.json \
+    python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly --durations=20 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -22,4 +28,7 @@ else
     echo "DOTS_PASSED=$passed"
 fi
 echo "$passed" > "$last_file"
+if [ -s /tmp/_t1.trace.json ]; then
+    echo "TRACE_ARTIFACT=/tmp/_t1.trace.json ($(wc -c < /tmp/_t1.trace.json) bytes; summarize: python tools/trace_view.py /tmp/_t1.trace.json)"
+fi
 exit $rc

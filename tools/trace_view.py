@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Summarize a TDTPU_TRACE dump without perfetto.
+
+The serving stack (runtime/telemetry.py) dumps Chrome trace-event JSON
+on exit — perfetto-loadable, but a terminal answer is often enough.
+This CLI reads one dump and prints:
+
+- per-phase HOST time shares (bookkeep/dispatch/land/retire/drafter/
+  step as a fraction of total poll time) and total DEVICE occupancy,
+- the top-k slowest polls (seq + duration — the stalls worth opening
+  perfetto for),
+- a per-request table (status, tokens, ttft_ms) plus the ttft_ms /
+  inter_token_ms histogram summary from the embedded metrics snapshot.
+
+Usage: python tools/trace_view.py /path/to/trace.json [--top 5]
+No dependencies beyond the stdlib; importable (`summarize(dump)`) so
+tests and notebooks can reuse the formatting.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _fmt_ms(us: float) -> str:
+    return f"{us / 1e3:8.3f}ms"
+
+
+def summarize(dump: dict, top_k: int = 5) -> str:
+    """Render one dumped trace (the dict form of the JSON file) as a
+    terminal report. Pure function: no I/O, returns the text."""
+    events = dump.get("traceEvents", [])
+    spans = [e for e in events if e.get("ph") == "X"]
+    polls = [e for e in spans if e.get("name") == "poll"]
+    host = [e for e in spans
+            if e.get("tid") == 0 and e.get("name") != "poll"]
+    device = [e for e in spans if e.get("tid") == 1]
+    instants = [e for e in events if e.get("ph") == "i"]
+
+    out = []
+    poll_total = sum(e["dur"] for e in polls)
+    out.append(f"polls: {len(polls)}  total {poll_total / 1e3:.3f}ms  "
+               f"instants: {len(instants)}")
+
+    # --- per-phase host time shares (vs total poll time)
+    if polls:
+        by_phase = {}
+        for e in host:
+            by_phase.setdefault(e["name"], [0.0, 0])
+            by_phase[e["name"]][0] += e["dur"]
+            by_phase[e["name"]][1] += 1
+        out.append("host phases (share of poll time):")
+        for name, (dur, n) in sorted(by_phase.items(),
+                                     key=lambda kv: -kv[1][0]):
+            share = dur / poll_total if poll_total else 0.0
+            out.append(f"  {name:<12s} {dur / 1e3:9.3f}ms "
+                       f"{share:6.1%}  (n={n})")
+        dev_total = sum(e["dur"] for e in device)
+        out.append(f"device occupancy: {dev_total / 1e3:.3f}ms over "
+                   f"{len(device)} dispatches "
+                   f"({dev_total / poll_total if poll_total else 0.0:.1%} "
+                   f"of poll time)")
+
+    # --- top-k slowest polls
+    if polls:
+        out.append(f"top {min(top_k, len(polls))} slowest polls:")
+        ranked = sorted(polls, key=lambda e: -e["dur"])[:top_k]
+        for e in ranked:
+            seq = e.get("args", {}).get("seq", "?")
+            out.append(f"  poll #{seq:<6} {_fmt_ms(e['dur'])}  "
+                       f"at {e['ts'] / 1e3:.3f}ms")
+
+    # --- instants (watchdog fires, preemptions, drains, kv moves)
+    if instants:
+        kinds = {}
+        for e in instants:
+            kinds[e["name"]] = kinds.get(e["name"], 0) + 1
+        out.append("instants: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(kinds.items())))
+
+    # --- per-request TTFT table
+    reqs = dump.get("requests", {})
+    if reqs:
+        out.append(f"requests ({len(reqs)}):")
+        out.append(f"  {'rid':<12s} {'status':<10s} {'tokens':>6s} "
+                   f"{'ttft_ms':>9s}")
+        for rid, r in sorted(reqs.items()):
+            ttft = r.get("ttft_ms")
+            out.append(f"  {rid:<12.12s} {r.get('status', '?'):<10s} "
+                       f"{r.get('tokens', 0):>6d} "
+                       f"{'-' if ttft is None else format(ttft, '9.3f')}")
+
+    # --- latency histograms from the embedded metrics snapshot
+    metrics = dump.get("metrics", {})
+    for key in ("ttft_ms", "inter_token_ms", "poll_ms"):
+        m = metrics.get(key)
+        if isinstance(m, dict) and m.get("count"):
+            out.append(f"{key}: n={m['count']} p50={m['p50']} "
+                       f"p95={m['p95']} p99={m['p99']}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="TDTPU_TRACE dump (JSON)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many slowest polls to list")
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        dump = json.load(f)
+    print(summarize(dump, top_k=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

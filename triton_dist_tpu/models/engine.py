@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.runtime.telemetry import default_registry
 
 
 class Engine:
@@ -70,6 +71,21 @@ class Engine:
         self.sampling = sampling
         self._sample_params = dict(temperature=temperature, k=top_k,
                                    p=top_p)
+        # process-global dispatch counters (runtime/telemetry.py): the
+        # device-program mix every scheduler on this engine drives —
+        # prefills vs decode vs verify vs mixed ticks — surfaced by
+        # the TokenServer's /metrics listener next to each scheduler's
+        # own registry. Cached Counter handles: inc() on the dispatch
+        # path is one int add, no registry lock.
+        _reg = default_registry()
+        self._c_prefills = _reg.counter(
+            "engine_prefill_dispatches", "prefill/admit forwards")
+        self._c_decode = _reg.counter(
+            "engine_decode_dispatches", "slot-scan decode chunks")
+        self._c_verify = _reg.counter(
+            "engine_verify_dispatches", "spec verify forwards")
+        self._c_mixed = _reg.counter(
+            "engine_mixed_dispatches", "mixed prefill+decode ticks")
         # int8-quantized models run on EVERY backend: the comm-kernel
         # GEMMs (ag_gemm/gemm_rs/gemm_allreduce) stream int8 weight
         # panels and dequant per column after the dot (exact), so the
@@ -282,6 +298,7 @@ class Engine:
         if n > self.max_seq:
             raise ValueError(
                 f"prompt length {n} exceeds slot capacity {self.max_seq}")
+        self._c_prefills.inc()
         # the pad bucket must never write past the cache capacity
         # (max_seq need not be a pad_to multiple)
         P = min(-(-n // pad_to) * pad_to, self.max_seq)
@@ -317,6 +334,7 @@ class Engine:
         if self.backend == "mega":
             raise ValueError("backend='mega' carries no resumable "
                              "slot state; use the per-op backends")
+        self._c_decode.inc()
         if self.sampling == "greedy":
             assert keys is None
             toks, logits, cache, pos = self._slot_scan(
@@ -358,6 +376,7 @@ class Engine:
                              "state; use the per-op backends")
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
+        self._c_verify.inc()
         if self.sampling == "greedy":
             assert keys is None
             n_emit, t0n, cache, pos = self._slot_verify(
@@ -377,6 +396,7 @@ class Engine:
         """
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
+        self._c_verify.inc()
         if self.sampling == "greedy":
             assert keys is None
             n_emit, t0n, pcache, pos = self._paged_slot_verify(
@@ -424,6 +444,7 @@ class Engine:
         prefilling = jnp.asarray(prefilling, bool)
         if self.sampling == "greedy":
             assert keys is None
+        self._c_mixed.inc()
         return self._slot_mixed(self.model, logits, cache, pos, active,
                                 prefilling, tokens, q_lens, keys)
 
@@ -438,6 +459,7 @@ class Engine:
         prefilling = jnp.asarray(prefilling, bool)
         if self.sampling == "greedy":
             assert keys is None
+        self._c_mixed.inc()
         return self._paged_slot_mixed(self.model, logits, pcache, pos,
                                       active, prefilling, tokens, q_lens,
                                       keys)
@@ -460,6 +482,7 @@ class Engine:
         prefilling = jnp.asarray(prefilling, bool)
         if self.sampling == "greedy":
             assert keys is None
+        self._c_mixed.inc()
         return self._slot_mixed_verify(self.model, cache, pos, active,
                                        prefilling, tokens, q_lens, keys)
 
@@ -472,6 +495,7 @@ class Engine:
         prefilling = jnp.asarray(prefilling, bool)
         if self.sampling == "greedy":
             assert keys is None
+        self._c_mixed.inc()
         return self._paged_slot_mixed_verify(self.model, pcache, pos,
                                              active, prefilling, tokens,
                                              q_lens, keys)
@@ -572,6 +596,7 @@ class Engine:
             # keeps the bucketed DUS in range at every kv_start
             self._paged_scratch = self.model.make_cache(
                 1, T_pool + pad_to, dtype=self.kv_dtype)
+        self._c_prefills.inc()
         logits, self._paged_scratch, pcache = self._paged_admit(
             self.model, padded, self._paged_scratch, pcache,
             jnp.asarray(rows, jnp.int32), jnp.int32(slot),
@@ -586,6 +611,7 @@ class Engine:
         row's KV scatter resolves through the page table (a retired
         row's table maps the trash page, so its masked-out writes can
         never touch a live or cached page)."""
+        self._c_decode.inc()
         if self.sampling == "greedy":
             assert keys is None
             toks, logits, pcache, pos = self._paged_slot_scan(

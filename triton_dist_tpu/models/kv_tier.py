@@ -30,6 +30,15 @@ releases device refs like a drop did), and the host invariant
 ``pages_resident == sum(entry pages) <= capacity`` holds after any
 sequence of demotions, promotions, drops, and injected faults
 (runtime/chaos.py::FaultInjector.host_demotion).
+
+Telemetry (runtime/telemetry.py): the counters below stay plain ints
+because ``pages_resident``/``room`` gate the demote/promote logic and
+the invariant checks compare them directly; ``PrefixCache.stats()``
+publishes `stats()`'s key set into the owning scheduler's metrics
+registry as gauges on every snapshot (so `/metrics` and the stats()
+registry cut carry ``host_pages_resident`` / ``host_puts`` /
+``host_pops`` / ``host_drops_pool`` live), and demote/promote/drop
+fire timeline instants on the tree's telemetry hook when tracing.
 """
 
 from __future__ import annotations

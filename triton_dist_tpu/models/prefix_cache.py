@@ -197,9 +197,13 @@ class RadixPrefixTree:
     refcounts (callers retain what they map)."""
 
     def __init__(self, pool: RefcountedPages, page: int, *,
-                 host_pool=None, fault=None):
+                 host_pool=None, fault=None, telemetry=None):
         self.pool = pool
         self.page = page
+        # optional runtime/telemetry.py bundle: demote/promote/drop
+        # show up as timeline instants when tracing is on (trace-off
+        # is a guarded no-op inside Telemetry.instant)
+        self.tele = telemetry
         self.root = _Node(None, 0, np.zeros((0,), np.int32), [])
         self._tick = 0
         self.evictions = 0
@@ -393,9 +397,13 @@ class RadixPrefixTree:
             parent = nd.parent
             if self._try_demote(nd):
                 self.demotions += 1
+                if self.tele is not None:
+                    self.tele.instant("kv_demote")
             else:
                 self._drop_node(nd)
                 self.evictions += 1
+                if self.tele is not None:
+                    self.tele.instant("kv_evict")
             blockers[id(parent)] -= 1
             if parent is not self.root and parent.groups \
                     and blockers[id(parent)] == 0:
@@ -551,6 +559,8 @@ class RadixPrefixTree:
         nd.host = None
         nd.groups = groups
         self.promotions += 1
+        if self.tele is not None:
+            self.tele.instant("kv_promote")
         self._touch(nd)
         return True
 
@@ -576,7 +586,7 @@ class PrefixCache:
 
     def __init__(self, num_pages: int, n_kv_heads: int, page: int, *,
                  enabled: bool = True, host_pool_pages: int = 0,
-                 fault=None):
+                 fault=None, telemetry=None):
         """host_pool_pages > 0 attaches the host-RAM capacity tier
         (models/kv_tier.py): eviction demotes spans to a host pool of
         that many (device-page-sized) buffers instead of dropping, and
@@ -584,21 +594,39 @@ class PrefixCache:
         must also wire the device copy callbacks (attach_host_tier) —
         until then demotion stays disabled and eviction drops as
         before. fault: chaos hook (runtime/chaos.py::FaultInjector)
-        whose host_demotion() can force the true-drop path."""
+        whose host_demotion() can force the true-drop path.
+
+        telemetry (runtime/telemetry.py): the hit/skip counters below
+        live in its metrics registry — PagedDecodeSlots passes the
+        scheduler's bundle so one stats() registry snapshot covers
+        the cache; a bare PrefixCache gets a private registry."""
+        from triton_dist_tpu.runtime.telemetry import Telemetry
         self.pool = RefcountedPages(num_pages, n_kv_heads)
         self.page = page
         self.enabled = enabled
+        self.tele = telemetry if telemetry is not None else Telemetry()
         self.host = HostKVPool(host_pool_pages) if host_pool_pages \
             else None
         self.tree = RadixPrefixTree(self.pool, page,
-                                    host_pool=self.host, fault=fault)
-        self.admissions = 0
-        self.hits = 0
-        self.host_hits = 0
-        self.restore_latency_ms = 0.0   # EMA over promoting lookups
-        self.prompt_tokens = 0
-        self.prefill_tokens_skipped = 0
-        self.tokens_inserted = 0
+                                    host_pool=self.host, fault=fault,
+                                    telemetry=self.tele)
+        reg = self.tele.registry
+        self.admissions = reg.counter(
+            "admissions", "successful paged admissions")
+        self.hits = reg.counter(
+            "hits", "admissions with a non-empty prefix match")
+        self.host_hits = reg.counter(
+            "host_hits", "lookups that promoted host-resident spans")
+        self._g_restore = reg.gauge(
+            "restore_latency_ms", "EMA over promoting lookups' h2d "
+                                  "restore work")
+        self.prompt_tokens = reg.counter(
+            "prompt_tokens", "prompt tokens across admissions")
+        self.prefill_tokens_skipped = reg.counter(
+            "prefill_tokens_skipped", "prompt tokens served from "
+                                      "cached prefixes")
+        self.tokens_inserted = reg.counter(
+            "tokens_inserted", "new tokens donated to the radix tree")
 
     def attach_host_tier(self, extract, restore) -> None:
         """Wire the device-side copy callbacks into the residency
@@ -624,30 +652,30 @@ class PrefixCache:
         if self.host is not None:
             self.tree.restore_ms_accum = 0.0
             if self.tree.promote_path(prompt, cap):
-                self.host_hits += 1
+                self.host_hits.inc()
                 # EMA over the pure restore work (alloc + h2d install)
                 # of this lookup's promotions — victim-demotion time
                 # evict_until spends making room is excluded, so the
                 # gauge reports what its name claims
                 dt = self.tree.restore_ms_accum
-                self.restore_latency_ms = dt \
-                    if self.restore_latency_ms == 0.0 \
-                    else 0.9 * self.restore_latency_ms + 0.1 * dt
+                cur = self._g_restore.value
+                self._g_restore.set(dt if cur == 0.0
+                                    else 0.9 * cur + 0.1 * dt)
         return self.tree.match(prompt, cap=cap)
 
     def record(self, n_prompt: int, n_matched: int) -> None:
         """Count one SUCCESSFUL admission (rejected requests don't
         skew the hit/skip rates)."""
-        self.admissions += 1
-        self.prompt_tokens += n_prompt
-        self.prefill_tokens_skipped += n_matched
-        self.hits += bool(n_matched)
+        self.admissions.inc()
+        self.prompt_tokens.inc(n_prompt)
+        self.prefill_tokens_skipped.inc(n_matched)
+        self.hits.inc(int(bool(n_matched)))
 
     def insert(self, tokens, groups_by_page) -> int:
         if not self.enabled:
             return 0
         new = self.tree.insert(tokens, groups_by_page)
-        self.tokens_inserted += new
+        self.tokens_inserted.inc(new)
         return new
 
     def ensure_pages(self, n_pages: int) -> bool:
@@ -659,16 +687,42 @@ class PrefixCache:
             return False
         return self.tree.evict_until(n_pages)
 
+    @property
+    def restore_latency_ms(self) -> float:
+        """EMA over promoting lookups (registry gauge; the old float
+        attribute's read API, kept for callers)."""
+        return self._g_restore.value
+
     def stats(self) -> dict:
-        total = max(self.prompt_tokens, 1)
+        """Hit/skip counters + structural gauges. The counters live in
+        the telemetry registry; the structural values (pool occupancy,
+        tree/tier counters) are refreshed into registry gauges here so
+        a registry snapshot taken right after (ContinuousScheduler.
+        stats(), the /metrics exposition) is one consistent cut."""
+        reg = self.tele.registry
+        total = max(self.prompt_tokens.value, 1)
+        with reg.lock:
+            reg.gauge("pages_in_use").set(self.pool.pages_in_use)
+            reg.gauge("pages_free").set(self.pool.available)
+            reg.gauge("pages_outstanding").set(self.pool.outstanding)
+            reg.gauge("evictions").set(self.tree.evictions)
+            reg.gauge("demotions").set(self.tree.demotions)
+            reg.gauge("promotions").set(self.tree.promotions)
+            reg.gauge("host_drops").set(self.tree.host_drops)
+            host = (self.host.stats() if self.host is not None
+                    else HostKVPool.empty_stats())
+            for k, v in host.items():
+                reg.gauge(k).set(v)
         out = {
             "enabled": self.enabled,
-            "admissions": self.admissions,
-            "hits": self.hits,
-            "hit_rate": self.hits / max(self.admissions, 1),
-            "prompt_tokens": self.prompt_tokens,
-            "prefill_tokens_skipped": self.prefill_tokens_skipped,
-            "prefill_skip_frac": self.prefill_tokens_skipped / total,
+            "admissions": self.admissions.value,
+            "hits": self.hits.value,
+            "hit_rate": self.hits.value / max(self.admissions.value, 1),
+            "prompt_tokens": self.prompt_tokens.value,
+            "prefill_tokens_skipped":
+                self.prefill_tokens_skipped.value,
+            "prefill_skip_frac":
+                self.prefill_tokens_skipped.value / total,
             "evictions": self.tree.evictions,
             "pages_in_use": self.pool.pages_in_use,
             "pages_free": self.pool.available,
@@ -677,11 +731,11 @@ class PrefixCache:
             # pool's canonical key set) — the operator's live view of
             # demote/promote behaviour
             **HostKVPool.empty_stats(),
-            "host_hits": self.host_hits,
+            "host_hits": self.host_hits.value,
             "demotions": self.tree.demotions,
             "promotions": self.tree.promotions,
             "host_drops": self.tree.host_drops,
-            "restore_latency_ms": round(self.restore_latency_ms, 3),
+            "restore_latency_ms": round(self._g_restore.value, 3),
         }
         # NB the pool defines __len__, so this must test `is not None`
         # (an EMPTY pool is falsy)

@@ -68,7 +68,10 @@ inter-token floor. Mechanics:
 
 - every blocking readback rides ``DecodeSlots._fetch`` and is timed
   into ``device_wait_s``, so ``stats()["host_ms_per_poll"]`` reports
-  dispatch-to-dispatch host time with device wait subtracted; the
+  dispatch-to-dispatch host time with device wait subtracted (the EMA
+  now lives as the ``host_ms_per_poll`` Gauge in the scheduler's
+  metrics registry — runtime/telemetry.py — next to the live
+  ``poll_ms``/``ttft_ms``/``inter_token_ms`` histograms); the
   tick's readback is ONE coalesced ``jax.device_get`` per poll (spec
   arming adds a small per-armed-slot seed fetch on top);
 - the non-spec emission plan is HOST-DETERMINISTIC (each active slot
@@ -116,6 +119,19 @@ PAPERS.md):
   runtime/stress.py::watchdog — a hung chunk surfaces as a clean HANG
   verdict in stats() (and a HangError to the caller) instead of a
   frozen model loop.
+
+Telemetry (runtime/telemetry.py): every counter this module used to
+keep in hand-rolled ints lives in a per-scheduler METRICS REGISTRY,
+so stats() is one deep, single-point-in-time registry snapshot; the
+scheduler additionally records each request's lifecycle
+(queued → admitted → prefill_chunk*N → first_token → tokens →
+preempt/resume → retired/cancelled/expired) — deriving live `ttft_ms`
+and `inter_token_ms` p50/p95/p99 histograms — and, with
+``trace=True`` (or TDTPU_TRACE set), a perfetto-loadable poll-loop
+timeline: host phase spans, device occupancy (dispatch → `_fetch`
+landing), and instants for watchdog fires / preemptions / drains.
+Tracing is host-side only: streams stay BITWISE identical trace-on
+vs trace-off with zero new XLA programs (tests/test_telemetry.py).
 """
 
 from __future__ import annotations
@@ -127,6 +143,9 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from triton_dist_tpu.runtime.telemetry import Telemetry, \
+    trace_env_enabled
 
 
 @dataclasses.dataclass
@@ -260,7 +279,7 @@ class DecodeSlots:
     between chunks."""
 
     def __init__(self, engine, batch: int, *, spec: int = 0,
-                 drafter=None):
+                 drafter=None, telemetry: Optional[Telemetry] = None):
         """spec=K > 0 enables SPECULATIVE DECODING
         (models/spec_decode.py): each step_chunk becomes one
         draft-then-verify iteration — the host `drafter` (default
@@ -275,6 +294,12 @@ class DecodeSlots:
         import jax.numpy as jnp
         self.engine = engine
         self.batch = batch
+        # telemetry bundle (runtime/telemetry.py): the registry the
+        # lifetime counters below live in, plus the trace hooks the
+        # ticks stamp (device occupancy spans, drafter phases). The
+        # owning scheduler passes its own; a bare DecodeSlots gets a
+        # private trace-off instance.
+        self.tele = telemetry if telemetry is not None else Telemetry()
         V = engine.model.config.vocab_size
         self.cache = self._make_cache()
         self.logits = jnp.zeros((batch, V), jnp.float32)
@@ -329,20 +354,26 @@ class DecodeSlots:
             self._t0 = np.zeros((batch,), np.int64)
             # accept counters (stats(): spec_accept_rate /
             # tokens_per_step, surfaced through TokenServer). The
-            # scalars are LIFETIME aggregates (they survive slot
-            # reuse); the per-slot arrays cover the current occupants
-            # only (zeroed at admit).
-            self._spec_steps = 0           # verify forwards run
-            self._spec_slot_steps = 0      # live (slot, forward) pairs
-            self._spec_emitted = 0         # tokens kept (incl. seeds)
-            self._spec_drafted_total = 0
-            self._spec_accepted_total = 0
+            # LIFETIME aggregates (they survive slot reuse) are
+            # registry Counters; the per-slot arrays cover the current
+            # occupants only (zeroed at admit).
+            reg = self.tele.registry
+            self._spec_steps = reg.counter(
+                "spec_steps", "verify forwards run")
+            self._spec_slot_steps = reg.counter(
+                "spec_slot_steps", "live (slot, forward) pairs")
+            self._spec_emitted = reg.counter(
+                "spec_emitted", "tokens kept (incl. seeds)")
+            self._spec_drafted_total = reg.counter(
+                "spec_drafted", "drafter tokens proposed")
+            self._spec_accepted_total = reg.counter(
+                "spec_accepted", "drafter tokens accepted")
             self._spec_drafted = np.zeros((batch,), np.int64)
             self._spec_accepted = np.zeros((batch,), np.int64)
             # a drafter that raises (or proposes garbage) must degrade
             # to plain decode, never take down the model loop — the
             # chaos harness (runtime/chaos.py::FlakyDrafter) pins this
-            self._drafter_errors = 0
+            self._drafter_errors = reg.counter("drafter_errors")
 
     def _make_cache(self):
         """Cache-flavor hook (PagedDecodeSlots swaps in the paged pool)."""
@@ -408,13 +439,13 @@ class DecodeSlots:
             elif self.engine.sampling == "greedy":
                 # arming readbacks ride _fetch so their device wait is
                 # not misattributed as host time (host_ms_per_poll)
-                (row,) = self._fetch((row_logits,))
+                (row,) = self._fetch((row_logits,), land=False)
                 self._t0[slot] = int(np.argmax(row))
             else:
                 t0, k2 = self.engine.spec_seed(row_logits,
                                                self.keys[slot])
                 self.keys = self.keys.at[slot].set(k2)
-                (t0,) = self._fetch((t0,))
+                (t0,) = self._fetch((t0,), land=False)
                 self._t0[slot] = int(t0)
             self._spec_drafted[slot] = 0
             self._spec_accepted[slot] = 0
@@ -511,17 +542,24 @@ class DecodeSlots:
         if self.spec:
             self._hist[slot] = _TokenLog()
 
-    def _fetch(self, arrs: tuple) -> tuple:
+    def _fetch(self, arrs: tuple, *, land: bool = True) -> tuple:
         """The ONE blocking readback of a tick: a single coalesced
         jax.device_get over every array the tick hands back, timed
         into device_wait_s (the scheduler reports host_ms_per_poll =
         dispatch-to-dispatch interval minus this). Shared by the sync
         steps (fetch right after dispatch) and the overlap land (fetch
-        one poll later)."""
+        one poll later). land=False for out-of-band readbacks (the
+        spec arming seed fetches): they must NOT close the device-
+        occupancy span of a tick still in flight — under overlap,
+        admission runs between a verify's dispatch and its land."""
         import jax
         t0 = time.perf_counter()
         out = jax.device_get(arrs)
         self.device_wait_s += time.perf_counter() - t0
+        if land:
+            # close the device-occupancy span stamped at dispatch
+            # (no-op when tracing is off or nothing is pending)
+            self.tele.device_land()
         return out
 
     def _run_chunk(self, chunk: int):
@@ -573,7 +611,7 @@ class DecodeSlots:
                 # a broken drafter degrades to plain decode for
                 # this window (the verify still emits the seed
                 # token) — it must never take down the model loop
-                self._drafter_errors += 1
+                self._drafter_errors.inc()
                 d = []
             finally:
                 h.pop()
@@ -596,12 +634,12 @@ class DecodeSlots:
             self.remaining[b] -= keep
             self._hist[b].extend(kept)
             self._record(b, kept)
-            self._spec_slot_steps += 1
-            self._spec_emitted += keep
+            self._spec_slot_steps.inc()
+            self._spec_emitted.inc(keep)
             self._spec_drafted[b] += int(q_lens[b]) - 1
             self._spec_accepted[b] += keep - 1
-            self._spec_drafted_total += int(q_lens[b]) - 1
-            self._spec_accepted_total += keep - 1
+            self._spec_drafted_total.inc(int(q_lens[b]) - 1)
+            self._spec_accepted_total.inc(keep - 1)
             self._t0[b] = int(t0n[b])
         if self.remaining[b] == 0:
             finished.append((b, self.rids[b]))
@@ -618,11 +656,13 @@ class DecodeSlots:
         S = self.spec + 1
         tokens = np.zeros((self.batch, S), np.int32)
         q_lens = np.ones((self.batch,), np.int32)
-        for b in self.decode_slots:
-            self._draft_into(tokens, q_lens, b)
+        with self.tele.phase("drafter"):
+            for b in self.decode_slots:
+                self._draft_into(tokens, q_lens, b)
+        self.tele.mark_dispatch("verify")
         n_emit, t0n = self._fetch(self._run_verify(tokens, q_lens))
         n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
-        self._spec_steps += 1
+        self._spec_steps.inc()
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
         for b in self.decode_slots:
@@ -640,21 +680,21 @@ class DecodeSlots:
         per-slot counter arrays for the CURRENT occupants."""
         if not self.spec:
             return {}
-        drafted = self._spec_drafted_total
-        accepted = self._spec_accepted_total
+        drafted = self._spec_drafted_total.value
+        accepted = self._spec_accepted_total.value
+        slot_steps = self._spec_slot_steps.value
         return {
             "spec": self.spec,
-            "spec_steps": self._spec_steps,
+            "spec_steps": self._spec_steps.value,
             "spec_drafted": drafted,
             "spec_accepted": accepted,
-            "spec_emitted": self._spec_emitted,
+            "spec_emitted": self._spec_emitted.value,
             "spec_accept_rate": (accepted / drafted) if drafted else 0.0,
-            "tokens_per_step": (self._spec_emitted
-                                / self._spec_slot_steps
-                                if self._spec_slot_steps else 0.0),
+            "tokens_per_step": (self._spec_emitted.value / slot_steps
+                                if slot_steps else 0.0),
             "spec_accepted_per_slot": self._spec_accepted.tolist(),
             "spec_drafted_per_slot": self._spec_drafted.tolist(),
-            "drafter_errors": self._drafter_errors,
+            "drafter_errors": self._drafter_errors.value,
         }
 
     def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
@@ -669,6 +709,7 @@ class DecodeSlots:
         emits 1..K+1 tokens per call (seed + accepted drafts)."""
         if self.spec:
             return self._step_spec()
+        self.tele.mark_dispatch("chunk")
         (toks,) = self._fetch((self._run_chunk(chunk),))
         toks = np.asarray(toks)
         plan, finished = self._plan_chunk(chunk)
@@ -756,16 +797,19 @@ class DecodeSlots:
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
         if self.spec:
-            for b in decode:
-                self._draft_into(tokens, q_lens, b)
+            with self.tele.phase("drafter"):
+                for b in decode:
+                    self._draft_into(tokens, q_lens, b)
+            self.tele.mark_dispatch("mixed_verify")
             n_emit, t0n = self._fetch(
                 self._run_mixed_verify(tokens, q_lens, pf))
             n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
-            self._spec_steps += 1
+            self._spec_steps.inc()
             for b in decode:
                 self._account_spec(b, tokens, q_lens, n_emit, t0n, out,
                                    finished)
         else:
+            self.tele.mark_dispatch("mixed")
             (toks,) = self._fetch((self._run_mixed(tokens, q_lens, pf),))
             toks = np.asarray(toks)
             plan, finished = self._plan_mixed_decode(decode)
@@ -828,6 +872,7 @@ class DecodeSlots:
             off = int(self._pf_off[b])
             self._pf_record(b, ids[off:off + c])
             self._pf_off[b] = off + c
+            self.tele.req_event(self.rids[b], "prefill_chunk", c)
             if self._pf_off[b] == len(ids):
                 req = self.reqs[b]
                 self._pf_ids[b] = None
@@ -863,6 +908,7 @@ class DecodeSlots:
         if self.spec:
             self.begin_spec(skip)
             return
+        self.tele.mark_dispatch("chunk")
         toks_dev = self._run_chunk(chunk)
         plan, finishing = self._plan_chunk(chunk, skip)
         for b, _ in finishing:
@@ -882,13 +928,15 @@ class DecodeSlots:
         tokens = np.zeros((self.batch, S), np.int32)
         q_lens = np.ones((self.batch,), np.int32)
         plan = []
-        for b in self.decode_slots:
-            if b in skip:
-                continue
-            self._draft_into(tokens, q_lens, b)
-            plan.append((b, self.rids[b]))
+        with self.tele.phase("drafter"):
+            for b in self.decode_slots:
+                if b in skip:
+                    continue
+                self._draft_into(tokens, q_lens, b)
+                plan.append((b, self.rids[b]))
+        self.tele.mark_dispatch("verify")
         arrs = self._run_verify(tokens, q_lens)
-        self._spec_steps += 1
+        self._spec_steps.inc()
         self._inflight = _InFlight("spec", arrs, plan, [],
                                    tokens=tokens, q_lens=q_lens)
 
@@ -903,14 +951,17 @@ class DecodeSlots:
         tokens, q_lens, pf, chunks = self._build_mixed_window(budget)
         decode = [b for b in self.decode_slots if b not in skip]
         if self.spec:
-            for b in decode:
-                self._draft_into(tokens, q_lens, b)
+            with self.tele.phase("drafter"):
+                for b in decode:
+                    self._draft_into(tokens, q_lens, b)
+            self.tele.mark_dispatch("mixed_verify")
             arrs = self._run_mixed_verify(tokens, q_lens, pf)
-            self._spec_steps += 1
+            self._spec_steps.inc()
             inf = _InFlight("mixed_spec", arrs,
                             [(b, self.rids[b]) for b in decode], [],
                             tokens=tokens, q_lens=q_lens)
         else:
+            self.tele.mark_dispatch("mixed")
             toks_dev = self._run_mixed(tokens, q_lens, pf)
             plan, finishing = self._plan_mixed_decode(decode)
             for b, _ in finishing:
@@ -985,7 +1036,8 @@ class PagedDecodeSlots(DecodeSlots):
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True, margin: int = 4,
                  spec: int = 0, drafter=None,
-                 host_pool_pages: int = 0, fault=None):
+                 host_pool_pages: int = 0, fault=None,
+                 telemetry: Optional[Telemetry] = None):
         """host_pool_pages > 0 attaches the HOST-RAM KV TIER
         (models/kv_tier.py): LRU eviction demotes unreferenced spans
         to a host pool of that many device-page-sized buffers (d2h
@@ -1001,12 +1053,15 @@ class PagedDecodeSlots(DecodeSlots):
         self.page = page
         self.margin = margin
         self._num_pages = num_pages
-        super().__init__(engine, batch, spec=spec, drafter=drafter)
+        super().__init__(engine, batch, spec=spec, drafter=drafter,
+                         telemetry=telemetry)
         Hkv = engine.model.config.num_kv_heads
+        # the prefix cache publishes its counters into the SAME
+        # registry, so the scheduler's stats() snapshot covers it
         self.prefix = PrefixCache(self.cache.num_pages, Hkv, page,
                                   enabled=prefix_cache,
                                   host_pool_pages=host_pool_pages,
-                                  fault=fault)
+                                  fault=fault, telemetry=self.tele)
         if host_pool_pages:
             self.prefix.attach_host_tier(self._tier_extract,
                                          self._tier_restore)
@@ -1303,7 +1358,9 @@ class ContinuousScheduler:
                  watchdog_s: Optional[float] = None,
                  preempt: bool = True, fault=None,
                  prefill_budget: Optional[int] = None,
-                 host_pool_pages: int = 0, overlap: bool = False):
+                 host_pool_pages: int = 0, overlap: bool = False,
+                 telemetry: Optional[Telemetry] = None,
+                 trace: Optional[bool] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -1369,19 +1426,38 @@ class ContinuousScheduler:
         just arrive one poll later at stream start, and a freed slot
         re-admits one tick later. Watch stats()["host_ms_per_poll"]:
         when it approaches the device step time, overlap=True is the
-        difference between host-bound and device-bound serving."""
+        difference between host-bound and device-bound serving.
+
+        telemetry/trace (runtime/telemetry.py — module docstring):
+        every scheduler owns a Telemetry bundle; its registry holds
+        the counters stats() snapshots and the live `ttft_ms` /
+        `inter_token_ms` / `poll_ms` histograms. trace=True
+        additionally records per-request event rings and the
+        perfetto-loadable poll-loop timeline (host phases + device
+        occupancy); the default is the TDTPU_TRACE env convention.
+        Tracing is host-side only — streams stay bitwise identical
+        and no new XLA program compiles (tests/test_telemetry.py).
+        Pass `telemetry` to share or pre-configure the bundle."""
         if prefill_budget is not None and prefill_budget < 1:
             raise ValueError(f"prefill_budget must be >= 1, got "
                              f"{prefill_budget}")
+        if telemetry is not None:
+            self.tele = telemetry
+        else:
+            if trace is None:
+                trace = trace_env_enabled()
+            self.tele = Telemetry(trace=trace)
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
                 prefix_cache=prefix_cache, margin=chunk,
                 spec=spec, drafter=drafter,
-                host_pool_pages=host_pool_pages, fault=fault)
+                host_pool_pages=host_pool_pages, fault=fault,
+                telemetry=self.tele)
         else:
             self.slots = DecodeSlots(engine, batch, spec=spec,
-                                     drafter=drafter)
+                                     drafter=drafter,
+                                     telemetry=self.tele)
         self.chunk = chunk
         self.prefill_budget = prefill_budget
         # the stall bound the chunking buys: the most prefill tokens
@@ -1422,10 +1498,39 @@ class ContinuousScheduler:
         # serving layer pops these to tell the client WHY it got zero
         # tokens instead of a success-shaped empty stream)
         self.rejected: Dict[object, str] = {}
-        self.preemptions = 0
-        self.deadline_expired = 0
-        self.busy_rejections = 0
+        # resilience counters, registry-homed (stats() snapshots them;
+        # the int-valued properties below keep the old attribute API)
+        reg = self.tele.registry
+        self._c_preemptions = reg.counter(
+            "preemptions", "KV-pressure slot preemptions")
+        self._c_deadline_expired = reg.counter(
+            "deadline_expired", "requests cancelled past deadline_ms")
+        self._c_busy_rejections = reg.counter(
+            "busy_rejections", "submits refused at max_queue")
+        self._g_host_ms = reg.gauge(
+            "host_ms_per_poll", "dispatch-to-dispatch host time minus "
+                                "device wait (EMA)")
         self._hang: Optional[str] = None
+
+    # registry-homed counters behind the old int attribute API (tests
+    # and bench read these as plain ints)
+    @property
+    def preemptions(self) -> int:
+        return self._c_preemptions.value
+
+    @property
+    def deadline_expired(self) -> int:
+        return self._c_deadline_expired.value
+
+    @property
+    def busy_rejections(self) -> int:
+        return self._c_busy_rejections.value
+
+    def dump_trace(self, path: str) -> None:
+        """Write the telemetry export (poll timeline + request traces
+        + metrics snapshot) as perfetto-loadable JSON — the
+        TDTPU_TRACE dump; summarize with tools/trace_view.py."""
+        self.tele.dump(path)
 
     def submit(self, req: Request) -> bool:
         """Enqueue a request. Returns False — WITHOUT queueing — when
@@ -1437,14 +1542,18 @@ class ContinuousScheduler:
         with self._lock:
             if self.max_queue is not None \
                     and len(self._queue) >= self.max_queue:
-                self.busy_rejections += 1
+                self._c_busy_rejections.inc()
                 return False
             if req.deadline_ms is not None \
                     and req.rid not in self._deadline:
                 self._deadline[req.rid] = time.monotonic() \
                     + req.deadline_ms / 1e3
+            # lifecycle stamp INSIDE the lock: the driver may admit
+            # (and emit for) this request the instant it is visible in
+            # the queue, and emit/retire need the record to exist
+            self.tele.queued(req.rid)
             self._queue.append(req)
-            return True
+        return True
 
     @property
     def queue_depth(self) -> int:
@@ -1472,6 +1581,7 @@ class ContinuousScheduler:
                 if r.rid == rid:
                     del self._queue[i]
                     self._deadline.pop(rid, None)
+                    self.tele.retire(rid, "cancelled")
                     return True
         if self.overlap and not self._pipeline_idle() \
                 and any(self.slots.rids[b] == rid
@@ -1486,36 +1596,64 @@ class ContinuousScheduler:
                 self.slots.retire(b)
                 with self._lock:
                     self._deadline.pop(rid, None)
+                self.tele.retire(rid, "cancelled")
                 return True
         return False
 
     def stats(self) -> dict:
         """Serving counters: prefix-cache hit/skip (paged path),
         speculative-decoding accept counters (spec=K mode —
-        spec_accept_rate, tokens_per_step), and the resilience
-        counters: queue_depth, preemptions, deadline_expired,
-        busy_rejections, plus a "hang" verdict string once a
-        watchdogged chunk has missed its deadline."""
-        out = dict(getattr(self.slots, "stats", {}) or {})
-        out.update({
-            "queue_depth": len(self._queue),
-            "preemptions": self.preemptions,
-            "deadline_expired": self.deadline_expired,
-            "busy_rejections": self.busy_rejections,
-            "prefill_budget": self.prefill_budget,
-            "prefill_tokens_forwarded": self.slots.prefill_forwarded,
-            "max_prefill_tokens_per_poll":
-                self.max_prefill_tokens_per_poll,
-            "prefills_in_progress": len(self.slots.prefill_slots),
-            # host time per poll with device wait subtracted (EMA):
-            # the number overlap=True exists to hide behind the device
-            "overlap": self.overlap,
-            "host_ms_per_poll": (0.0 if self._host_ms_ema is None
-                                 else round(self._host_ms_ema, 3)),
-            "device_wait_s": round(self.slots.device_wait_s, 4),
-        })
-        if self._hang is not None:
-            out["hang"] = self._hang
+        spec_accept_rate, tokens_per_step), the resilience counters
+        (queue_depth, preemptions, deadline_expired, busy_rejections,
+        plus a "hang" verdict string once a watchdogged chunk has
+        missed its deadline), and the live latency histograms
+        (`ttft_ms` / `inter_token_ms` / `request_latency_ms` /
+        `poll_ms` as {count, sum, mean, p50, p95, p99} dicts).
+
+        The result is a DEEP, single-point-in-time snapshot of the
+        metrics registry (runtime/telemetry.py) taken under the
+        scheduler and registry locks: every container is freshly
+        allocated, so cross-thread readers can iterate/serialize it
+        while the driver keeps polling — the shallow-copy race the
+        old three hand-maintained dicts carried is structurally
+        gone (tests/test_telemetry.py hammers this)."""
+        reg = self.tele.registry
+        with self._lock, reg.lock:
+            # point-in-time gauges refreshed first (prefix/host-tier
+            # gauges refresh inside slots.stats), then ONE registry
+            # snapshot, then the config echoes and derived rates
+            reg.gauge("queue_depth").set(len(self._queue))
+            reg.gauge("prefill_tokens_forwarded").set(
+                self.slots.prefill_forwarded)
+            reg.gauge("max_prefill_tokens_per_poll").set(
+                self.max_prefill_tokens_per_poll)
+            reg.gauge("prefills_in_progress").set(
+                len(self.slots.prefill_slots))
+            reg.gauge("device_wait_s").set(self.slots.device_wait_s)
+            slots_stats = dict(getattr(self.slots, "stats", {}) or {})
+            out = reg.snapshot()
+            out.update(slots_stats)
+            out.update({
+                "queue_depth": len(self._queue),
+                "preemptions": self._c_preemptions.value,
+                "deadline_expired": self._c_deadline_expired.value,
+                "busy_rejections": self._c_busy_rejections.value,
+                "prefill_budget": self.prefill_budget,
+                "prefill_tokens_forwarded":
+                    self.slots.prefill_forwarded,
+                "max_prefill_tokens_per_poll":
+                    self.max_prefill_tokens_per_poll,
+                "prefills_in_progress": len(self.slots.prefill_slots),
+                # host time per poll with device wait subtracted
+                # (EMA): the number overlap=True exists to hide
+                # behind the device
+                "overlap": self.overlap,
+                "host_ms_per_poll": (0.0 if self._host_ms_ema is None
+                                     else round(self._host_ms_ema, 3)),
+                "device_wait_s": round(self.slots.device_wait_s, 4),
+            })
+            if self._hang is not None:
+                out["hang"] = self._hang
         return out
 
     def _mark_dispatch(self) -> None:
@@ -1531,6 +1669,7 @@ class ContinuousScheduler:
             host_ms = max(0.0, ((now - t0) - (wait - w0)) * 1e3)
             self._host_ms_ema = host_ms if self._host_ms_ema is None \
                 else 0.8 * self._host_ms_ema + 0.2 * host_ms
+            self._g_host_ms.set(self._host_ms_ema)   # registry mirror
         self._last_mark = (now, wait)
 
     @property
@@ -1538,7 +1677,8 @@ class ContinuousScheduler:
         return (not self._queue and not self.slots.occupied
                 and not self._carry_out and not self._carry_done)
 
-    def _reject(self, rid, reason: str) -> None:
+    def _reject(self, rid, reason: str,
+                status: str = "rejected") -> None:
         import sys
         print(f"[scheduler] rejected request {rid!r}: {reason}",
               file=sys.stderr)
@@ -1549,6 +1689,7 @@ class ContinuousScheduler:
             # oldest first (dict preserves insertion order)
             self.rejected.pop(next(iter(self.rejected)))
         self._deadline.pop(rid, None)
+        self.tele.retire(rid, status)
 
     def _expire_deadlines(self, done: List[object]) -> None:
         """Cancel everything past its deadline_ms budget: queued
@@ -1567,7 +1708,7 @@ class ContinuousScheduler:
             keep: deque = deque()
             for r in self._queue:
                 if r.rid in expired:
-                    self.deadline_expired += 1
+                    self._c_deadline_expired.inc()
                     if r.resume is not None:
                         # preempted mid-stream, expired while waiting
                         # to resume: the client DID receive tokens —
@@ -1578,7 +1719,7 @@ class ContinuousScheduler:
                     else:
                         reason = (f"deadline_ms={r.deadline_ms:g} "
                                   f"expired before admission")
-                    self._reject(r.rid, reason)
+                    self._reject(r.rid, reason, status="expired")
                     done.append(r.rid)
                 else:
                     keep.append(r)
@@ -1589,9 +1730,10 @@ class ContinuousScheduler:
                 req = self.slots.reqs[b]
                 emitted = self.slots.emitted(b)
                 self.slots.retire(b)
-                self.deadline_expired += 1
+                self._c_deadline_expired.inc()
                 self._reject(rid, f"deadline_ms={req.deadline_ms:g} "
-                                  f"exceeded after {emitted} tokens")
+                                  f"exceeded after {emitted} tokens",
+                             status="expired")
                 done.append(rid)
 
     def _eligible_victims(self) -> List[int]:
@@ -1630,6 +1772,7 @@ class ContinuousScheduler:
         preemption, cancel and in-flight deadline expiry through
         here. The land runs watchdogged (_land_watchdog) — a drain's
         readback can hang exactly like a poll's."""
+        self.tele.instant("drain")
         out, finished = self._land_watchdog()
         rid_of = self.slots.rids
         for b, t in out.items():
@@ -1688,6 +1831,10 @@ class ContinuousScheduler:
                 else:
                     self.slots.admit(free[0], req)
                 self._queue.popleft()
+                self.tele.req_event(
+                    req.rid,
+                    "resume" if req.resume is not None else "admitted",
+                    free[0])
             except PoolExhausted as e:
                 if self.overlap and not self._pipeline_idle():
                     # land + retire first: pages still held by the
@@ -1716,7 +1863,9 @@ class ContinuousScheduler:
                     # away eviction-fragile prefill work forever
                     return
                 victim = self.slots.preempt(self._pick_victim(victims))
-                self.preemptions += 1
+                self._c_preemptions.inc()
+                self.tele.req_event(victim.rid, "preempt")
+                self.tele.instant("preempt", str(victim.rid))
                 preempted_now.add(victim.rid)
                 self._queue.insert(1, victim)
             except ValueError as e:
@@ -1739,12 +1888,23 @@ class ContinuousScheduler:
         overlap=True swaps in the pipeline-aware iteration
         (_poll_overlap): same contract, same streams, with the host
         phases running under the device's compute instead of after
-        its readback."""
-        if self.overlap:
-            return self._poll_overlap()
+        its readback.
+
+        Every poll rides a telemetry span (poll_ms histogram always;
+        a timeline span + nested host-phase spans when tracing), and
+        delivered tokens drive the live ttft_ms / inter_token_ms
+        histograms."""
+        with self.tele.poll_span():
+            if self.overlap:
+                return self._poll_overlap()
+            return self._poll_sync()
+
+    def _poll_sync(self) -> Tuple[Dict[object, np.ndarray],
+                                  List[object]]:
+        """The synchronous iteration (poll() has the contract)."""
         done: List[object] = []
         pf_before = self.slots.prefill_forwarded
-        with self._lock:
+        with self._lock, self.tele.phase("bookkeep"):
             # the queue-mutating phases run under the submit lock; the
             # decode chunk below does not (submitters may enqueue while
             # the model steps). NOTE: under MONOLITHIC admissions the
@@ -1775,31 +1935,39 @@ class ContinuousScheduler:
             step = lambda: self.slots.step_chunk(self.chunk)
             label = f"scheduler chunk (chunk={self.chunk})"
         self._mark_dispatch()
-        if self.watchdog_s is not None:
-            from triton_dist_tpu.runtime.stress import watchdog
-            try:
-                by_slot, finished = watchdog(step, self.watchdog_s,
-                                             label=label)
-            except Exception as e:
-                from triton_dist_tpu.runtime.stress import HangError
-                if isinstance(e, HangError):
-                    # record the verdict for stats(), then unwind: the
-                    # process is poisoned (stress.watchdog contract) and
-                    # the one unacceptable outcome is a silent freeze
-                    self._hang = str(e)
-                raise
-        else:
-            by_slot, finished = step()
+        with self.tele.phase("step"):
+            if self.watchdog_s is not None:
+                from triton_dist_tpu.runtime.stress import watchdog
+                try:
+                    by_slot, finished = watchdog(step, self.watchdog_s,
+                                                 label=label)
+                except Exception as e:
+                    from triton_dist_tpu.runtime.stress import HangError
+                    if isinstance(e, HangError):
+                        # record the verdict for stats(), then unwind:
+                        # the process is poisoned (stress.watchdog
+                        # contract) and the one unacceptable outcome
+                        # is a silent freeze
+                        self._hang = str(e)
+                        self.tele.instant("watchdog_hang", str(e))
+                    raise
+            else:
+                by_slot, finished = step()
         self.max_prefill_tokens_per_poll = max(
             self.max_prefill_tokens_per_poll,
             self.slots.prefill_forwarded - pf_before)
         rid_of = self.slots.rids
         out = {rid_of[b]: t for b, t in by_slot.items()}
-        for b, rid in finished:
-            self.slots.retire(b)
-            with self._lock:
-                self._deadline.pop(rid, None)
-            done.append(rid)
+        for rid, toks in out.items():
+            if len(toks):
+                self.tele.emit(rid, len(toks))
+        with self.tele.phase("retire"):
+            for b, rid in finished:
+                self.slots.retire(b)
+                with self._lock:
+                    self._deadline.pop(rid, None)
+                self.tele.retire(rid)
+                done.append(rid)
         return out, done
 
     def _land_watchdog(self) -> Tuple[Dict[int, np.ndarray],
@@ -1819,6 +1987,7 @@ class ContinuousScheduler:
                 from triton_dist_tpu.runtime.stress import HangError
                 if isinstance(e, HangError):
                     self._hang = str(e)
+                    self.tele.instant("watchdog_hang", str(e))
                 raise
         return self.slots.land()
 
@@ -1844,27 +2013,32 @@ class ContinuousScheduler:
         done: List[object] = self._carry_done
         self._carry_out, self._carry_done = {}, []
         pf_before = slots.prefill_forwarded
+        tele = self.tele
         if slots.spec:
             skip = frozenset(b for b, _ in self._staged)
-            if any(b not in skip for b in slots.occupied):
-                if slots.prefill_slots:
-                    slots.begin_mixed(self.prefill_budget, skip=skip)
+            with tele.phase("dispatch"):
+                if any(b not in skip for b in slots.occupied):
+                    if slots.prefill_slots:
+                        slots.begin_mixed(self.prefill_budget,
+                                          skip=skip)
+                    else:
+                        slots.begin_chunk(self.chunk, skip=skip)
+                    self._mark_dispatch()
                 else:
-                    slots.begin_chunk(self.chunk, skip=skip)
-                self._mark_dispatch()
-            else:
-                self._last_mark = None  # idle tick: no dispatch stamp
+                    self._last_mark = None  # idle: no dispatch stamp
             # deferred bookkeeping — overlapped with the verify: the
             # previous tick's retires (tree inserts + page releases),
             # deadline expiry, admissions (one-tick slot-free delay)
-            for b, rid in self._staged:
-                if slots.rids[b] == rid:
-                    slots.retire(b)
-            self._staged = []
-            with self._lock:
+            with tele.phase("retire"):
+                for b, rid in self._staged:
+                    if slots.rids[b] == rid:
+                        slots.retire(b)
+                self._staged = []
+            with self._lock, tele.phase("bookkeep"):
                 self._expire_overlap(out_acc, done)
                 self._admit(done, out_acc)
-            out, finished = self._land_watchdog()
+            with tele.phase("land"):
+                out, finished = self._land_watchdog()
             rid_of = slots.rids
             for b, t in out.items():
                 _merge_out(out_acc, rid_of[b], t)
@@ -1874,10 +2048,11 @@ class ContinuousScheduler:
                     done.append(rid)
             self._staged.extend(finished)
         else:
-            with self._lock:
+            with self._lock, tele.phase("bookkeep"):
                 self._expire_overlap(out_acc, done)
                 self._admit(done, out_acc)
-            out, finished = self._land_watchdog()
+            with tele.phase("land"):
+                out, finished = self._land_watchdog()
             rid_of = slots.rids
             for b, t in out.items():
                 _merge_out(out_acc, rid_of[b], t)
@@ -1885,20 +2060,23 @@ class ContinuousScheduler:
             # device starts immediately and the retire bookkeeping
             # (radix-tree inserts, page releases) hides under it
             skip = frozenset(b for b, _ in finished)
-            if any(b not in skip for b in slots.occupied):
-                if slots.prefill_slots:
-                    slots.begin_mixed(self.prefill_budget, skip=skip)
+            with tele.phase("dispatch"):
+                if any(b not in skip for b in slots.occupied):
+                    if slots.prefill_slots:
+                        slots.begin_mixed(self.prefill_budget,
+                                          skip=skip)
+                    else:
+                        slots.begin_chunk(self.chunk, skip=skip)
+                    self._mark_dispatch()
                 else:
-                    slots.begin_chunk(self.chunk, skip=skip)
-                self._mark_dispatch()
-            else:
-                self._last_mark = None  # idle tick: no dispatch stamp
-            for b, rid in finished:
-                if slots.rids[b] == rid:
-                    slots.retire(b)
-                with self._lock:
-                    self._deadline.pop(rid, None)
-                done.append(rid)
+                    self._last_mark = None  # idle: no dispatch stamp
+            with tele.phase("retire"):
+                for b, rid in finished:
+                    if slots.rids[b] == rid:
+                        slots.retire(b)
+                    with self._lock:
+                        self._deadline.pop(rid, None)
+                    done.append(rid)
         # drains during the phases above landed into the carry buffers
         for rid, t in self._carry_out.items():
             _merge_out(out_acc, rid, t)
@@ -1907,6 +2085,16 @@ class ContinuousScheduler:
         self.max_prefill_tokens_per_poll = max(
             self.max_prefill_tokens_per_poll,
             slots.prefill_forwarded - pf_before)
+        # lifecycle: token deliveries first (a finishing stream's last
+        # chunk must land its ttft/inter-token samples before the
+        # retired event pops its record), then the final transitions
+        # ({rejected, expired, cancelled} rids already recorded their
+        # status — the repeat retire no-ops)
+        for rid, t in out_acc.items():
+            if len(t):
+                tele.emit(rid, len(t))
+        for rid in done:
+            tele.retire(rid)
         return out_acc, done
 
     def run(self, requests) -> Dict[object, np.ndarray]:

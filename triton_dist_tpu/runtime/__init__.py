@@ -14,3 +14,12 @@ from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
     create_symm_buffer,
     clear_registry,
 )
+from triton_dist_tpu.runtime.telemetry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Telemetry,
+    default_registry,
+    prometheus_text,
+)

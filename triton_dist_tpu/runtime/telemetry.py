@@ -1,0 +1,565 @@
+"""Serving telemetry: a metrics registry, per-request lifecycle
+traces, and a perfetto-ready poll-loop timeline.
+
+The serving stack (models/scheduler.py + serving.py) is a production-
+shaped loop — continuous batching, prefix cache + host tier, spec
+decode, chunked prefill, dispatch-ahead overlap — and this module is
+its observability substrate:
+
+- METRICS REGISTRY: `Counter` / `Gauge` / `Histogram` under a
+  `MetricsRegistry`. Histograms are LOG-BUCKETED over fixed numpy
+  bins: `record()` is O(1) and allocation-free on the hot path (one
+  `math.log`, one in-place bucket increment — no searchsorted, no
+  array building), and live p50/p95/p99 come from a cumulative walk
+  over ~100 buckets at read time. The scheduler, prefix cache and
+  host KV tier publish their counters here, so `stats()` is a DEEP,
+  single-point-in-time registry snapshot (every container freshly
+  allocated under the registry lock) instead of three hand-maintained
+  dicts — the shallow-snapshot race `dict(sched.stats())` used to
+  carry is structurally gone. A process-global `default_registry()`
+  holds process-wide counters (e.g. Engine dispatch counts) that are
+  not per-scheduler.
+
+- REQUEST LIFECYCLE TRACES: `queued → admitted → prefill_chunk*N →
+  first_token → tokens → preempt/resume → retired/cancelled/expired`,
+  monotonic-stamped per request. The always-on half is two derived
+  histograms — `ttft_ms` (queued → first token, the Sarathi-Serve
+  TTFT) and `inter_token_ms` (gap between consecutive deliveries of a
+  stream, the stall a client actually sees) — which previously
+  existed only as offline bench rows. The full event ring (bounded,
+  oldest-retired-first) is kept only when tracing is ON.
+
+- POLL-LOOP TIMELINE: Chrome trace-event JSON (perfetto-loadable —
+  `ui.perfetto.dev`, or `chrome://tracing`) with one track for HOST
+  phases (bookkeep/admit/dispatch/drafter/land/retire nested under
+  each poll span) and one for DEVICE occupancy (dispatch →
+  `DecodeSlots._fetch` landing), plus instants for watchdog fires,
+  preemptions, drains, and KV demote/promote. This makes the PR-7
+  overlap pipeline VISIBLE: the dispatch-ahead bubble structure and
+  drain stalls are spans you can measure instead of numbers you
+  infer.
+
+Tracing OFF (the default) is a true no-op: every trace entry point
+early-outs on `self.trace` before touching a ring or stamping a
+span. Tracing ON is host-side only — no jax call anywhere in this
+module — so token streams stay BITWISE identical and zero new XLA
+programs compile (asserted by tests/test_telemetry.py). Enable with
+`ContinuousScheduler(trace=True)` / `TokenServer(trace=True)` or by
+setting `TDTPU_TRACE=path` (the TokenServer also dumps the trace to
+that path on exit); summarize dumps with `tools/trace_view.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic event counter. `inc()` is a plain int add (GIL-atomic
+    enough for the single-writer driver thread; cross-thread writers
+    — e.g. busy rejections from reader threads — tolerate the same
+    best-effort semantics the raw-int counters always had)."""
+
+    __slots__ = ("name", "help", "_v")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+    def snapshot(self):
+        return self._v
+
+
+class Gauge:
+    """Point-in-time value (pool occupancy, an EMA, a queue depth)."""
+
+    __slots__ = ("name", "help", "_v")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def snapshot(self):
+        return self._v
+
+
+class Histogram:
+    """Log-bucketed latency histogram over FIXED numpy bins.
+
+    Bucket i >= 1 covers [lo * growth**(i-1), lo * growth**i); bucket
+    0 is the underflow sink (values below `lo`, zero/negative, NaN),
+    the last bucket is the overflow sink (values >= the top edge,
+    +inf included — its sum contribution clamps to the top edge so
+    one bad sample cannot poison the mean). `record()` is
+    O(1) and zero-alloc: the bucket index is pure math
+    (log(v) arithmetic against precomputed constants), the increment
+    is in-place into a preallocated int64 array — no per-sample numpy
+    temporaries, which is what lets the scheduler record on the poll
+    hot path without showing up in host_ms_per_poll.
+
+    `quantile(q)` walks the cumulative counts and returns the
+    GEOMETRIC MIDPOINT of the bucket holding the rank, so its
+    relative error vs the exact sample percentile is bounded by
+    sqrt(growth) (~9.3% at the default growth of 2**0.25) —
+    tests/test_telemetry.py pins this against numpy.percentile."""
+
+    __slots__ = ("name", "help", "lo", "growth", "edges", "counts",
+                 "n", "total", "_log_lo", "_inv_log_g", "_nbins",
+                 "_top")
+
+    def __init__(self, name: str, help: str = "", *, lo: float = 0.01,
+                 hi: float = 6e5, growth: float = 2.0 ** 0.25):
+        if not (lo > 0 and hi > lo and growth > 1.0):
+            raise ValueError(f"bad histogram bounds: lo={lo} hi={hi} "
+                             f"growth={growth}")
+        self.name = name
+        self.help = help
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self._nbins = int(math.ceil(
+            math.log(hi / lo) / math.log(growth)))
+        # fixed bin EDGES [lo, lo*g, ..., lo*g^nbins]; counts has an
+        # underflow slot in front and an overflow slot behind
+        self.edges = self.lo * self.growth ** np.arange(
+            self._nbins + 1, dtype=np.float64)
+        self.counts = np.zeros((self._nbins + 2,), np.int64)
+        self.n = 0
+        self.total = 0.0
+        self._log_lo = math.log(self.lo)
+        self._inv_log_g = 1.0 / math.log(self.growth)
+        self._top = float(self.edges[-1])
+
+    def record(self, v) -> None:
+        v = float(v)
+        if not v >= self.lo:        # below lo, zero, negative, or NaN
+            i = 0
+            v = max(v, 0.0) if v == v else 0.0
+        elif v >= self._top:        # overflow sink (reached directly:
+            i = self._nbins + 1     # int(log(+inf)) would raise, and
+            if v == math.inf:       # an inf sum poisons the snapshot
+                v = self._top       # — clamp ONLY the non-finite case
+        else:
+            i = int((math.log(v) - self._log_lo) * self._inv_log_g) + 1
+            if i > self._nbins:
+                i = self._nbins + 1
+        self.counts[i] += 1
+        self.n += 1
+        self.total += v
+
+    def quantile(self, q: float) -> float:
+        """q in [0, 1]: geometric-midpoint estimate of the q-th sample
+        quantile (0.0 when empty; clamped to [lo, top edge])."""
+        if self.n == 0:
+            return 0.0
+        rank = q * (self.n - 1)
+        c = 0
+        for i in range(len(self.counts)):
+            c += int(self.counts[i])
+            if c > rank:
+                if i == 0:
+                    return float(self.edges[0])
+                if i > self._nbins:
+                    return float(self.edges[-1])
+                return float(math.sqrt(self.edges[i - 1]
+                                       * self.edges[i]))
+        return float(self.edges[-1])
+
+    def snapshot(self) -> dict:
+        """Fresh scalars only — safe to hold across further records."""
+        n = self.n
+        return {
+            "count": int(n),
+            "sum": round(float(self.total), 3),
+            "mean": round(float(self.total) / n, 3) if n else 0.0,
+            "p50": round(self.quantile(0.50), 3),
+            "p95": round(self.quantile(0.95), 3),
+            "p99": round(self.quantile(0.99), 3),
+        }
+
+
+class MetricsRegistry:
+    """Named metrics with get-or-create accessors and DEEP snapshots.
+
+    snapshot() returns {name: scalar | fresh dict} built entirely
+    under the registry lock — nothing in the returned structure
+    aliases live mutable state, so callers (the serving layer's
+    done-messages, the /metrics listener, cross-thread stats()
+    readers) can iterate/serialize it while the driver keeps
+    recording. The lock is reentrant and exposed (`.lock`) so the
+    scheduler can bundle its own point-in-time gauge refresh with the
+    snapshot into one consistent cut."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._metrics: "Dict[str, object]" = {}
+
+    def _get(self, name: str, cls, help: str, **kw):
+        with self.lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif type(m) is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
+        return self._get(name, Histogram, help, **kw)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {name: m.snapshot()
+                    for name, m in self._metrics.items()}
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """Process-global registry for metrics that are not per-scheduler
+    (Engine dispatch counters, user code). Per-scheduler counters live
+    in each scheduler's own registry (`sched.tele.registry`) so two
+    schedulers never alias each other's stats."""
+    return _DEFAULT
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_text(*registries: MetricsRegistry) -> str:
+    """Prometheus text exposition (v0.0.4) over one or more
+    registries: counters/gauges as single samples, histograms as
+    cumulative `_bucket{le=...}` series + `_sum`/`_count`. Names are
+    sanitized and prefixed `tdtpu_`."""
+    lines: List[str] = []
+    for reg in registries:
+        with reg.lock:
+            metrics = list(reg._metrics.values())
+        for m in metrics:
+            name = "tdtpu_" + _NAME_RE.sub("_", m.name)
+            if isinstance(m, Counter):
+                lines += [f"# TYPE {name} counter", f"{name} {m.value}"]
+            elif isinstance(m, Gauge):
+                lines += [f"# TYPE {name} gauge", f"{name} {m.value:g}"]
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for i in range(len(m.counts) - 1):
+                    cum += int(m.counts[i])
+                    le = m.edges[min(i, len(m.edges) - 1)]
+                    lines.append(f'{name}_bucket{{le="{le:g}"}} {cum}')
+                cum += int(m.counts[-1])
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum {m.total:g}")
+                lines.append(f"{name}_count {m.n}")
+    return "\n".join(lines) + "\n"
+
+
+class _Req:
+    """Per-request lifecycle state: the monotonic stamps the derived
+    histograms need (always), plus the event list (tracing only)."""
+
+    __slots__ = ("t_q", "t_first", "t_last", "n", "ev")
+
+    def __init__(self, t: float, traced: bool):
+        self.t_q = t
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.n = 0
+        self.ev: Optional[list] = [] if traced else None
+
+
+class _NullSpan:
+    """The tracing-off phase context: literally nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One host-track phase span (emitted as a Chrome 'X' complete
+    event on exit; nests visually under the enclosing poll span)."""
+
+    __slots__ = ("_tele", "_name", "_t0")
+
+    def __init__(self, tele: "Telemetry", name: str):
+        self._tele = tele
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._tele._span(self._name, self._t0, time.monotonic(),
+                         tid=0)
+        return False
+
+
+class _PollSpan:
+    """Wraps one scheduler poll: records the `poll_ms` histogram
+    (always — it is the live twin of the host_ms_per_poll EMA) and,
+    when tracing, the poll's timeline span with its sequence number
+    (tools/trace_view.py ranks these for the top-k slowest polls)."""
+
+    __slots__ = ("_tele", "_t0")
+
+    def __init__(self, tele: "Telemetry"):
+        self._tele = tele
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        tele = self._tele
+        t1 = time.monotonic()
+        tele.h_poll.record((t1 - self._t0) * 1e3)
+        if tele.trace:
+            tele._poll_seq += 1
+            tele._span("poll", self._t0, t1, tid=0,
+                       args={"seq": tele._poll_seq})
+        return False
+
+
+class Telemetry:
+    """One scheduler's telemetry bundle: registry + request lifecycle
+    + poll timeline (module docstring). The ALWAYS-ON half is the
+    registry and the derived latency histograms (`ttft_ms`,
+    `inter_token_ms`, `request_latency_ms`, `poll_ms`) — they are the
+    stats() surface and cost what the hand-rolled counters cost. The
+    TRACE half (event rings, timeline spans/instants) is gated on
+    `self.trace` with guarded early-outs: trace-off is a true no-op.
+
+    Thread contract: histogram/counter records come from the driver
+    thread; `queued`/`retire` (which resize the live-request dict)
+    and `export` take the small internal lock so cross-thread
+    submit() and stats dumps never iterate a resizing dict."""
+
+    # retired statuses get their own counters, predeclared so the
+    # retire path never takes the registry lock
+    _STATUSES = ("retired", "cancelled", "expired", "rejected")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 *, trace: bool = False, max_retired: int = 512,
+                 max_events: int = 65536):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.trace = bool(trace)
+        self._lock = threading.RLock()
+        self._t0 = time.monotonic()
+        r = self.registry
+        self.h_ttft = r.histogram(
+            "ttft_ms", "queued -> first token, per request")
+        self.h_itl = r.histogram(
+            "inter_token_ms", "gap between consecutive deliveries of "
+                              "one stream")
+        self.h_e2e = r.histogram(
+            "request_latency_ms", "queued -> retirement, per request")
+        self.h_poll = r.histogram(
+            "poll_ms", "scheduler poll duration")
+        self._c_status = {s: r.counter("requests_" + s)
+                          for s in self._STATUSES}
+        self._live: Dict[object, _Req] = {}
+        self._retired: deque = deque(maxlen=max_retired)
+        self._events: deque = deque(maxlen=max_events)
+        self._dispatch = None           # pending device-track stamp
+        self._poll_seq = 0
+
+    # ------------------------------------------------------------------
+    # request lifecycle (histograms always; event ring when tracing)
+    # ------------------------------------------------------------------
+
+    def _ms(self, t: float) -> float:
+        return round((t - self._t0) * 1e3, 3)
+
+    def queued(self, rid) -> None:
+        t = time.monotonic()
+        with self._lock:
+            rec = self._live.get(rid)
+            if rec is None:
+                rec = self._live[rid] = _Req(t, self.trace)
+        if rec.ev is not None:
+            rec.ev.append([self._ms(t), "queued", None])
+
+    def req_event(self, rid, name: str, detail=None) -> None:
+        """Trace-only annotation on a live request (admitted, resume,
+        prefill_chunk, preempt, ...). No-op when tracing is off or the
+        rid is unknown (e.g. events for never-queued internals)."""
+        if not self.trace:
+            return
+        rec = self._live.get(rid)
+        if rec is None or rec.ev is None:
+            return
+        rec.ev.append([self._ms(time.monotonic()), name, detail])
+
+    def emit(self, rid, n: int) -> None:
+        """One delivery of n tokens to rid's stream: derives ttft_ms
+        (first delivery) / inter_token_ms (the rest) live."""
+        t = time.monotonic()
+        rec = self._live.get(rid)
+        if rec is None:
+            return
+        if rec.t_first is None:
+            rec.t_first = t
+            self.h_ttft.record((t - rec.t_q) * 1e3)
+            if rec.ev is not None:
+                rec.ev.append([self._ms(t), "first_token", int(n)])
+        else:
+            self.h_itl.record((t - rec.t_last) * 1e3)
+            if rec.ev is not None:
+                rec.ev.append([self._ms(t), "tokens", int(n)])
+        rec.t_last = t
+        rec.n += n
+
+    def retire(self, rid, status: str = "retired") -> None:
+        """Final transition; repeat retires of the same rid no-op (a
+        rejected rid can reappear in a later done list)."""
+        t = time.monotonic()
+        with self._lock:
+            rec = self._live.pop(rid, None)
+        if rec is None:
+            return
+        self.h_e2e.record((t - rec.t_q) * 1e3)
+        c = self._c_status.get(status)
+        if c is None:
+            c = self.registry.counter("requests_" + status)
+        c.inc()
+        if rec.ev is not None:
+            rec.ev.append([self._ms(t), status, None])
+            ttft = (round((rec.t_first - rec.t_q) * 1e3, 3)
+                    if rec.t_first is not None else None)
+            with self._lock:
+                self._retired.append(
+                    (rid, {"status": status, "tokens": rec.n,
+                           "ttft_ms": ttft, "events": rec.ev}))
+
+    # ------------------------------------------------------------------
+    # poll-loop timeline (tracing only; host tid=0, device tid=1)
+    # ------------------------------------------------------------------
+
+    def _span(self, name: str, t0: float, t1: float, *, tid: int,
+              args: Optional[dict] = None) -> None:
+        ev = {"name": name, "ph": "X", "pid": 0, "tid": tid,
+              "ts": round((t0 - self._t0) * 1e6, 1),
+              "dur": round((t1 - t0) * 1e6, 1)}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def poll_span(self) -> _PollSpan:
+        return _PollSpan(self)
+
+    def phase(self, name: str):
+        """Host-track phase span context (bookkeep/dispatch/land/
+        retire/drafter). Returns the shared null context when off —
+        zero allocation, zero stamps."""
+        if not self.trace:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def mark_dispatch(self, kind: str = "step") -> None:
+        """Stamp a device-program dispatch; the matching
+        `device_land()` (DecodeSlots._fetch) closes the device-track
+        occupancy span dispatch -> readback-landing."""
+        if self.trace:
+            self._dispatch = (kind, time.monotonic())
+
+    def device_land(self) -> None:
+        if not self.trace or self._dispatch is None:
+            return
+        kind, t0 = self._dispatch
+        self._dispatch = None
+        self._span("device:" + kind, t0, time.monotonic(), tid=1)
+
+    def instant(self, name: str, detail=None) -> None:
+        """Timeline instant (watchdog fire, preemption, drain stall,
+        KV demote/promote)."""
+        if not self.trace:
+            return
+        ev = {"name": name, "ph": "i", "s": "p", "pid": 0, "tid": 0,
+              "ts": round((time.monotonic() - self._t0) * 1e6, 1)}
+        if detail is not None:
+            ev["args"] = {"detail": detail}
+        self._events.append(ev)
+
+    # ------------------------------------------------------------------
+    # export
+    # ------------------------------------------------------------------
+
+    def export(self) -> dict:
+        """The dump payload: perfetto loads it via the standard
+        `traceEvents` key and ignores the extra `requests`/`metrics`
+        sections tools/trace_view.py summarizes."""
+        meta = [
+            {"ph": "M", "pid": 0, "tid": 0, "name": "thread_name",
+             "args": {"name": "host phases"}},
+            {"ph": "M", "pid": 0, "tid": 1, "name": "thread_name",
+             "args": {"name": "device occupancy"}},
+        ]
+        with self._lock:
+            events = meta + list(self._events)
+            reqs = {}
+            for rid, summary in self._retired:
+                reqs[str(rid)] = summary
+            for rid, rec in self._live.items():
+                if rec.ev is not None:
+                    ttft = (round((rec.t_first - rec.t_q) * 1e3, 3)
+                            if rec.t_first is not None else None)
+                    reqs[str(rid)] = {"status": "live",
+                                      "tokens": rec.n,
+                                      "ttft_ms": ttft,
+                                      "events": list(rec.ev)}
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "requests": reqs, "metrics": self.registry.snapshot()}
+
+    def dump(self, path: str) -> None:
+        """Write the export to `path` (the TDTPU_TRACE contract)."""
+        with open(path, "w") as f:
+            json.dump(self.export(), f)
+
+
+def trace_env_enabled() -> bool:
+    """The TDTPU_TRACE convention: a non-empty value enables tracing
+    (and names the TokenServer's dump path)."""
+    return bool(os.environ.get("TDTPU_TRACE"))

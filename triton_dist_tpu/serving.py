@@ -48,11 +48,22 @@ Resilience (models/scheduler.py has the scheduler-side story):
   of rejecting (the client just sees a pause — resumed streams are
   bitwise identical), and a hung decode chunk (watchdog_s) ends the
   loop with a HANG error to every live client instead of freezing.
+
+Telemetry (runtime/telemetry.py): stats() is a deep registry snapshot
+with live `ttft_ms` / `inter_token_ms` p50/p95/p99 histograms; any
+client can fetch it in-protocol with a `{"op": "stats"}` request
+(one JSON reply line, then close). `metrics_port=` starts a minimal
+Prometheus text-exposition listener (`GET /metrics` over HTTP/1.0 —
+scrape `http://host:server.metrics_port/metrics`), and
+`TDTPU_TRACE=path` enables poll-loop tracing AND dumps the
+perfetto-loadable timeline + request traces to `path` when
+serve_forever exits (summarize with tools/trace_view.py).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -137,7 +148,9 @@ class TokenServer:
                  drafter=None, max_queue: Optional[int] = None,
                  watchdog_s: Optional[float] = None, fault=None,
                  prefill_budget: Optional[int] = None,
-                 host_pool_pages: int = 0, overlap: bool = False):
+                 host_pool_pages: int = 0, overlap: bool = False,
+                 metrics_port: Optional[int] = None,
+                 trace: Optional[bool] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -187,7 +200,20 @@ class TokenServer:
         can). The win is visible as stats()["host_ms_per_poll"] (also
         in every done message): when that approaches the device step
         time, overlap=True is the difference between host-bound and
-        device-bound serving."""
+        device-bound serving.
+
+        metrics_port: not None starts a Prometheus text-exposition
+        listener on that TCP port (0 = ephemeral; the bound port is
+        `self.metrics_port`) — `GET /metrics` returns the scheduler's
+        registry plus the process-global one (Engine dispatch
+        counters) in exposition format v0.0.4.
+
+        trace: poll-loop + request tracing (runtime/telemetry.py,
+        perfetto-loadable; None = the TDTPU_TRACE env convention —
+        setting TDTPU_TRACE=path also makes serve_forever dump the
+        trace to `path` on exit). Clients can fetch the live stats
+        snapshot — ttft_ms / inter_token_ms histograms included —
+        with a `{"op": "stats"}` request."""
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
@@ -200,7 +226,8 @@ class TokenServer:
             spec=spec, drafter=drafter, max_queue=max_queue,
             watchdog_s=watchdog_s, fault=fault,
             prefill_budget=prefill_budget,
-            host_pool_pages=host_pool_pages, overlap=overlap)
+            host_pool_pages=host_pool_pages, overlap=overlap,
+            trace=trace)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -211,6 +238,21 @@ class TokenServer:
         self._next_rid = 0
         self._conns: dict = {}          # rid -> _ClientStream
         self._lock = threading.Lock()   # guards scheduler submit + _conns
+        # optional Prometheus /metrics listener (daemon thread; dies
+        # with stop()). metrics_port=0 binds an ephemeral port.
+        self.metrics_port: Optional[int] = None
+        self._msock: Optional[socket.socket] = None
+        if metrics_port is not None:
+            self._msock = socket.socket(socket.AF_INET,
+                                        socket.SOCK_STREAM)
+            self._msock.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEADDR, 1)
+            self._msock.bind((host, metrics_port))
+            self._msock.listen(4)
+            self._msock.settimeout(0.25)
+            self.metrics_port = self._msock.getsockname()[1]
+            threading.Thread(target=self._serve_metrics,
+                             daemon=True).start()
 
     class _ClientStream:
         """Per-connection state: the socket + reply file handle + token
@@ -291,6 +333,13 @@ class TokenServer:
                 req = json.loads(line)
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
+                if req.get("op") == "stats":
+                    # in-protocol stats fetch: one deep-snapshot JSON
+                    # reply (live ttft/inter-token histograms
+                    # included), then close — no slot consumed
+                    self._refuse(conn, f, {"done": True,
+                                           "stats": self.stats()})
+                    return
                 ids = self.tok.encode(str(req.get("prompt", ""))) or [0]
                 gen_len = int(req.get("gen_len", 16))
                 seed = int(req.get("seed", 0))
@@ -337,6 +386,42 @@ class TokenServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_metrics(self) -> None:
+        """Prometheus text-exposition listener: one short-lived HTTP
+        exchange per scrape (HTTP/1.0, connection-close — the format
+        every Prometheus-compatible scraper speaks). Refreshes the
+        point-in-time gauges via stats() before rendering, and serves
+        the scheduler registry plus the process-global default (the
+        Engine dispatch counters)."""
+        from triton_dist_tpu.runtime.telemetry import (
+            default_registry, prometheus_text)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._msock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                conn.recv(4096)          # request line + headers
+                self.stats()             # refresh registry gauges
+                body = prometheus_text(self.sched.tele.registry,
+                                       default_registry()).encode()
+                conn.sendall(
+                    b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4; "
+                    b"charset=utf-8\r\n"
+                    b"Content-Length: " + str(len(body)).encode()
+                    + b"\r\n\r\n" + body)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _retry_after_ms(self) -> int:
         """Backpressure hint: the measured poll cadence times the line
@@ -393,11 +478,19 @@ class TokenServer:
     def stats(self) -> dict:
         """Serving counters: prefix-cache (hit rate, prefill tokens
         skipped — paged path), speculative decoding (spec_accept_rate,
-        tokens_per_step — spec=K mode), and the resilience counters
+        tokens_per_step — spec=K mode), the resilience counters
         (queue_depth, preemptions, deadline_expired, busy_rejections,
-        "hang" verdict once a watchdogged chunk missed its deadline)."""
+        "hang" verdict once a watchdogged chunk missed its deadline),
+        and the live ttft_ms / inter_token_ms / poll_ms histograms.
+
+        The scheduler already returns a DEEP single-point-in-time
+        registry snapshot (runtime/telemetry.py) — every container
+        freshly allocated under the scheduler + registry locks — so
+        cross-thread readers (this server's reader threads, the
+        /metrics listener, test hammers) can iterate and serialize it
+        while the driver keeps polling."""
         with self._lock:
-            return dict(self.sched.stats())
+            return self.sched.stats()
 
     def _finish(self, rid, error: Optional[str] = None) -> None:
         cs = self._conns.pop(rid, None)
@@ -502,9 +595,23 @@ class TokenServer:
             self._sock.close()
             for rid in list(self._conns):
                 self._finish(rid)
+            # TDTPU_TRACE contract: dump the poll-loop timeline +
+            # request traces + metrics snapshot on exit (perfetto-
+            # loadable; summarize with tools/trace_view.py)
+            path = os.environ.get("TDTPU_TRACE")
+            if path:
+                try:
+                    self.sched.dump_trace(path)
+                except OSError:
+                    pass
 
     def stop(self) -> None:
         self._stop.set()
+        if self._msock is not None:
+            try:
+                self._msock.close()
+            except OSError:
+                pass
 
 
 def request_stream(host: str, port: int, prompt: str, *,
