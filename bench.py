@@ -730,6 +730,65 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- TP-sharded paged serving row (ROADMAP open item 1): the SAME
+    # paged serving workload through ONE scheduler on the FULL TP mesh
+    # (head-sharded pool, shard_map paged attends, comm-kernel
+    # projections — models/kv_cache.py TP SHARDING) vs a single-chip
+    # engine. Aggregate tokens/s across the mesh is the number TP
+    # exists to scale; the per-chip twin rides in stats(). On the CPU
+    # smoke every "chip" timeshares the same host cores, so the
+    # on/off ratio is noise by construction — real chips
+    # (tools/onchip_regen.sh) are the measurement.
+    if on_tpu:
+        tp_n, tp_len, tp_gen, tp_batch, tp_chunk = 2 * B, 64, 96, B, 8
+    else:
+        tp_n, tp_len, tp_gen, tp_batch, tp_chunk = 6, 8, 8, 3, 2
+
+    def tp_reqs():
+        r = np.random.RandomState(11)
+        return [Request(rid=i,
+                        ids=r.randint(0, cfg.vocab_size,
+                                      size=(tp_len,)).astype(np.int32),
+                        gen_len=tp_gen, seed=i)
+                for i in range(tp_n)]
+
+    def tp_run(eng_x):
+        mk = lambda: ContinuousScheduler(eng_x, batch=tp_batch,
+                                         chunk=tp_chunk, paged=True)
+        mk().run(tp_reqs()[:1])            # warm the slot programs
+        sched = mk()
+        t0 = time.perf_counter()
+        out = sched.run(tp_reqs())
+        dt = time.perf_counter() - t0
+        return sum(len(t) for t in out.values()) / dt, sched.stats()
+
+    eng_tp = Engine(model, max_seq=tp_len + tp_gen + tp_chunk + 16,
+                    backend=backend, kv_dtype=kv_dtype)
+    agg_on, st_tp = tp_run(eng_tp)
+    if ndev > 1:
+        mesh_1 = jax.make_mesh((1,), ("tp",))
+        model_1 = AutoLLM.from_config(cfg, mesh_1)
+        if on_tpu:
+            model_1 = model_1.quantize_int8()
+        eng_1 = Engine(model_1,
+                       max_seq=tp_len + tp_gen + tp_chunk + 16,
+                       backend=os.environ.get("TDTPU_BENCH_BACKEND")
+                       or "flash", kv_dtype=kv_dtype)
+        agg_off, _ = tp_run(eng_1)
+    else:
+        agg_off = agg_on                   # single-chip host: on == off
+    _emit_json({
+        "metric": "serving_tok_per_s_aggregate",
+        "value": round(agg_on, 2),
+        "unit": "tok/s",
+        "tp_size": ndev,
+        "tp_off_tok_per_s": round(agg_off, 2),
+        "per_chip": round(agg_on / ndev, 2),
+        "stats_per_chip": st_tp.get("serving_tok_per_s_per_chip"),
+        "requests": tp_n, "slots": tp_batch,
+        "backend": jax.default_backend(),
+    })
+
 
 def main():
     if os.environ.get("TDTPU_BENCH_CHILD") == "1":

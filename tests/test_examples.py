@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -64,6 +66,14 @@ def test_overlap_scheduler_example_runs():
 
 def test_telemetry_example_runs():
     _run_example("16_telemetry.py")
+
+
+@pytest.mark.slow
+def test_tp_serving_example_runs():
+    # slow: tier-1's 870 s budget — the TP=4-vs-TP=1 differential the
+    # example demos already runs in-suite (tests/test_tp_serving.py);
+    # tools/tp_smoke.sh and manual runs cover the example itself
+    _run_example("17_tp_serving.py")
 
 
 def test_socket_serving_two_process():

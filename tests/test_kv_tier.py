@@ -422,3 +422,66 @@ def test_chaos_host_exhaustion_stays_bitwise():
         np.testing.assert_array_equal(got[r.rid], want[r.rid],
                                       err_msg=f"rid={r.rid}")
     _assert_no_leak_two_tier(sched)
+
+
+# ----------------------------------------------------------------------
+# TP-sharded pool: the gather-to-host layout (PR "TP-sharded paged
+# serving" satellite) — extract_pages_host must pick each page's
+# OWNING head-group plane of the [NP, G, page, d] payload, and the
+# restore must land the bytes back where the owner reads them, so the
+# d2h -> h2d round trip is bitwise on multi-chip pools too.
+# ----------------------------------------------------------------------
+
+
+def test_extract_restore_bitwise_on_sharded_pool():
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    n = min(4, len(jax.devices()))
+    mesh = jax.make_mesh((n,), ("tp",))
+    cfg = tiny_qwen3(n)
+    model = AutoLLM.from_config(cfg, mesh)
+    eng = Engine(model, max_seq=32, backend="flash")
+    pc = eng.make_paged_slot_cache(2, page=PAGE)
+    Hkv, G = cfg.num_kv_heads, pc.head_groups
+    hkv_loc = Hkv // G
+    NP, page, d = pc.num_pages, pc.page, cfg.head_dim
+    # distinct bytes per (layer, page, PLANE): the owning plane's value
+    # is the one the round trip must preserve — a gather that read the
+    # wrong plane (or summed planes) cannot reproduce it
+    rng = np.random.RandomState(0)
+    pats_k = [rng.randn(NP, G, page, d).astype(np.float32)
+              for _ in pc.pages_k]
+    pats_v = [rng.randn(NP, G, page, d).astype(np.float32)
+              for _ in pc.pages_v]
+    pc = _dc.replace(
+        pc,
+        pages_k=tuple(jnp.asarray(p) for p in pats_k),
+        pages_v=tuple(jnp.asarray(p) for p in pats_v))
+    # one page per kv head (a head-ordered group, ids distinct)
+    ids = np.arange(1, 1 + Hkv, dtype=np.int32)
+    heads = np.arange(Hkv, dtype=np.int32)
+    out = eng.extract_pages_host(pc, ids, heads=heads)
+    k, v = out[0], out[1]
+    assert k.shape == (cfg.num_layers, Hkv, page, d)
+    for li in range(cfg.num_layers):
+        for i, (pid, h) in enumerate(zip(ids, heads)):
+            own = int(h) // hkv_loc
+            np.testing.assert_array_equal(
+                k[li, i], pats_k[li][pid, own],
+                err_msg=f"layer {li} page {pid}: gathered bytes are "
+                        f"not the owning plane {own}'s")
+            np.testing.assert_array_equal(v[li, i], pats_v[li][pid, own])
+    # restore into DIFFERENT pages of a zeroed pool, re-extract: the
+    # round trip is bitwise through the sharded layout
+    pc2 = eng.make_paged_slot_cache(2, page=PAGE)
+    ids2 = np.arange(1 + Hkv, 1 + 2 * Hkv, dtype=np.int32)
+    pc2 = eng.restore_pages_host(pc2, ids2, k, v)
+    out2 = eng.extract_pages_host(pc2, ids2, heads=heads)
+    np.testing.assert_array_equal(out2[0], k)
+    np.testing.assert_array_equal(out2[1], v)
+    # a TP-sharded pool refuses a head-blind extract (G > 1)
+    if G > 1:
+        with pytest.raises(ValueError, match="heads"):
+            eng.extract_pages_host(pc2, ids2)

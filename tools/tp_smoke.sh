@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# TP-sharded serving smoke — the TP=4-vs-TP=1 bitwise differential
+# suite (tests/test_tp_serving.py + the sharded host-tier round trip
+# in tests/test_kv_tier.py) on the forced multi-device CPU mesh, the
+# same substrate tier-1 uses (tools/tier1.sh runs the whole tests/
+# tree under it — this script is the focused loop for iterating on
+# the TP layer alone). Archives the pass count next to the log and
+# reports the delta vs the previous run, tier1.sh-style.
+# Run from the repo root: bash tools/tp_smoke.sh
+set -o pipefail
+rm -f /tmp/_tp_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the FULL TP
+# differential matrix, including the arms tier-1's 870 s budget
+# pushes behind the slow mark (sampled/spec, chunked+overlap,
+# preemption+host-tier, the example).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_tp_serving.py \
+    "tests/test_kv_tier.py::test_extract_restore_bitwise_on_sharded_pool" \
+    "tests/test_examples.py::test_tp_serving_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_tp_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_tp_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_tp_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "TP_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "TP_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

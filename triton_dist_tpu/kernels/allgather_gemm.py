@@ -374,6 +374,13 @@ def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
     (tools/profiler/language.py:38) within what Mosaic exposes — see
     tools/kprof.py.
     """
+    # comm-kernel trace counter (runtime/telemetry.py, process-global
+    # registry): counts each time this kernel is BUILT into a program
+    # (python call = jit trace time) — paired with the Engine's
+    # per-dispatch `comm_kernel_dispatches`, the observable proof that
+    # a serving topology actually routes through the comm kernels.
+    from triton_dist_tpu.runtime.telemetry import default_registry
+    default_registry().counter("comm_kernel_traces").inc()
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
     bq = b.q if quant else b

@@ -221,6 +221,13 @@ def all_reduce(x_partials, *, mesh: Mesh, axis: str = "tp",
     x_partials: [n, M, cols] sharded on dim 0 over `axis`. Returns
     [M, cols] = sum_d x_partials[d].
     """
+    # comm-kernel trace counter (runtime/telemetry.py, process-global
+    # registry): counts each time this kernel is BUILT into a program
+    # (python call = jit trace time) — paired with the Engine's
+    # per-dispatch `comm_kernel_dispatches`, the observable proof that
+    # a serving topology actually routes through the comm kernels.
+    from triton_dist_tpu.runtime.telemetry import default_registry
+    default_registry().counter("comm_kernel_traces").inc()
     n = mesh.shape[axis]
     _, M, cols = x_partials.shape
     if n == 1:

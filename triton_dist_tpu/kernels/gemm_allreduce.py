@@ -251,6 +251,13 @@ def gemm_allreduce(a, b, ctx: Optional[GemmARContext] = None, *,
     [M, N] replicated over `axis` — the torch-AR-equivalent TP epilogue
     but without a separate collective.
     """
+    # comm-kernel trace counter (runtime/telemetry.py, process-global
+    # registry): counts each time this kernel is BUILT into a program
+    # (python call = jit trace time) — paired with the Engine's
+    # per-dispatch `comm_kernel_dispatches`, the observable proof that
+    # a serving topology actually routes through the comm kernels.
+    from triton_dist_tpu.runtime.telemetry import default_registry
+    default_registry().counter("comm_kernel_traces").inc()
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
     bq = b.q if quant else b

@@ -651,21 +651,15 @@ class TP_Attn:
                                                  kv, pos, q_lens, impl)
         return self._o_proj(o, mode), kv
 
-    def _split_qkv_global(self, qkv, S: int = 1):
-        """Unpack a GLOBAL packed [q|k|v] projection into per-head q/k/v
-        [B, S, H, d]. The packed column layout is n per-rank blocks
-        [q_r | k_r | v_r] (shard_cols_packed), so the global split
-        de-interleaves the rank blocks; heads come out rank-major —
-        exactly the global head order the column-parallel w_o expects."""
-        n = self.mesh.shape[self.axis]
-        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
-        B = qkv.shape[0] // S
-        blk = (hq + 2 * hkv) * hd
-        r = qkv.reshape(B, S, n, blk)
-        q = r[..., :hq * hd].reshape(B, S, n * hq, hd)
-        k = r[..., hq * hd:(hq + hkv) * hd].reshape(B, S, n * hkv, hd)
-        v = r[..., (hq + hkv) * hd:].reshape(B, S, n * hkv, hd)
-        return q, k, v
+    def _paged_specs(self, quant: bool):
+        """shard_map in/out specs of one layer's paged pool tuple:
+        payloads [NP, G, page, d] and (int8) scale planes [NP, G, page]
+        split on the HEAD-GROUP axis G (kv_cache.PagedSlotCache TP
+        sharding) — each rank's plane holds its own kv heads' pages."""
+        pool_spec = P(None, self.axis, None, None)
+        sc_spec = P(None, self.axis, None)
+        return ((pool_spec, pool_spec, sc_spec, sc_spec) if quant
+                else (pool_spec, pool_spec))
 
     def _attend_paged_slots(self, qkv, cos, sin, batch: int, kv, table,
                             pos, impl: str = "flash"):
@@ -675,78 +669,102 @@ class TP_Attn:
         attention walks the pool through the table (flash_decode_paged,
         or a gather + contiguous oracle under impl="ref").
 
-        kv: (pages_k, pages_v) [NP, page, d] — ONE layer's pool — or
-        (pages_k, pages_v, scales_k, scales_v) for the INT8 pool
+        kv: (pages_k, pages_v) [NP, G, page, d] — ONE layer's pool —
+        or (pages_k, pages_v, scales_k, scales_v) for the INT8 pool
         (kv_cache.PagedSlotCache with dtype=int8): the new row
         quantizes per position (kernels/quant.quantize_kv_int8 — the
         contiguous cache's exact quantizer) and its scale lands in the
-        [NP, page] scale plane at the SAME page/row the payload takes,
-        so scales follow pages through sharing, CoW, eviction and the
-        host tier for free; attention dequants in-kernel
-        (flash_decode_paged k_scale/v_scale).
-        table: [B*Hkv, max_pages] int32 shared by all layers. The pool
-        is REPLICATED and this attend runs at the global level (GSPMD
-        partitions it; a head-sharded pool with per-rank allocators is
-        an open item), so on multi-chip meshes the paged path trades
-        the hand-overlapped comm kernels for allocation flexibility —
-        the single-chip serving regime is where paging earns its keep.
-        """
+        [NP, G, page] scale plane at the SAME page/row/plane the
+        payload takes, so scales follow pages through sharing, CoW,
+        eviction and the host tier for free; attention dequants
+        in-kernel (flash_decode_paged k_scale/v_scale).
+        table: [B*Hkv, max_pages] int32 shared by all layers,
+        replicated (the host owns it).
+
+        TP-NATIVE (the head-sharded pool of kv_cache.PagedSlotCache —
+        ROADMAP open item 1): this attend runs under jax.shard_map
+        exactly like the contiguous _attend_cached_slots — each rank
+        scatters its OWN kv heads' new rows into its local pool plane
+        and walks only its local streams (its slice of the table), so
+        a TP=N mesh reads 1/N of the KV and does 1/N of the attention
+        FLOPs per chip while the page table, allocator and radix tree
+        stay host-replicated and layout-oblivious."""
         from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
         from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
         from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
                                                    quantize_kv_int8)
-        hd = self.head_dim
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
         Hkv = self.n_kv_heads
         scale = hd ** -0.5
         quant = len(kv) == 4
-        if quant:
-            ck, cv, sk, sv = kv
-        else:
-            ck, cv = kv
-            sk = sv = None
-        page = ck.shape[1]
+        kv_specs = self._paged_specs(quant)
         B = qkv.shape[0]
-        q, k, v = self._split_qkv_global(qkv)        # [B, 1, H, d]
-        if self.q_norm is not None:
-            q = rms_norm(q, self.q_norm)
-        if self.k_norm is not None:
-            k = rms_norm(k, self.k_norm)
-        pos = jnp.asarray(pos, jnp.int32)
-        q = apply_rope_slots(q, cos, sin, pos)
-        k = apply_rope_slots(k, cos, sin, pos)
-        X = B * Hkv
-        pos_x = jnp.repeat(pos, Hkv)                     # [X]
-        pidx = table[jnp.arange(X), pos_x // page]
-        r = pos_x % page
-        if quant:
-            k8, k_s = quantize_kv_int8(k.reshape(X, hd))
-            v8, v_s = quantize_kv_int8(v.reshape(X, hd))
-            ck = ck.at[pidx, r].set(k8)
-            cv = cv.at[pidx, r].set(v8)
-            sk = sk.at[pidx, r].set(k_s)
-            sv = sv.at[pidx, r].set(v_s)
-        else:
-            ck = ck.at[pidx, r].set(k.reshape(X, hd).astype(ck.dtype))
-            cv = cv.at[pidx, r].set(v.reshape(X, hd).astype(cv.dtype))
-        lens = pos + 1
-        qd = jnp.bfloat16 if quant else ck.dtype
-        if impl == "flash":
-            o = flash_decode_paged(q.astype(qd), ck, cv, table,
-                                   jnp.max(lens), scale=scale,
-                                   kv_lens=lens, k_scale=sk, v_scale=sv)
-        else:
-            T = table.shape[1] * page
-            kd = dequantize_kv_int8(ck, sk) if quant else ck
-            vd = dequantize_kv_int8(cv, sv) if quant else cv
-            kfull = kd[table].reshape(B, Hkv, T, hd)
-            vfull = vd[table].reshape(B, Hkv, T, hd)
-            o = attention_cached_ref(q.astype(jnp.float32) if quant
-                                     else q.astype(ck.dtype),
-                                     kfull, vfull, lens, scale=scale)
-        o = o.reshape(B, self.n_heads * hd)
-        if quant:
-            return o.astype(qkv.dtype), (ck, cv, sk, sv)
-        return o, (ck, cv)
+        maxp = table.shape[1]
+        # table rows regrouped [B, Hkv, maxp] so the head axis blocks
+        # contiguously per rank (row b*Hkv+h of the flat table is
+        # stream (b, h); rank r owns heads [r*hkv, (r+1)*hkv))
+        table3 = table.reshape(B, Hkv, maxp)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis),) + kv_specs
+                     + (P(None, self.axis, None), P(None)),
+            out_specs=((P(None, self.axis),) + kv_specs),
+            check_vma=False)
+        def f(qkv_loc, ck4, cv4, *rest):
+            *scales4, tbl, pos = rest
+            ck, cv = ck4[:, 0], cv4[:, 0]          # local plane
+            page = ck.shape[1]
+            tbl = tbl.reshape(B * hkv, maxp)       # local streams
+            q = qkv_loc[:, :hq * hd].reshape(B, 1, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(B, 1, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(B, 1, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope_slots(q, cos, sin, pos)
+            k = apply_rope_slots(k, cos, sin, pos)
+            X = B * hkv
+            pos_x = jnp.repeat(pos, hkv)                     # [X]
+            pidx = tbl[jnp.arange(X), pos_x // page]
+            r = pos_x % page
+            if quant:
+                sk, sv = scales4[0][:, 0], scales4[1][:, 0]
+                k8, k_s = quantize_kv_int8(k.reshape(X, hd))
+                v8, v_s = quantize_kv_int8(v.reshape(X, hd))
+                ck = ck.at[pidx, r].set(k8)
+                cv = cv.at[pidx, r].set(v8)
+                sk = sk.at[pidx, r].set(k_s)
+                sv = sv.at[pidx, r].set(v_s)
+            else:
+                ck = ck.at[pidx, r].set(k.reshape(X, hd).astype(ck.dtype))
+                cv = cv.at[pidx, r].set(v.reshape(X, hd).astype(cv.dtype))
+                sk = sv = None
+            lens = pos + 1
+            qd = jnp.bfloat16 if quant else ck.dtype
+            if impl == "flash":
+                o = flash_decode_paged(q.astype(qd), ck, cv, tbl,
+                                       jnp.max(lens), scale=scale,
+                                       kv_lens=lens, k_scale=sk,
+                                       v_scale=sv)
+            else:
+                T = maxp * page
+                kd = dequantize_kv_int8(ck, sk) if quant else ck
+                vd = dequantize_kv_int8(cv, sv) if quant else cv
+                kfull = kd[tbl].reshape(B, hkv, T, hd)
+                vfull = vd[tbl].reshape(B, hkv, T, hd)
+                o = attention_cached_ref(q.astype(jnp.float32) if quant
+                                         else q.astype(ck.dtype),
+                                         kfull, vfull, lens, scale=scale)
+            o = o.reshape(B, hq * hd)
+            if quant:
+                return (o.astype(qkv_loc.dtype), ck[:, None], cv[:, None],
+                        sk[:, None], sv[:, None])
+            return o, ck[:, None], cv[:, None]
+
+        out = f(qkv, *kv, table3, jnp.asarray(pos, jnp.int32))
+        return out[0], tuple(out[1:])
 
     def _attend_paged_slots_verify(self, qkv, cos, sin, batch: int, kv,
                                    table, pos, q_lens,
@@ -761,73 +779,93 @@ class TP_Attn:
         An INT8 pool (kv = 4-tuple with scale planes) quantizes the
         window per position and scatters the scales to the same
         (page, row) destinations — OOB-dropped alongside the payload —
-        exactly like _attend_paged_slots."""
+        exactly like _attend_paged_slots. Runs under jax.shard_map on
+        the head-sharded pool (see _attend_paged_slots): each rank
+        writes and walks only its own kv-head plane."""
         from triton_dist_tpu.kernels.flash_attn import attention_cached_ref
         from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
         from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
                                                    quantize_kv_int8)
-        hd = self.head_dim
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
         Hkv = self.n_kv_heads
         scale = hd ** -0.5
         quant = len(kv) == 4
-        if quant:
-            ck, cv, sk, sv = kv
-        else:
-            ck, cv = kv
-            sk = sv = None
-        NP, page, _ = ck.shape
+        kv_specs = self._paged_specs(quant)
         B = batch
         S = qkv.shape[0] // B
-        q, k, v = self._split_qkv_global(qkv, S)      # [B, S, H, d]
-        if self.q_norm is not None:
-            q = rms_norm(q, self.q_norm)
-        if self.k_norm is not None:
-            k = rms_norm(k, self.k_norm)
-        pos = jnp.asarray(pos, jnp.int32)
-        q_lens = jnp.asarray(q_lens, jnp.int32)
-        q = apply_rope_slots(q, cos, sin, pos)
-        k = apply_rope_slots(k, cos, sin, pos)
+        NP = kv[0].shape[0]
         maxp = table.shape[1]
-        p = pos[:, None] + jnp.arange(S)[None]                 # [B, S]
-        valid = ((jnp.arange(S)[None] < q_lens[:, None])
-                 & (p < maxp * page))
-        streams = (jnp.arange(B) * Hkv)[:, None, None] \
-            + jnp.arange(Hkv)[None, None, :]                   # [B, 1, Hkv]
-        pidx = table[streams, jnp.minimum(p // page, maxp - 1)[:, :, None]]
-        # invalid rows scatter to page NP (out of bounds -> dropped)
-        dest = jnp.where(valid[:, :, None], pidx, NP)          # [B, S, Hkv]
-        r = (p % page)[:, :, None]
-        if quant:
-            k8, k_s = quantize_kv_int8(k)          # [B, S, Hkv, d] / [..]
-            v8, v_s = quantize_kv_int8(v)
-            ck = ck.at[dest, r].set(k8)
-            cv = cv.at[dest, r].set(v8)
-            sk = sk.at[dest, r].set(k_s)
-            sv = sv.at[dest, r].set(v_s)
-        else:
-            ck = ck.at[dest, r].set(k.astype(ck.dtype))
-            cv = cv.at[dest, r].set(v.astype(cv.dtype))
-        lens = pos + q_lens
-        qd = jnp.bfloat16 if quant else ck.dtype
-        if impl == "flash":
-            o = flash_decode_paged(q.astype(qd), ck, cv, table,
-                                   jnp.max(lens), scale=scale,
-                                   kv_lens=lens, q_lens=q_lens,
-                                   k_scale=sk, v_scale=sv)
-        else:
-            T = maxp * page
-            kd = dequantize_kv_int8(ck, sk) if quant else ck
-            vd = dequantize_kv_int8(cv, sv) if quant else cv
-            kfull = kd[table].reshape(B, Hkv, T, hd)
-            vfull = vd[table].reshape(B, Hkv, T, hd)
-            o = attention_cached_ref(q.astype(jnp.float32) if quant
-                                     else q.astype(ck.dtype),
-                                     kfull, vfull, lens, scale=scale,
-                                     q_lens=q_lens)
-        o = o.reshape(B * S, self.n_heads * hd)
-        if quant:
-            return o.astype(qkv.dtype), (ck, cv, sk, sv)
-        return o, (ck, cv)
+        table3 = table.reshape(B, Hkv, maxp)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(None, self.axis),) + kv_specs
+                     + (P(None, self.axis, None), P(None), P(None)),
+            out_specs=((P(None, self.axis),) + kv_specs),
+            check_vma=False)
+        def f(qkv_loc, ck4, cv4, *rest):
+            *scales4, tbl, pos, q_lens = rest
+            ck, cv = ck4[:, 0], cv4[:, 0]
+            page = ck.shape[1]
+            tbl = tbl.reshape(B * hkv, maxp)
+            M = qkv_loc.shape[0]
+            q = qkv_loc[:, :hq * hd].reshape(B, S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(B, S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope_slots(q, cos, sin, pos)
+            k = apply_rope_slots(k, cos, sin, pos)
+            p = pos[:, None] + jnp.arange(S)[None]             # [B, S]
+            valid = ((jnp.arange(S)[None] < q_lens[:, None])
+                     & (p < maxp * page))
+            streams = (jnp.arange(B) * hkv)[:, None, None] \
+                + jnp.arange(hkv)[None, None, :]               # [B, 1, hkv]
+            pidx = tbl[streams,
+                       jnp.minimum(p // page, maxp - 1)[:, :, None]]
+            # invalid rows scatter to page NP (out of bounds -> dropped)
+            dest = jnp.where(valid[:, :, None], pidx, NP)      # [B, S, hkv]
+            r = (p % page)[:, :, None]
+            if quant:
+                sk, sv = scales4[0][:, 0], scales4[1][:, 0]
+                k8, k_s = quantize_kv_int8(k)      # [B, S, hkv, d] / [..]
+                v8, v_s = quantize_kv_int8(v)
+                ck = ck.at[dest, r].set(k8)
+                cv = cv.at[dest, r].set(v8)
+                sk = sk.at[dest, r].set(k_s)
+                sv = sv.at[dest, r].set(v_s)
+            else:
+                ck = ck.at[dest, r].set(k.astype(ck.dtype))
+                cv = cv.at[dest, r].set(v.astype(cv.dtype))
+                sk = sv = None
+            lens = pos + q_lens
+            qd = jnp.bfloat16 if quant else ck.dtype
+            if impl == "flash":
+                o = flash_decode_paged(q.astype(qd), ck, cv, tbl,
+                                       jnp.max(lens), scale=scale,
+                                       kv_lens=lens, q_lens=q_lens,
+                                       k_scale=sk, v_scale=sv)
+            else:
+                T = maxp * page
+                kd = dequantize_kv_int8(ck, sk) if quant else ck
+                vd = dequantize_kv_int8(cv, sv) if quant else cv
+                kfull = kd[tbl].reshape(B, hkv, T, hd)
+                vfull = vd[tbl].reshape(B, hkv, T, hd)
+                o = attention_cached_ref(q.astype(jnp.float32) if quant
+                                         else q.astype(ck.dtype),
+                                         kfull, vfull, lens, scale=scale,
+                                         q_lens=q_lens)
+            o = o.reshape(M, hq * hd)
+            if quant:
+                return (o.astype(qkv_loc.dtype), ck[:, None], cv[:, None],
+                        sk[:, None], sv[:, None])
+            return o, ck[:, None], cv[:, None]
+
+        out = f(qkv, *kv, table3, jnp.asarray(pos, jnp.int32),
+                jnp.asarray(q_lens, jnp.int32))
+        return out[0], tuple(out[1:])
 
     def fwd_cached_slots_paged_verify(self, x, cos, sin, batch: int, kv,
                                       table, pos, q_lens,

@@ -86,6 +86,18 @@ class Engine:
             "engine_verify_dispatches", "spec verify forwards")
         self._c_mixed = _reg.counter(
             "engine_mixed_dispatches", "mixed prefill+decode ticks")
+        # TP comm-backend dispatch counter: every slot/verify/mixed
+        # tick whose backend routes the projections through the
+        # distributed comm kernels (AG-GEMM / GEMM-RS / AR / fused
+        # GEMM+AR) counts here — the observable proof that multi-chip
+        # serving actually exercises the paper's kernels (the TP=N
+        # differential suite asserts it > 0). Complemented by
+        # `comm_kernel_traces` (kernels/*) counting each comm kernel
+        # BUILT into a program at trace time.
+        self._c_comm = _reg.counter(
+            "comm_kernel_dispatches", "slot-path dispatches through "
+                                      "the dist/ar/gemm_ar backends")
+        self._comm_backend = backend in ("dist", "ar", "gemm_ar")
         # int8-quantized models run on EVERY backend: the comm-kernel
         # GEMMs (ag_gemm/gemm_rs/gemm_allreduce) stream int8 weight
         # panels and dequant per column after the dot (exact), so the
@@ -127,116 +139,55 @@ class Engine:
             backend if backend in ("dist", "flash") else
             "flash" if backend == "mega" else "xla")
         # The model is a jit ARGUMENT (weights must not be captured as
-        # program constants — that would bake GBs into the executable)
-        self._prefill = jax.jit(functools.partial(
-            _prefill_fn, mode=self.prefill_backend))
+        # program constants — that would bake GBs into the executable).
+        # The jitted program set is SHARED across Engine instances with
+        # the same (backend, sampling, params, prefill mode) via a
+        # process-wide factory (_jit_programs): jax's compile cache
+        # keys on the python callable, so per-instance functools
+        # partials used to recompile every executable once per engine
+        # — a server fleet (or a test suite) building several engines
+        # over the same model shapes paid the whole compile bill each
+        # time. Sharing is safe because every per-engine mutable piece
+        # (scratch caches, dispatch counters) stays on the instance and
+        # the model rides in as a traced argument.
         if backend == "mega":
-            scan_fn = _mega_scan_decode_fn
-        elif sampling == "greedy":
-            scan_fn = functools.partial(_scan_decode_fn, backend)
-        else:
-            scan_fn = functools.partial(_sampled_scan_decode_fn, backend,
-                                        sampling, self._sample_params)
-        self._decode_scan = jax.jit(
-            scan_fn, static_argnames=("gen_len",), donate_argnums=(2,))
-        # slot-masked chunked decode (continuous batching,
-        # models/scheduler.py): compiled lazily on first admit — the
-        # uniform-batch paths never pay for it
-        if backend != "mega":
-            slot_fn = (functools.partial(_slot_scan_decode_fn, backend)
-                       if sampling == "greedy" else
-                       functools.partial(_sampled_slot_scan_decode_fn,
-                                         backend, sampling,
-                                         self._sample_params))
-            self._slot_scan = jax.jit(
-                slot_fn, static_argnames=("gen_len",), donate_argnums=(2,))
-            self._prefill_slot = jax.jit(
-                functools.partial(_prefill_slot_fn,
-                                  mode=self.prefill_backend),
+            self._prefill = jax.jit(functools.partial(
+                _prefill_fn, mode=self.prefill_backend))
+            self._decode_scan = jax.jit(
+                _mega_scan_decode_fn, static_argnames=("gen_len",),
                 donate_argnums=(2,))
-            self._write_slot = jax.jit(_write_slot_fn, donate_argnums=(0,))
+        else:
+            progs = _jit_programs(backend, sampling,
+                                  _params_key(self._sample_params),
+                                  self.prefill_backend)
+            self._prefill = progs["prefill"]
+            self._decode_scan = progs["decode_scan"]
+            # slot-masked chunked decode (continuous batching,
+            # models/scheduler.py) + the paged/verify/mixed program
+            # family — all lazy-compiled on first use (the program
+            # roles are documented on _jit_programs)
+            self._slot_scan = progs["slot_scan"]
+            self._prefill_slot = progs["prefill_slot"]
+            self._write_slot = progs["write_slot"]
             # persistent 1-row scratch for prefill_into_slot, donated
             # through each admission instead of reallocated per request
             self._slot_scratch = None
-            # paged slot path (shared-prefix serving,
-            # models/prefix_cache.py): admission program (table install
-            # + copy-on-write + prefix gather + suffix prefill-from-
-            # offset + KV scatter), chunked slot scan over the paged
-            # pool, and the retire-time table reset. All lazy-compiled.
-            paged_fn = (functools.partial(_paged_slot_scan_decode_fn,
-                                          backend)
-                        if sampling == "greedy" else
-                        functools.partial(_sampled_paged_slot_scan_fn,
-                                          backend, sampling,
-                                          self._sample_params))
-            self._paged_slot_scan = jax.jit(
-                paged_fn, static_argnames=("gen_len",), donate_argnums=(2,))
-            self._paged_admit = jax.jit(
-                functools.partial(_paged_admit_fn,
-                                  mode=self.prefill_backend),
-                donate_argnums=(2, 3))
-            self._paged_set_table = jax.jit(_paged_set_table_fn,
-                                            donate_argnums=(0,))
+            self._paged_slot_scan = progs["paged_slot_scan"]
+            self._paged_admit = progs["paged_admit"]
+            self._paged_set_table = progs["paged_set_table"]
             self._paged_scratch = None
-            # speculative-decoding verify programs (models/spec_decode.py
-            # drives these through the scheduler's spec=K mode): ONE
-            # forward scores every slot's padded draft window, and the
-            # accept rule runs in the same program — the host reads back
-            # only (n_emit, next seed token). Lazy-compiled.
-            if sampling == "greedy":
-                vfn = functools.partial(_slot_verify_fn, backend)
-                pvfn = functools.partial(_paged_slot_verify_fn, backend)
-            else:
-                vfn = functools.partial(_sampled_slot_verify_fn, backend,
-                                        sampling, self._sample_params)
-                pvfn = functools.partial(_sampled_paged_slot_verify_fn,
-                                         backend, sampling,
-                                         self._sample_params)
-                self._spec_seed = jax.jit(functools.partial(
-                    _spec_seed_fn, sampling, self._sample_params))
-            self._slot_verify = jax.jit(vfn, donate_argnums=(1,))
-            self._paged_slot_verify = jax.jit(pvfn, donate_argnums=(1,))
-            # chunked-prefill mixed ticks (Sarathi-Serve-style stall-free
-            # batching, models/scheduler.py step_mixed): ONE forward per
-            # tick covers live decode slots (q_len = 1, or the spec
-            # window) AND a token-budgeted chunk of every in-progress
-            # prefill (q_len = chunk) through the SAME per-slot
-            # q_lens/kv_lens masks the verify programs ride. Lazy-
-            # compiled; one executable per mixed window width.
-            samp = None if sampling == "greedy" else sampling
-            self._slot_mixed = jax.jit(
-                functools.partial(_mixed_step_fn, backend, samp,
-                                  self._sample_params, False),
-                donate_argnums=(2,))
-            self._paged_slot_mixed = jax.jit(
-                functools.partial(_mixed_step_fn, backend, samp,
-                                  self._sample_params, True),
-                donate_argnums=(2,))
-            self._slot_mixed_verify = jax.jit(
-                functools.partial(_mixed_verify_fn, backend, samp,
-                                  self._sample_params, False),
-                donate_argnums=(1,))
-            self._paged_slot_mixed_verify = jax.jit(
-                functools.partial(_mixed_verify_fn, backend, samp,
-                                  self._sample_params, True),
-                donate_argnums=(1,))
-            # chunk-0 of a chunked paged admission: table install +
-            # boundary-page copy-on-write, with the suffix forward left
-            # to the mixed-chunk ticks (_paged_admit_fn minus the
-            # prefill)
-            self._paged_install = jax.jit(_paged_install_fn,
-                                          donate_argnums=(0,))
-            # host KV tier (models/kv_tier.py + models/prefix_cache.py
-            # residency machine): ONE gather program extracts a demoted
-            # span's pages across every layer's pool (d2h at evict
-            # time), ONE scatter installs a promoted span into freshly
-            # allocated pages (h2d before the uncached-suffix prefill).
-            # Page-id lists are trash-padded to pad_to buckets so the
-            # executable count is bounded (trash reads are discarded,
-            # trash writes are the sanctioned sink).
-            self._gather_pages = jax.jit(_gather_pages_fn)
-            self._restore_pages = jax.jit(_restore_pages_fn,
-                                          donate_argnums=(0,))
+            if sampling != "greedy":
+                self._spec_seed = progs["spec_seed"]
+            self._slot_verify = progs["slot_verify"]
+            self._paged_slot_verify = progs["paged_slot_verify"]
+            self._slot_mixed = progs["slot_mixed"]
+            self._paged_slot_mixed = progs["paged_slot_mixed"]
+            self._slot_mixed_verify = progs["slot_mixed_verify"]
+            self._paged_slot_mixed_verify = \
+                progs["paged_slot_mixed_verify"]
+            self._paged_install = progs["paged_install"]
+            self._gather_pages = progs["gather_pages"]
+            self._restore_pages = progs["restore_pages"]
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -335,6 +286,8 @@ class Engine:
             raise ValueError("backend='mega' carries no resumable "
                              "slot state; use the per-op backends")
         self._c_decode.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
             toks, logits, cache, pos = self._slot_scan(
@@ -377,6 +330,8 @@ class Engine:
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         self._c_verify.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
             n_emit, t0n, cache, pos = self._slot_verify(
@@ -397,6 +352,8 @@ class Engine:
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         self._c_verify.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
             n_emit, t0n, pcache, pos = self._paged_slot_verify(
@@ -445,6 +402,8 @@ class Engine:
         if self.sampling == "greedy":
             assert keys is None
         self._c_mixed.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         return self._slot_mixed(self.model, logits, cache, pos, active,
                                 prefilling, tokens, q_lens, keys)
 
@@ -460,6 +419,8 @@ class Engine:
         if self.sampling == "greedy":
             assert keys is None
         self._c_mixed.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         return self._paged_slot_mixed(self.model, logits, pcache, pos,
                                       active, prefilling, tokens, q_lens,
                                       keys)
@@ -483,6 +444,8 @@ class Engine:
         if self.sampling == "greedy":
             assert keys is None
         self._c_mixed.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         return self._slot_mixed_verify(self.model, cache, pos, active,
                                        prefilling, tokens, q_lens, keys)
 
@@ -496,6 +459,8 @@ class Engine:
         if self.sampling == "greedy":
             assert keys is None
         self._c_mixed.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         return self._paged_slot_mixed_verify(self.model, pcache, pos,
                                              active, prefilling, tokens,
                                              q_lens, keys)
@@ -539,7 +504,16 @@ class Engine:
         kv_dtype=int8 engines get the INT8 POOL (per-position scale
         planes riding the page payload — kv_cache.PagedSlotCache):
         half the decode KV read, double the resident pages, streams
-        bitwise equal to the contiguous int8 cache."""
+        bitwise equal to the contiguous int8 cache.
+
+        TP: the pool's page payloads are HEAD-SHARDED over the model's
+        mesh (kv_cache.PagedSlotCache TP SHARDING) and the slot
+        programs run each chip's attention over its local kv-head
+        shard under shard_map — one scheduler drives the whole TP=N
+        mesh. The mesh size must divide n_kv_heads (validated here
+        with a real error instead of a shard shape mismatch deep in
+        compile); GQA replication (num_heads > num_kv_heads) is a
+        query-side property and changes nothing about the pool split."""
         from triton_dist_tpu.models.kv_cache import PagedSlotCache
         if self.backend == "mega":
             raise ValueError("backend='mega' has no resumable slot "
@@ -550,13 +524,24 @@ class Engine:
                 f"{type(self.model).__name__} has no paged slot decode "
                 "path (dense models only)")
         cfg = self.model.config
+        tp = self.model.mesh.shape[self.model.axis]
+        if cfg.num_kv_heads % tp:
+            rep = cfg.num_heads // max(cfg.num_kv_heads, 1)
+            raise ValueError(
+                f"paged TP serving needs num_kv_heads "
+                f"({cfg.num_kv_heads}) divisible by the TP mesh size "
+                f"({tp}); this model's GQA replication factor is {rep} "
+                f"(query heads replicate per kv head, but the KV pool "
+                f"itself splits on kv heads) — serve on a mesh whose "
+                f"size divides {cfg.num_kv_heads}, or replicate kv "
+                f"heads in the checkpoint")
         maxp = -(-self.max_seq // page)
         if num_pages is None:
             num_pages = batch * cfg.num_kv_heads * maxp + 1
         return PagedSlotCache.create(
             cfg.num_layers, batch, self.max_seq, cfg.num_kv_heads,
             cfg.head_dim, page=page, num_pages=num_pages,
-            mesh=self.model.mesh,
+            mesh=self.model.mesh, axis=self.model.axis,
             dtype=self.kv_dtype or cfg.jax_dtype)
 
     def admit_slot_paged(self, pcache, slot: int, ids, rows,
@@ -612,6 +597,8 @@ class Engine:
         row's table maps the trash page, so its masked-out writes can
         never touch a live or cached page)."""
         self._c_decode.inc()
+        if self._comm_backend:
+            self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
             toks, logits, pcache, pos = self._paged_slot_scan(
@@ -638,7 +625,8 @@ class Engine:
     # the PagedDecodeSlots callbacks.
     # ------------------------------------------------------------------
 
-    def extract_pages_host(self, pcache, page_ids, *, pad_to: int = 8):
+    def extract_pages_host(self, pcache, page_ids, *, heads=None,
+                           pad_to: int = 8):
         """DEMOTION d2h: gather the listed physical pages out of every
         layer's K/V pool and return them as host arrays
         (k, v each [L, N, page, d], pool dtype — the raw bytes, so a
@@ -648,17 +636,35 @@ class Engine:
         executable count; the padded reads are sliced off before
         returning). The gather is dispatched async — the device_get
         below is the synchronization point, i.e. the copy overlaps
-        whatever was already in flight."""
+        whatever was already in flight.
+
+        heads: the kv-head index behind each page id (page groups are
+        head-ordered, so callers always know it — the scheduler's tier
+        callback passes tile(arange(Hkv))). REQUIRED on a TP-sharded
+        pool (head_groups > 1): it selects each page's owning payload
+        plane so the gathered bytes are the true ones; ignored on a
+        single-group pool."""
         if self.backend == "mega":
             raise ValueError("backend='mega' has no paged pool to "
                              "demote from; use the per-op backends")
         import numpy as np
         ids = np.asarray(page_ids, np.int32).reshape(-1)
         n = len(ids)
+        G = pcache.head_groups
+        if G > 1 and heads is None:
+            raise ValueError(
+                "extract_pages_host on a TP-sharded pool needs the "
+                "per-page kv-head indices (heads=...) to pick each "
+                "page's owning payload plane")
         P = max(-(-n // pad_to) * pad_to, pad_to)
         padded = np.full((P,), pcache.trash, np.int32)
         padded[:n] = ids
-        out = self._gather_pages(pcache, jnp.asarray(padded))
+        owners = np.zeros((P,), np.int32)
+        if heads is not None and G > 1:
+            hkv_loc = self.model.config.num_kv_heads // G
+            owners[:n] = np.asarray(heads, np.int32) // hkv_loc
+        out = self._gather_pages(pcache, jnp.asarray(padded),
+                                 jnp.asarray(owners))
         # one device_get over every array: the K/V (and scale) d2h
         # transfers overlap instead of serializing on the eviction
         # critical path
@@ -707,6 +713,108 @@ class Engine:
         return self._restore_pages(pcache, jnp.asarray(padded),
                                    jnp.asarray(hk), jnp.asarray(hv),
                                    hsk, hsv)
+
+
+def _params_key(params: dict) -> tuple:
+    """Hashable key of the sampling params dict (the _jit_programs
+    cache key component)."""
+    return (params["temperature"], params["k"], params["p"])
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_programs(backend: str, sampling: str, pkey: tuple,
+                  prefill_mode: str) -> dict:
+    """The engine's jitted program set, ONE per (backend, sampling,
+    params, prefill-mode) configuration process-wide.
+
+    jax's executable cache keys on the python callable object, so
+    building these per Engine instance (the old per-__init__ partials)
+    recompiled every program once per engine — serving restarts, test
+    suites, and TP-vs-single-chip differentials all paid the whole
+    compile bill repeatedly for identical configurations. The model is
+    a traced ARGUMENT of every program (weights never bake in), and
+    all mutable per-engine state (scratch caches, counters) lives on
+    the instance, so sharing the jit wrappers is purely a
+    compile-cache win. Contents:
+
+    - prefill / decode_scan: the uniform-batch serve() pair;
+    - slot_scan / prefill_slot / write_slot: continuous batching
+      (models/scheduler.py) — slot-masked chunked decode + the
+      bucketed prefill-into-slot pair;
+    - paged_slot_scan / paged_admit / paged_set_table /
+      paged_install: the shared-prefix paged pool family (admission =
+      table install + CoW + prefix gather + suffix
+      prefill-from-offset + KV scatter; retire = table reset);
+    - slot_verify / paged_slot_verify (+ spec_seed under sampling):
+      speculative-decoding verify forwards with the on-device accept;
+    - slot_mixed / paged_slot_mixed (+ _verify twins): the chunked-
+      prefill mixed prefill+decode ticks;
+    - gather_pages / restore_pages: the host-KV-tier d2h/h2d pair.
+
+    All lazy-compiled: a path never exercised costs nothing."""
+    params = dict(temperature=pkey[0], k=pkey[1], p=pkey[2])
+    greedy = sampling == "greedy"
+    P = {}
+    P["prefill"] = jax.jit(functools.partial(_prefill_fn,
+                                             mode=prefill_mode))
+    scan_fn = (functools.partial(_scan_decode_fn, backend) if greedy
+               else functools.partial(_sampled_scan_decode_fn, backend,
+                                      sampling, params))
+    P["decode_scan"] = jax.jit(scan_fn, static_argnames=("gen_len",),
+                               donate_argnums=(2,))
+    slot_fn = (functools.partial(_slot_scan_decode_fn, backend)
+               if greedy else
+               functools.partial(_sampled_slot_scan_decode_fn, backend,
+                                 sampling, params))
+    P["slot_scan"] = jax.jit(slot_fn, static_argnames=("gen_len",),
+                             donate_argnums=(2,))
+    P["prefill_slot"] = jax.jit(
+        functools.partial(_prefill_slot_fn, mode=prefill_mode),
+        donate_argnums=(2,))
+    P["write_slot"] = jax.jit(_write_slot_fn, donate_argnums=(0,))
+    paged_fn = (functools.partial(_paged_slot_scan_decode_fn, backend)
+                if greedy else
+                functools.partial(_sampled_paged_slot_scan_fn, backend,
+                                  sampling, params))
+    P["paged_slot_scan"] = jax.jit(paged_fn,
+                                   static_argnames=("gen_len",),
+                                   donate_argnums=(2,))
+    P["paged_admit"] = jax.jit(
+        functools.partial(_paged_admit_fn, mode=prefill_mode),
+        donate_argnums=(2, 3))
+    P["paged_set_table"] = jax.jit(_paged_set_table_fn,
+                                   donate_argnums=(0,))
+    if greedy:
+        vfn = functools.partial(_slot_verify_fn, backend)
+        pvfn = functools.partial(_paged_slot_verify_fn, backend)
+    else:
+        vfn = functools.partial(_sampled_slot_verify_fn, backend,
+                                sampling, params)
+        pvfn = functools.partial(_sampled_paged_slot_verify_fn, backend,
+                                 sampling, params)
+        P["spec_seed"] = jax.jit(functools.partial(_spec_seed_fn,
+                                                   sampling, params))
+    P["slot_verify"] = jax.jit(vfn, donate_argnums=(1,))
+    P["paged_slot_verify"] = jax.jit(pvfn, donate_argnums=(1,))
+    samp = None if greedy else sampling
+    P["slot_mixed"] = jax.jit(
+        functools.partial(_mixed_step_fn, backend, samp, params, False),
+        donate_argnums=(2,))
+    P["paged_slot_mixed"] = jax.jit(
+        functools.partial(_mixed_step_fn, backend, samp, params, True),
+        donate_argnums=(2,))
+    P["slot_mixed_verify"] = jax.jit(
+        functools.partial(_mixed_verify_fn, backend, samp, params,
+                          False),
+        donate_argnums=(1,))
+    P["paged_slot_mixed_verify"] = jax.jit(
+        functools.partial(_mixed_verify_fn, backend, samp, params,
+                          True),
+        donate_argnums=(1,))
+    P["paged_install"] = jax.jit(_paged_install_fn, donate_argnums=(0,))
+    P["gather_pages"] = jax.jit(_gather_pages_fn)
+    P["restore_pages"] = jax.jit(_restore_pages_fn, donate_argnums=(0,))
+    return P
 
 
 def _prefill_fn(model, ids, cache, *, mode):
@@ -977,6 +1085,52 @@ def _mixed_verify_fn(backend, sampling, params, paged, model, cache, pos,
     return n_emit, t0n, sel_logits, cache, pos, keys
 
 
+def _pool_gather_heads(mesh, axis, pool, rows):
+    """Head-aligned pool gather (the admit program's prefix read on
+    the TP-sharded pool): rows [Hkv, maxp] page ids -> the mapped
+    pages' bytes [Hkv, maxp*page(, d)], each rank reading its OWN
+    kv-head group's plane of the [NP, G, page(, d)] pool. Comm-free by
+    construction — the output is head-sharded exactly like the
+    contiguous scratch it fills."""
+    from jax.sharding import PartitionSpec as P
+    if pool.ndim == 4:
+        in_p, out_p = P(None, axis, None, None), P(axis, None, None)
+    else:
+        in_p, out_p = P(None, axis, None), P(axis, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(in_p, P(axis, None)), out_specs=out_p,
+                       check_vma=False)
+    def f(p_loc, rows_loc):
+        g = p_loc[:, 0][rows_loc]        # [h_loc, maxp, page(, d)]
+        return g.reshape((g.shape[0], -1) + g.shape[3:])
+
+    return f(pool, rows)
+
+
+def _pool_scatter_heads(mesh, axis, pool, dest, ri, u):
+    """Head-aligned pool scatter (the admit program's suffix
+    write-back): u [Hkv, S(, d)] — a head-sharded scratch slice — lands
+    at (dest [Hkv, S] page ids, ri [S] in-page rows) of each rank's
+    own plane of the [NP, G, page(, d)] pool. Trash dest ids are the
+    sanctioned sink (pad-bucket tail rows)."""
+    from jax.sharding import PartitionSpec as P
+    if pool.ndim == 4:
+        in_p, u_p = P(None, axis, None, None), P(axis, None, None)
+    else:
+        in_p, u_p = P(None, axis, None), P(axis, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(in_p, P(axis, None), P(None), u_p),
+                       out_specs=in_p, check_vma=False)
+    def f(p_loc, dest_loc, ri, u_loc):
+        p2 = p_loc[:, 0].at[dest_loc, ri[None]].set(
+            u_loc.astype(p_loc.dtype))
+        return p2[:, None]
+
+    return f(pool, dest, ri, u)
+
+
 def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
     """Table install + boundary-page copy-on-write for a CHUNKED paged
     admission (chunk 0): exactly the pre-forward half of
@@ -984,13 +1138,18 @@ def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
     the slot's table — the boundary page's valid rows [0, cow_r) are
     copied from the shared original into the slot's own fresh page,
     which then receives the request's diverging writes. An int8 pool
-    copies the boundary page's scale rows alongside."""
+    copies the boundary page's scale rows alongside.
+
+    TP pool ([NP, G, page, d]): the CoW copies ALL G planes of the
+    boundary page — only the owning head's plane holds real bytes, but
+    copying the others' garbage is harmless (never read) and keeps the
+    copy a plain plane-aligned gather/scatter GSPMD keeps local."""
     import dataclasses
     page = pcache.page
     Hkv = rows.shape[0]
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
-    rowmask = (jnp.arange(page) < cow_r)[None, :, None]
+    rowmask = (jnp.arange(page) < cow_r)[None, None, :, None]
     rowmask2 = rowmask[..., 0]
     pk, pv, psk, psv = [], [], [], []
     for li in range(len(pcache.pages_k)):
@@ -1027,17 +1186,26 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
     writes the scales the forward's quantizer produced back beside the
     payload. The scratch is an int8 KVCache whenever the pool is (both
     derive from engine.kv_dtype), so the two branches can never be
-    mismatched."""
+    mismatched.
+
+    TP pool ([NP, G, page, d] head-sharded): the prefix gather and the
+    suffix scatter run HEAD-ALIGNED under shard_map
+    (_pool_gather_heads / _pool_scatter_heads) — each rank moves its
+    own kv heads' page bytes between its pool plane and its shard of
+    the (head-sharded) contiguous scratch, so the whole admission
+    stays ONE sharded program with zero cross-chip page traffic; the
+    CoW copies all planes (garbage planes are never read)."""
     import dataclasses
     page = pcache.page
     Hkv, maxp = rows.shape
     T_pool = maxp * page
-    d = pcache.pages_k[0].shape[2]
+    d = pcache.pages_k[0].shape[3]
+    mesh, axis = model.mesh, model.axis
     quant = bool(pcache.scales_k)
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
-    rowmask = (jnp.arange(page) < cow_r)[None, :, None]
-    rowmask2 = rowmask[..., 0]                       # [1, page] (scales)
+    rowmask = (jnp.arange(page) < cow_r)[None, None, :, None]
+    rowmask2 = rowmask[..., 0]                  # [1, 1, page] (scales)
     S_pad = ids.shape[1]
     p = m + jnp.arange(S_pad)
     valid = p < n
@@ -1053,8 +1221,8 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
             jnp.where(rowmask, pk[li][cow_src], pk[li][cow_dst]))
         pv[li] = pv[li].at[cow_dst].set(
             jnp.where(rowmask, pv[li][cow_src], pv[li][cow_dst]))
-        kf = pk[li][rows].reshape(1, Hkv, T_pool, d)
-        vf = pv[li][rows].reshape(1, Hkv, T_pool, d)
+        kf = _pool_gather_heads(mesh, axis, pk[li], rows)[None]
+        vf = _pool_gather_heads(mesh, axis, pv[li], rows)[None]
         sk[li] = jax.lax.dynamic_update_slice(
             sk[li], kf.astype(sk[li].dtype), (0, 0, 0, 0))
         sv[li] = jax.lax.dynamic_update_slice(
@@ -1064,8 +1232,8 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
                 jnp.where(rowmask2, psk[li][cow_src], psk[li][cow_dst]))
             psv[li] = psv[li].at[cow_dst].set(
                 jnp.where(rowmask2, psv[li][cow_src], psv[li][cow_dst]))
-            ksf = psk[li][rows].reshape(1, Hkv, T_pool)
-            vsf = psv[li][rows].reshape(1, Hkv, T_pool)
+            ksf = _pool_gather_heads(mesh, axis, psk[li], rows)[None]
+            vsf = _pool_gather_heads(mesh, axis, psv[li], rows)[None]
             ssk[li] = jax.lax.dynamic_update_slice(ssk[li], ksf,
                                                    (0, 0, 0))
             ssv[li] = jax.lax.dynamic_update_slice(ssv[li], vsf,
@@ -1081,15 +1249,17 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
                                    (1, Hkv, S_pad, d))[0]
         vs = jax.lax.dynamic_slice(scratch.v[li], (0, 0, m, 0),
                                    (1, Hkv, S_pad, d))[0]
-        pk2.append(pk[li].at[dest, ri[None]].set(ks.astype(pk[li].dtype)))
-        pv2.append(pv[li].at[dest, ri[None]].set(vs.astype(pv[li].dtype)))
+        pk2.append(_pool_scatter_heads(mesh, axis, pk[li], dest, ri, ks))
+        pv2.append(_pool_scatter_heads(mesh, axis, pv[li], dest, ri, vs))
         if quant:
             kss = jax.lax.dynamic_slice(scratch.ks[li], (0, 0, m),
                                         (1, Hkv, S_pad))[0]
             vss = jax.lax.dynamic_slice(scratch.vs[li], (0, 0, m),
                                         (1, Hkv, S_pad))[0]
-            psk2.append(psk[li].at[dest, ri[None]].set(kss))
-            psv2.append(psv[li].at[dest, ri[None]].set(vss))
+            psk2.append(_pool_scatter_heads(mesh, axis, psk[li], dest,
+                                            ri, kss))
+            psv2.append(_pool_scatter_heads(mesh, axis, psv[li], dest,
+                                            ri, vss))
     pcache = dataclasses.replace(pcache, pages_k=tuple(pk2),
                                  pages_v=tuple(pv2),
                                  scales_k=tuple(psk2),
@@ -1105,16 +1275,28 @@ def _paged_set_table_fn(pcache, rows, slot):
     return dataclasses.replace(pcache, table=table)
 
 
-def _gather_pages_fn(pcache, ids):
+def _gather_pages_fn(pcache, ids, owners):
     """Host-tier demotion gather: the listed pages of every layer's
     pool, stacked [L, N, page, d] (one program per id-bucket shape).
     An int8 pool also gathers the scale planes [L, N, page] — a
-    demoted page's scales are part of its bytes."""
-    k = jnp.stack([p[ids] for p in pcache.pages_k])
-    v = jnp.stack([p[ids] for p in pcache.pages_v])
+    demoted page's scales are part of its bytes.
+
+    TP pool: `owners` [N] int32 is each page's owning HEAD-GROUP plane
+    (the caller knows the kv head behind every id — page groups are
+    head-ordered); the gather selects that plane, so the returned
+    bytes are the TRUE payload whatever the mesh (take_along_axis
+    moves bytes — no arithmetic — so the d2h/h2d round trip stays
+    bitwise on sharded pools)."""
+    def pick(p):
+        g = p[ids]                            # [N, G, page(, d)]
+        idx = owners.reshape((-1, 1) + (1,) * (g.ndim - 2))
+        return jnp.take_along_axis(g, idx, axis=1)[:, 0]
+
+    k = jnp.stack([pick(p) for p in pcache.pages_k])
+    v = jnp.stack([pick(p) for p in pcache.pages_v])
     if pcache.scales_k:
-        sk = jnp.stack([s[ids] for s in pcache.scales_k])
-        sv = jnp.stack([s[ids] for s in pcache.scales_v])
+        sk = jnp.stack([pick(s) for s in pcache.scales_k])
+        sv = jnp.stack([pick(s) for s in pcache.scales_v])
         return k, v, sk, sv
     return k, v
 
@@ -1124,17 +1306,27 @@ def _restore_pages_fn(pcache, ids, hk, hv, hsk=None, hsv=None):
     the listed pages of every layer's pool (donated). Padded tail ids
     all point at the trash page — duplicate scatter targets there are
     fine, trash content is never read. Int8 pools restore the scale
-    planes from hsk/hsv [L, N, page] in the same program."""
+    planes from hsk/hsv [L, N, page] in the same program.
+
+    TP pool: the payload broadcasts into ALL G head-group planes of
+    each restored page — the owning plane gets the true bytes and the
+    others hold copies nothing ever reads (freshly allocated pages are
+    garbage until written anyway), which keeps the scatter plane-
+    aligned and comm-free instead of needing per-rank owner masks."""
     import dataclasses
-    pk = tuple(p.at[ids].set(hk[li].astype(p.dtype))
-               for li, p in enumerate(pcache.pages_k))
-    pv = tuple(p.at[ids].set(hv[li].astype(p.dtype))
-               for li, p in enumerate(pcache.pages_v))
+
+    def put(p, h):
+        u = jnp.broadcast_to(h[:, None],
+                             (h.shape[0], p.shape[1]) + h.shape[1:])
+        return p.at[ids].set(u.astype(p.dtype))
+
+    pk = tuple(put(p, hk[li]) for li, p in enumerate(pcache.pages_k))
+    pv = tuple(put(p, hv[li]) for li, p in enumerate(pcache.pages_v))
     out = dataclasses.replace(pcache, pages_k=pk, pages_v=pv)
     if pcache.scales_k:
-        psk = tuple(s.at[ids].set(hsk[li])
+        psk = tuple(put(s, hsk[li])
                     for li, s in enumerate(pcache.scales_k))
-        psv = tuple(s.at[ids].set(hsv[li])
+        psv = tuple(put(s, hsv[li])
                     for li, s in enumerate(pcache.scales_v))
         out = dataclasses.replace(out, scales_k=psk, scales_v=psv)
     return out

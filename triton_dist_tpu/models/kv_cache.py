@@ -101,8 +101,9 @@ class PagedSlotCache:
     """Multi-layer paged KV cache for the continuous-batching slot path
     (models/prefix_cache.py policy over kernels/paged_kv.py mechanics).
 
-    Per-layer physical pools pages_k/v [NP, page, d] (one page = `page`
-    contiguous positions of ONE (slot, kv-head) stream) behind ONE
+    Per-layer physical pools pages_k/v [NP, G, page, d] (one page =
+    `page` contiguous positions of ONE (slot, kv-head) stream; G is
+    the TP head-group axis — see TP SHARDING below) behind ONE
     shared page table [B*Hkv, max_pages]: a physical page id means the
     same row in EVERY layer's pool, so the host allocator hands out one
     [Hkv] page-id group per logical tile and it covers all layers.
@@ -122,7 +123,7 @@ class PagedSlotCache:
     INT8 POOL (dtype=jnp.int8 — the KV-quantization design of KIVI,
     arXiv:2402.02750, specialized to per-position symmetric scales;
     PAPERS.md): the page payload stores int8 and per-layer scale
-    planes scales_k/scales_v [NP, page] f32 ride ALONGSIDE it — a
+    planes scales_k/scales_v [NP, G, page] f32 ride ALONGSIDE it — a
     physical page id addresses its payload AND its scales in every
     layer, so the host allocator, the radix prefix tree, the
     copy-on-write boundary copy and the host-tier d2h/h2d extract/
@@ -133,13 +134,34 @@ class PagedSlotCache:
     dequants in-kernel by logit/P scaling, so paged-int8 streams are
     bitwise identical to the contiguous-int8 reference while the
     decode step's dominant HBM read halves and the same pool holds
-    ~2x the resident pages."""
+    ~2x the resident pages.
 
-    pages_k: Tuple[jax.Array, ...]   # L x [NP, page, d]
+    TP SHARDING (the multi-chip serving layout — ROADMAP open item 1;
+    the head-axis split of the contiguous KVCache carried over to the
+    paged pool): page payloads carry a HEAD-GROUP axis G = the TP
+    mesh size, [NP, G, page, d] sharded NamedSharding(mesh, P(None,
+    axis, None, None)) — chip g's plane holds the page bytes of ITS
+    Hkv/G kv heads and nothing else ever reads or writes it. The
+    page-id space is NOT split: the host allocator, refcounts, radix
+    tree, CoW and LRU policy (models/prefix_cache.py) hand out the
+    same ids whatever the mesh, and the replicated page table
+    resolves a (slot, head) stream to a page id exactly as on one
+    chip — the stream's kv head decides the PLANE, and that decision
+    is static per stream, so the slot attends (layers/tp_attn.py
+    _attend_paged_slots*) run under jax.shard_map with each chip
+    walking only its local shard: 1/G of the decode step's KV read
+    and attention FLOPs per chip, with the QKV/O projections riding
+    the TP comm backends (kernels/gemm_allreduce.py et al.). Planes
+    of a page outside its owning head's group hold garbage by design
+    (never read — the same argument that lets retired pages keep
+    stale bytes); the host-tier d2h gather selects the owning plane
+    per page (Engine.extract_pages_host heads=...)."""
+
+    pages_k: Tuple[jax.Array, ...]   # L x [NP, G, page, d]
     pages_v: Tuple[jax.Array, ...]
     table: jax.Array                 # [B*Hkv, max_pages] int32
-    # int8 pool only: per-position dequant scales, L x [NP, page] f32
-    # (empty tuples for the bf16 pool — a pytree-stable "absent")
+    # int8 pool only: per-position dequant scales, L x [NP, G, page]
+    # f32 (empty tuples for the bf16 pool — a pytree-stable "absent")
     scales_k: Tuple[jax.Array, ...] = ()
     scales_v: Tuple[jax.Array, ...] = ()
     trash: int = dataclasses.field(default=0, metadata=dict(static=True))
@@ -147,20 +169,30 @@ class PagedSlotCache:
     @staticmethod
     def create(num_layers: int, batch: int, max_seq: int, n_kv_heads: int,
                head_dim: int, *, page: int, num_pages: int, mesh: Mesh,
-               dtype=jnp.bfloat16, trash: int = 0) -> "PagedSlotCache":
+               axis: str = "tp", dtype=jnp.bfloat16,
+               trash: int = 0) -> "PagedSlotCache":
         maxp = -(-max_seq // page)
         X = batch * n_kv_heads
-        rep = NamedSharding(mesh, P(None, None, None))
+        G = mesh.shape[axis]
+        if n_kv_heads % G:
+            raise ValueError(
+                f"paged pool needs n_kv_heads ({n_kv_heads}) divisible "
+                f"by the TP mesh size ({G}): each chip owns a whole "
+                f"kv-head group of the page payloads. GQA replication "
+                f"(Hq > Hkv) lives on the QUERY side and does not "
+                f"relax this — replicate KV heads in the checkpoint "
+                f"or shrink the mesh.")
+        shd = NamedSharding(mesh, P(None, axis, None, None))
         mk = lambda: tuple(
-            jax.device_put(jnp.zeros((num_pages, page, head_dim), dtype),
-                           rep)
+            jax.device_put(
+                jnp.zeros((num_pages, G, page, head_dim), dtype), shd)
             for _ in range(num_layers))
         sk = sv = ()
         if jnp.dtype(dtype) == jnp.int8:
-            s_rep = NamedSharding(mesh, P(None, None))
+            s_shd = NamedSharding(mesh, P(None, axis, None))
             mks = lambda: tuple(
-                jax.device_put(jnp.zeros((num_pages, page), jnp.float32),
-                               s_rep)
+                jax.device_put(
+                    jnp.zeros((num_pages, G, page), jnp.float32), s_shd)
                 for _ in range(num_layers))
             sk, sv = mks(), mks()
         table = jax.device_put(
@@ -175,11 +207,17 @@ class PagedSlotCache:
 
     @property
     def page(self) -> int:
-        return self.pages_k[0].shape[1]
+        return self.pages_k[0].shape[2]
 
     @property
     def num_pages(self) -> int:
         return self.pages_k[0].shape[0]
+
+    @property
+    def head_groups(self) -> int:
+        """The TP head-group axis G (mesh size at creation): payload
+        plane g holds the bytes of kv-head group g's pages."""
+        return self.pages_k[0].shape[1]
 
     @property
     def capacity(self) -> int:

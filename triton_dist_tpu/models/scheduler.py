@@ -132,6 +132,20 @@ timeline: host phase spans, device occupancy (dispatch → `_fetch`
 landing), and instants for watchdog fires / preemptions / drains.
 Tracing is host-side only: streams stay BITWISE identical trace-on
 vs trace-off with zero new XLA programs (tests/test_telemetry.py).
+
+Multi-chip TP (ROADMAP open item 1): ONE scheduler drives a whole
+TP=N mesh. The paged pool's page payloads are head-sharded over the
+mesh (models/kv_cache.PagedSlotCache TP SHARDING) and the slot
+programs run each chip's attention over its local kv-head shard under
+shard_map, with the projections on the TP comm backends
+(kernels/gemm_allreduce.py "gemm_ar" is the decode-regime pick;
+kernels/allgather_gemm.py + gemm_reduce_scatter.py under "dist") —
+while EVERYTHING in this module stays host-side and layout-oblivious:
+admission, preemption, the radix tree, deadlines and the overlap
+pipeline mutate page TABLES and masks, never payloads, so the same
+scheduler code serves TP=1 and TP=8 with bitwise-identical streams
+(tests/test_tp_serving.py). stats() reports tp_size plus aggregate
+AND per-chip tok/s.
 """
 
 from __future__ import annotations
@@ -1090,9 +1104,16 @@ class PagedDecodeSlots(DecodeSlots):
     def _tier_extract(self, groups):
         """Demotion d2h: snapshot the span's pages (all layers). An
         int8 pool's payload carries the scale planes too ("ks"/"vs")
-        — the d2h/h2d round trip stays bitwise for both layouts."""
+        — the d2h/h2d round trip stays bitwise for both layouts,
+        including the TP-sharded pool: each group is head-ordered, so
+        the per-page kv-head indices passed here let the gather pick
+        every page's owning payload plane (Engine.extract_pages_host
+        heads contract)."""
         ids = np.concatenate([np.asarray(g, np.int32) for g in groups])
-        out = self.engine.extract_pages_host(self.cache, ids)
+        Hkv = self.engine.model.config.num_kv_heads
+        heads = np.tile(np.arange(Hkv, dtype=np.int32), len(groups))
+        out = self.engine.extract_pages_host(self.cache, ids,
+                                             heads=heads)
         return dict(zip(("k", "v", "ks", "vs"), out))
 
     def _tier_restore(self, payload, groups) -> None:
@@ -1510,6 +1531,22 @@ class ContinuousScheduler:
         self._g_host_ms = reg.gauge(
             "host_ms_per_poll", "dispatch-to-dispatch host time minus "
                                 "device wait (EMA)")
+        # TP topology + live throughput (multi-chip serving — ROADMAP
+        # open item 1): ONE scheduler drives the whole TP mesh, so
+        # multi-chip runs must report both aggregate and per-chip
+        # numbers. tokens_emitted counts every token delivered to a
+        # stream; _busy_s accumulates dispatch-to-dispatch wall time
+        # while slots were occupied (idle gaps excluded, same rule as
+        # host_ms_per_poll) — stats() derives
+        # serving_tok_per_s_aggregate and /tp_size per-chip from them,
+        # and the gauges ride the Prometheus exposition.
+        self.tp_size = int(
+            engine.model.mesh.shape[engine.model.axis])
+        reg.gauge("tp_size",
+                  "TP mesh size this scheduler drives").set(self.tp_size)
+        self._c_tokens = reg.counter(
+            "tokens_emitted", "tokens delivered to client streams")
+        self._busy_s = 0.0
         self._hang: Optional[str] = None
 
     # registry-homed counters behind the old int attribute API (tests
@@ -1630,10 +1667,27 @@ class ContinuousScheduler:
             reg.gauge("prefills_in_progress").set(
                 len(self.slots.prefill_slots))
             reg.gauge("device_wait_s").set(self.slots.device_wait_s)
+            # live throughput, aggregate AND per-chip (one scheduler
+            # drives the whole TP mesh — the per-chip number is the
+            # one comparable across topologies)
+            reg.gauge("tp_size").set(self.tp_size)
+            agg = (self._c_tokens.value / self._busy_s
+                   if self._busy_s > 0 else 0.0)
+            reg.gauge("serving_tok_per_s_aggregate",
+                      "tokens/s across the whole mesh while "
+                      "serving").set(round(agg, 3))
+            reg.gauge("serving_tok_per_s_per_chip",
+                      "aggregate tok/s / tp_size").set(
+                round(agg / self.tp_size, 3))
             slots_stats = dict(getattr(self.slots, "stats", {}) or {})
             out = reg.snapshot()
             out.update(slots_stats)
             out.update({
+                "tp_size": self.tp_size,
+                "tokens_emitted": self._c_tokens.value,
+                "serving_tok_per_s_aggregate": round(agg, 3),
+                "serving_tok_per_s_per_chip":
+                    round(agg / self.tp_size, 3),
                 "queue_depth": len(self._queue),
                 "preemptions": self._c_preemptions.value,
                 "deadline_expired": self._c_deadline_expired.value,
@@ -1670,6 +1724,9 @@ class ContinuousScheduler:
             self._host_ms_ema = host_ms if self._host_ms_ema is None \
                 else 0.8 * self._host_ms_ema + 0.2 * host_ms
             self._g_host_ms.set(self._host_ms_ema)   # registry mirror
+            # serving time base for the live tok/s gauges (stats()):
+            # dispatch-to-dispatch wall while occupied, idle excluded
+            self._busy_s += now - t0
         self._last_mark = (now, wait)
 
     @property
@@ -1961,6 +2018,7 @@ class ContinuousScheduler:
         for rid, toks in out.items():
             if len(toks):
                 self.tele.emit(rid, len(toks))
+                self._c_tokens.inc(len(toks))
         with self.tele.phase("retire"):
             for b, rid in finished:
                 self.slots.retire(b)
@@ -2093,6 +2151,7 @@ class ContinuousScheduler:
         for rid, t in out_acc.items():
             if len(t):
                 tele.emit(rid, len(t))
+                self._c_tokens.inc(len(t))
         for rid in done:
             tele.retire(rid)
         return out_acc, done

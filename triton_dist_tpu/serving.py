@@ -49,6 +49,14 @@ Resilience (models/scheduler.py has the scheduler-side story):
   bitwise identical), and a hung decode chunk (watchdog_s) ends the
   loop with a HANG error to every live client instead of freezing.
 
+Multi-chip TP: build the model over a TP mesh and ONE TokenServer
+drives every chip — the paged pool is head-sharded and the slot scan
+runs under shard_map with the projections on the TP comm backends
+(models/kv_cache.py TP SHARDING + models/scheduler.py module
+docstring); streams are bitwise identical TP=N vs TP=1 and stats()
+reports tp_size plus aggregate AND per-chip tok/s
+(tests/test_tp_serving.py).
+
 Telemetry (runtime/telemetry.py): stats() is a deep registry snapshot
 with live `ttft_ms` / `inter_token_ms` p50/p95/p99 histograms; any
 client can fetch it in-protocol with a `{"op": "stats"}` request
