@@ -520,6 +520,108 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- disaggregation rows (models/disagg.py — the DistServe split,
+    # 2401.09670): a long prompt admitted into a busy decode batch
+    # with prefill traffic FULLY OFF the decode mesh — a dedicated
+    # prefill worker thread computes the prompt's KV into a staging
+    # pool and streams the pages to the decode pool, so decode polls
+    # never carry a prefill q_len. Both arms are measured by the SAME
+    # harness over the live streams' WHOLE serving window (not just
+    # the absorption tail — the sustained p99 a client actually sees):
+    # the fused chunked arm's mixed ticks pay up to `prefill_budget`
+    # prompt tokens on the decode forward's critical path for every
+    # tick of the absorption, while the disagg arm pays one install
+    # (visible as max_gap — on real chips the h2d overlaps decode; on
+    # this same-host smoke the worker also timeshares the CPU, which
+    # separate prefill chips do not). disagg_ttft_ms is the long
+    # request's TTFT (prefill + transfer + install, overlapped with
+    # the live decode). Best-of-two per arm against CPU noise.
+    from triton_dist_tpu.models.disagg import DisaggScheduler
+
+    if on_tpu:
+        dl_live, dl_plen, dl_gen, dl_long, dl_budget = 6, 16, 256, 384, 32
+    else:
+        dl_live, dl_plen, dl_gen, dl_long, dl_budget = 3, 4, 40, 48, 4
+
+    def disagg_load_run(disagg):
+        rngc = np.random.RandomState(6)
+        live = [Request(rid=f"l{i}",
+                        ids=rngc.randint(0, cfg.vocab_size,
+                                         size=(dl_plen,)).astype(np.int32),
+                        gen_len=dl_gen)
+                for i in range(dl_live)]
+        long_req = Request(
+            rid="long",
+            ids=rngc.randint(0, cfg.vocab_size,
+                             size=(dl_long,)).astype(np.int32),
+            gen_len=8)
+        if disagg:
+            sched = DisaggScheduler(eng_c, batch=dl_live + 1, chunk=2,
+                                    threads=True)
+        else:
+            sched = ContinuousScheduler(eng_c, batch=dl_live + 1,
+                                        chunk=2, paged=True,
+                                        prefill_budget=dl_budget)
+        try:
+            for r in live:
+                sched.submit(r)
+            for _ in range(200):           # live slots armed + decoding
+                sched.poll()
+                if len(sched.slots.occupied) >= dl_live:
+                    break
+            last = {r.rid: time.perf_counter() for r in live}
+            gaps = []
+            t_submit = time.perf_counter()
+            sched.submit(long_req)
+            ttft = None
+            while not sched.idle:          # the WHOLE serving window
+                out, done = sched.poll()
+                now = time.perf_counter()
+                for r in live:
+                    if len(out.get(r.rid, ())):
+                        gaps.append(now - last[r.rid])
+                        last[r.rid] = now
+                if ttft is None and len(out.get("long", ())):
+                    ttft = now - t_submit
+        finally:
+            if disagg:
+                sched.close()
+        return ttft, gaps
+
+    dres = {}
+    for arm in (False, True):
+        disagg_load_run(arm)               # warm the programs
+        a, b = disagg_load_run(arm), disagg_load_run(arm)
+        pick = a if np.percentile(a[1], 99) <= np.percentile(b[1], 99) \
+            else b
+        dres[arm] = pick
+    d_p99 = {k: float(np.percentile(v[1], 99) * 1e3)
+             for k, v in dres.items()}
+    d_max = {k: float(np.max(v[1]) * 1e3) for k, v in dres.items()}
+    _emit_json({
+        "metric": "disagg_inter_token_p99_ms",
+        "value": round(d_p99[True], 2),
+        "unit": "ms",
+        "fused_chunked_p99_ms": round(d_p99[False], 2),
+        "max_gap_disagg_ms": round(d_max[True], 2),
+        "max_gap_fused_chunked_ms": round(d_max[False], 2),
+        "gap_samples": len(dres[True][1]),
+        "prompt_tokens": dl_long, "prefill_budget": dl_budget,
+        "live_streams": dl_live, "prefill_workers": 1,
+        "transport": "host",
+        "backend": jax.default_backend(),
+    })
+    _emit_json({
+        "metric": "disagg_ttft_ms",
+        "value": round(dres[True][0] * 1e3, 2),
+        "unit": "ms",
+        "fused_chunked_ttft_ms": round(dres[False][0] * 1e3, 2),
+        "prompt_tokens": dl_long, "prefill_budget": dl_budget,
+        "live_streams": dl_live, "prefill_workers": 1,
+        "transport": "host",
+        "backend": jax.default_backend(),
+    })
+
     # --- overlap scheduler rows (models/scheduler.py overlap=True —
     # the SGLang zero-overhead overlap design, PAPERS.md): the SAME
     # mixed serving workload through the synchronous poll loop and the

@@ -1,0 +1,500 @@
+"""Prefill/decode disaggregation (models/disagg.py — the DistServe
+split, 2401.09670): dedicated prefill workers compute a prompt's KV
+into a staging paged pool and stream the finished page-groups to the
+decode mesh over the transfer plane; decode workers install the pages
+and arm the slot without ever running a prefill q_len.
+
+The contract under test:
+- decode streams are BITWISE identical disagg vs fused (same tokens,
+  same PRNG chains) across {greedy, sampled, spec=K} x {prefix cache,
+  preemption, host tier, overlap} — the tier-1 core keeps the greedy
+  matrix + churn guard (the suite budget note in ISSUE/ROADMAP), the
+  heavier arms carry `slow` (tools/disagg_smoke.sh runs them all);
+- ZERO new XLA programs per decode poll: the install path reuses the
+  install/restore executables that already exist for chunked
+  admission and the host tier (jit-churn guard);
+- the decode mesh runs NO prefill work (max_prefill_tokens_per_poll
+  stays 0; prompt tokens land in prefill_plane_tokens instead);
+- transfer faults (runtime/chaos.py: dropped push, duplicated push,
+  prefill-worker death mid-transfer) degrade to retries/idempotent
+  discards with the zero-leak invariant
+  available + outstanding == num_pages holding on BOTH pools;
+- cancel/deadline mid-transfer release the request from the plane
+  with a visible reason and no leaked pages.
+"""
+
+import dataclasses
+import logging
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import (AutoLLM, ContinuousScheduler,
+                                    DisaggScheduler, Engine, Request)
+from triton_dist_tpu.models.config import tiny_qwen3
+from triton_dist_tpu.runtime.chaos import FaultInjector
+
+mesh = None
+_ENGINES = {}
+
+
+def setup_module(module):
+    global mesh
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("tp",))
+
+
+def _engine(mode="greedy", **kw):
+    key = (mode,) + tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        cfg = tiny_qwen3(mesh.shape["tp"])
+        model = AutoLLM.from_config(cfg, mesh)
+        ekw = dict(sampling="top_k", temperature=0.8) \
+            if mode == "sampled" else {}
+        ekw.update(kw)
+        _ENGINES[key] = (cfg, Engine(model, max_seq=64, backend="xla",
+                                     **ekw))
+    return _ENGINES[key]
+
+
+def _requests(cfg, seed=0, shared_prefix_len=6):
+    """Mixed lengths, odd rids sharing a prefix, 5 requests through
+    batch=3 so slots refill mid-stream."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, cfg.vocab_size,
+                         size=(shared_prefix_len,)).astype(np.int32)
+    # lengths chosen so staged prompts land in TWO pad buckets (8 and
+    # 24 — prefixed odd rids hit 20 and 18), bounding this module's
+    # share of the tier-1 compile bill
+    spec = [(5, 6), (14, 8), (3, 4), (12, 10), (7, 9)]
+    out = []
+    for i, (L, g) in enumerate(spec):
+        ids = rng.randint(0, cfg.vocab_size, size=(L,)).astype(np.int32)
+        if i % 2:
+            ids = np.concatenate([prefix, ids]).astype(np.int32)
+        out.append(Request(rid=i, ids=ids, gen_len=g, seed=100 + i))
+    return out
+
+
+# batch/chunk/page match tests/test_overlap.py's schedulers so the
+# decode-tick executables are SHARED across the two modules (jax's
+# compile cache keys on the process-wide _jit_programs callables +
+# shapes) — this module adds only the disagg-unique programs
+# (staging admit, install/restore buckets) to the suite's bill
+def _run_fused(eng, reqs, **kw):
+    sched = ContinuousScheduler(eng, batch=3, chunk=4, paged=True, **kw)
+    return sched.run([dataclasses.replace(r) for r in reqs]), sched
+
+
+def _run_disagg(eng, reqs, **kw):
+    sched = DisaggScheduler(eng, batch=3, chunk=4, **kw)
+    try:
+        out = sched.run([dataclasses.replace(r) for r in reqs])
+    finally:
+        sched.close()
+    return out, sched
+
+
+def _assert_same(ref, got, tag):
+    assert set(ref) == set(got), tag
+    for rid in ref:
+        np.testing.assert_array_equal(
+            got[rid], ref[rid],
+            err_msg=f"{tag}: rid={rid} diverged disagg vs fused")
+
+
+def _assert_no_leak(sched):
+    """Zero-leak invariant on BOTH pools at idle: every decode page is
+    back on the free list (or parked in the radix tree with the tree
+    holding the only refs) and every staging page is free."""
+    pool = sched.slots.prefix.pool
+    assert pool.available + pool.outstanding == pool.num_pages
+    for w in sched._workers:
+        sp = w.pool
+        assert sp.available + sp.outstanding == sp.num_pages
+        # only the reserved trash page is ever held between jobs
+        assert sp.pages_in_use == 0, "staging pages leaked"
+        assert sp.outstanding == 1
+
+
+# ----------------------------------------------------------------------
+# tier-1 core: the greedy differential + churn guard (one test, shared
+# runs — suite budget)
+# ----------------------------------------------------------------------
+
+
+def test_disagg_greedy_equals_fused_no_churn():
+    """The tier-1 core (the suite sits at the edge of the 870 s
+    budget, so the greedy differential and the churn guard SHARE
+    their runs; everything heavier is `slow` —
+    tools/disagg_smoke.sh runs the full matrix):
+
+    1. greedy streams bitwise identical disagg vs fused, prefix cache
+       on, mid-stream refill into recycled slots (fused chunked ==
+       monolithic is already test_chunked_prefill's contract — the
+       disagg arm matches both);
+    2. jit-churn guard: after the first disagg run warms every
+       program, a second run over the same shapes — install/restore/
+       decode ticks included — compiles ZERO programs (the transfer
+       plane reuses the chunked-admission install and host-tier
+       restore executables)."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg)
+    ref, _ = _run_fused(eng, reqs)
+    got, sched = _run_disagg(eng, reqs)    # warms every program
+    _assert_same(ref, got, "greedy")
+    st = sched.stats()
+    assert st["disagg"] is True
+    assert st["hits"] > 0, "prefix cache never hit — differential vacuous"
+    assert st["kv_transfers"] == len(reqs)
+    assert st["pages_transferred"] > 0
+    assert st["transfer_bytes"] > 0
+    assert st["kv_transfer_latency_ms"]["count"] == len(reqs)
+    # the perf structure the split exists for: every prompt token was
+    # forwarded on the PREFILL plane — the decode mesh ran pure decode
+    # ticks (no mixed ticks, no admission forwards)
+    assert sched.max_prefill_tokens_per_poll == 0
+    assert sched.slots.prefill_forwarded == 0
+    assert st["prefill_plane_tokens"] == sum(len(r.ids) for r in reqs)
+    assert st["prefills_in_progress"] == 0
+    _assert_no_leak(sched)
+
+    class _CompileCounter(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.names = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                self.names.append(msg.split()[1])
+
+    counter = _CompileCounter()
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    logger.addHandler(counter)
+    prev = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    try:
+        got2, _ = _run_disagg(eng, reqs)
+        assert not counter.names, (
+            f"disagg run compiled {len(counter.names)} program(s) "
+            f"after warmup: {counter.names}")
+    finally:
+        jax.config.update("jax_log_compiles", prev)
+        logger.removeHandler(counter)
+    _assert_same(ref, got2, "churn run")
+
+
+@pytest.mark.slow
+def test_transfer_faults_zero_leak():
+    """(slow: tier-1's 870 s budget keeps the greedy core + churn
+    guard — tools/disagg_smoke.sh runs the full matrix.)
+    Chaos matrix: a DROPPED push re-queues to prefill, a DUPLICATED
+    push is discarded idempotently at install, a prefill-worker DEATH
+    mid-transfer (after the forward, before delivery) releases staging
+    and retries — streams stay bitwise identical to the fused
+    reference and neither pool leaks a page."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=3)
+    ref, _ = _run_fused(eng, reqs)
+    fault = FaultInjector(drop_transfers={0, 3}, dup_transfers={2},
+                          kill_prefills={1})
+    got, sched = _run_disagg(eng, reqs, fault=fault)
+    _assert_same(ref, got, "transfer chaos")
+    st = sched.stats()
+    assert st["transfer_drops"] == 2
+    assert st["transfer_retries"] >= 3      # 2 drops + 1 death
+    assert st["prefill_worker_deaths"] == 1
+    assert sched._c_dups.value == 1
+    assert st["kv_transfers"] == len(reqs)
+    assert fault.injected["transfer_drop"] == 2
+    assert fault.injected["transfer_dup"] == 1
+    assert fault.injected["prefill_death"] == 1
+    _assert_no_leak(sched)
+
+
+@pytest.mark.slow
+def test_cancel_and_deadline_during_transfer():
+    """(slow: budget note above.) A cancel while the request is owned
+    by the prefill plane frees
+    it immediately (no decode pages were ever reserved); a deadline
+    expiry mid-plane reports the usual visible reason. Surviving
+    streams match the fused reference."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=4)
+    keep = [reqs[0], reqs[2], reqs[4]]
+    ref, _ = _run_fused(eng, keep)
+
+    sched = DisaggScheduler(eng, batch=2, chunk=2)
+    try:
+        for r in keep[:2]:
+            sched.submit(dataclasses.replace(r))
+        # rid=1 gets cancelled while queued on the plane; rid=3
+        # expires there (inline mode services one job per poll, so
+        # with four submissions the last two wait in _prefill_q)
+        sched.submit(dataclasses.replace(reqs[1]))
+        sched.submit(dataclasses.replace(reqs[3], deadline_ms=30.0))
+        acc = {r.rid: [] for r in keep}
+        expired = []
+
+        def drain(out, done):
+            for rid, toks in out.items():
+                acc.setdefault(rid, []).extend(np.asarray(toks).tolist())
+            expired.extend(done)
+
+        drain(*sched.poll())               # routes all four, runs job 0
+        assert sched._pending, "nothing routed to the prefill plane"
+        assert sched.cancel(reqs[1].rid), "plane cancel refused"
+        time.sleep(0.05)                   # let rid=3's deadline lapse
+        sched.submit(dataclasses.replace(keep[2]))
+        while not sched.idle:
+            drain(*sched.poll())
+        assert reqs[3].rid in expired
+        assert "deadline_ms" in sched.rejected[reqs[3].rid]
+        assert reqs[1].rid not in acc or not acc[reqs[1].rid]
+        for r in keep:
+            np.testing.assert_array_equal(
+                np.asarray(acc[r.rid]), ref[r.rid],
+                err_msg=f"survivor rid={r.rid} diverged")
+        assert sched.deadline_expired == 1
+        _assert_no_leak(sched)
+    finally:
+        sched.close()
+
+
+@pytest.mark.slow
+def test_disagg_validation():
+    """(slow: budget note above; a batch=2 scheduler compiles its own
+    program shapes.) Bad requests are rejected at ROUTING with a
+    visible reason — before any prefill work burns on the plane."""
+    cfg, eng = _engine()
+    sched = DisaggScheduler(eng, batch=2, chunk=2)
+    try:
+        big = Request(rid="big", ids=np.arange(50, dtype=np.int32),
+                      gen_len=60)
+        empty = Request(rid="empty", ids=np.zeros((0,), np.int32),
+                        gen_len=4)
+        ok = Request(rid="ok", ids=np.arange(5, dtype=np.int32),
+                     gen_len=4)
+        for r in (big, empty, ok):
+            sched.submit(r)
+        done = []
+        while not sched.idle:
+            _, d = sched.poll()
+            done.extend(d)
+        assert "big" in done and "empty" in done and "ok" in done
+        assert "exceeds slot capacity" in sched.rejected["big"]
+        assert "empty prompt" in sched.rejected["empty"]
+        assert "ok" not in sched.rejected
+        assert sched.stats()["prefill_plane_tokens"] == 5
+        _assert_no_leak(sched)
+    finally:
+        sched.close()
+
+    # max_queue bounds the PLANE too: routing stops once the plane
+    # owns max_queue requests, so the queue fills and submit() keeps
+    # its busy/backpressure contract instead of draining every poll
+    # into an unbounded transfer backlog
+    sched = DisaggScheduler(eng, batch=2, chunk=4, max_queue=1,
+                            prefill_jobs_per_poll=0)
+    try:
+        mk = lambda i: Request(rid=f"q{i}",
+                               ids=np.arange(4, dtype=np.int32),
+                               gen_len=2, seed=i)
+        assert sched.submit(mk(0))
+        sched.poll()                       # routes q0 to the plane
+        assert len(sched._pending) == 1
+        assert sched.submit(mk(1))         # queue has room again
+        sched.poll()                       # plane full: q1 stays queued
+        assert len(sched._pending) == 1 and sched.queue_depth == 1
+        assert not sched.submit(mk(2)), "max_queue never bounced"
+        assert sched.busy_rejections == 1
+        sched.prefill_jobs_per_poll = 1    # un-wedge and drain
+        while not sched.idle:
+            sched.poll()
+        _assert_no_leak(sched)
+    finally:
+        sched.close()
+
+
+@pytest.mark.slow
+def test_transfer_instants_traced():
+    """(slow: budget note above.) kv_push / kv_install ride the
+    poll-loop timeline when tracing is on (tools/trace_view.py
+    surfaces them in its instants line)."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=5)[:2]
+    _, sched = _run_disagg(eng, reqs, trace=True)
+    names = [e["name"] for e in sched.tele.export()["traceEvents"]
+             if e.get("ph") == "i"]
+    assert names.count("kv_push") == len(reqs)
+    assert names.count("kv_install") == len(reqs)
+
+
+@pytest.mark.slow
+def test_dcn_transport_bitwise():
+    """(slow: budget note above.) Cross-slice transfer tier: the
+    payload crosses the DCN axis via kernels/two_tier.kv_push_slices
+    (an XLA ppermute — the tier XLA owns) bitwise."""
+    from triton_dist_tpu.kernels.two_tier import kv_push_slices
+    n = len(jax.devices())
+    if n < 2:
+        pytest.skip("needs >= 2 devices")
+    m2 = jax.make_mesh((2, n // 2), ("dcn", "tp"))
+    rng = np.random.RandomState(0)
+    for dtype in (np.float32, np.int8):
+        x = rng.randint(-100, 100, size=(2, 6, 8, 4)).astype(dtype)
+        got = np.asarray(kv_push_slices(x, mesh=m2, slice_axis="dcn",
+                                        src=0, dst=1))
+        np.testing.assert_array_equal(got, x)
+
+
+# ----------------------------------------------------------------------
+# slow arms: full matrix + device transports + threaded workers
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_disagg_sampled_and_spec():
+    cfg, eng = _engine("sampled")
+    reqs = _requests(cfg, seed=6)
+    ref, _ = _run_fused(eng, reqs)
+    got, sched = _run_disagg(eng, reqs)
+    _assert_same(ref, got, "sampled")
+    _assert_no_leak(sched)
+    cfg, eng = _engine()
+    ref, _ = _run_fused(eng, reqs, spec=2)
+    got, sched = _run_disagg(eng, reqs, spec=2)
+    _assert_same(ref, got, "spec=2")
+    _assert_no_leak(sched)
+
+
+@pytest.mark.slow
+def test_disagg_preemption_and_host_tier():
+    """Pool pressure at INSTALL walks the same preempt ladder as fused
+    admission (resumed requests re-admit decode-side); with the host
+    tier on, evicted spans demote and transferred prefixes promote."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=7)
+    hkv = cfg.num_kv_heads
+    # 3 usable page groups: the widest request alone takes 2, so
+    # concurrent residents must preempt each other
+    tiny = 3 * hkv + 1
+    ref, rs = _run_fused(eng, reqs, num_pages=tiny)
+    got, sched = _run_disagg(eng, reqs, num_pages=tiny)
+    _assert_same(ref, got, "preemption")
+    assert sched.preemptions > 0 and rs.preemptions > 0
+    _assert_no_leak(sched)
+    ref, _ = _run_fused(eng, reqs, num_pages=tiny, host_pool_pages=64)
+    got, sched = _run_disagg(eng, reqs, num_pages=tiny,
+                             host_pool_pages=64)
+    _assert_same(ref, got, "host tier")
+    _assert_no_leak(sched)
+
+
+@pytest.mark.slow
+def test_disagg_overlap():
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=8)
+    ref, _ = _run_fused(eng, reqs)
+    got, sched = _run_disagg(eng, reqs, overlap=True)
+    _assert_same(ref, got, "overlap")
+    _assert_no_leak(sched)
+
+
+@pytest.mark.slow
+def test_disagg_threaded_workers():
+    """threads=True: the prefill plane runs on its own threads (the
+    CPU stand-in for dedicated prefill chips). Per-rid streams are
+    timing-invariant, so they still match the fused reference."""
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=9)
+    ref, _ = _run_fused(eng, reqs)
+    got, sched = _run_disagg(eng, reqs, threads=True,
+                             prefill_workers=2)
+    _assert_same(ref, got, "threads")
+    _assert_no_leak(sched)
+
+
+@pytest.mark.slow
+def test_token_server_disagg():
+    """Worker roles through the serving layer: TokenServer(disagg=True)
+    streams over threaded prefill workers + the handoff protocol, and
+    the socket streams match a fused server's byte for byte."""
+    import threading
+
+    from triton_dist_tpu.serving import (ByteTokenizer, TokenServer,
+                                         request_stream)
+
+    cfg, eng = _engine()
+    tok = ByteTokenizer(cfg.vocab_size)
+    prompts = ["hello disagg", "hello disagg world", "abc"]
+
+    def serve(**kw):
+        srv = TokenServer(eng, tok, batch=2, chunk=2, **kw)
+        t = threading.Thread(target=srv.serve_forever,
+                             kwargs=dict(max_requests=len(prompts)),
+                             daemon=True)
+        t.start()
+        outs = {}
+        for i, p in enumerate(prompts):
+            toks = []
+            for msg in request_stream(srv.host, srv.port, p,
+                                      gen_len=6, seed=3 + i):
+                toks.extend(msg.get("token_ids", []))
+            outs[p] = toks
+        t.join(timeout=60)
+        srv.stop()
+        return outs, srv
+
+    ref, _ = serve(paged=True)
+    got, srv = serve(disagg=True, prefill_workers=2)
+    assert got == ref, "disagg server streams diverged from fused"
+    st = srv.stats()
+    assert st["disagg"] is True and st["kv_transfers"] >= len(prompts)
+    with pytest.raises(ValueError):
+        TokenServer(eng, tok, batch=2, disagg=True, prefill_budget=4)
+
+
+def _p2p_usable():
+    """Probe the interpret-mode p2p kernel (some jax builds carry a
+    dma_start discharge bug that breaks the one-sided kernels under
+    interpret mode — tier-1 seed already counts those failures as
+    environmental)."""
+    from triton_dist_tpu.kernels.p2p import p2p_push_pages
+    try:
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        np.asarray(p2p_push_pages(x, mesh=mesh, axis="tp",
+                                  src=0, dst=1))
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.slow
+def test_ici_transport_bitwise_and_end_to_end():
+    """On-slice transfer tier: raw page bytes hop prefill-chip ->
+    decode-chip through the paper's one-sided neighbor-put kernel
+    (kernels/p2p.p2p_push_pages) bitwise, and a full disagg run over
+    ICITransport matches the fused reference."""
+    if mesh.shape["tp"] < 2:
+        pytest.skip("needs >= 2 devices")
+    if not _p2p_usable():
+        pytest.skip("interpret-mode p2p kernel unavailable on this "
+                    "host (pre-existing environment limitation)")
+    from triton_dist_tpu.kernels.p2p import p2p_push_pages
+    from triton_dist_tpu.models.disagg import ICITransport
+    rng = np.random.RandomState(1)
+    x = rng.randint(-100, 100, size=(2, 6, 8, 4)).astype(np.float32)
+    got = np.asarray(p2p_push_pages(x, mesh=mesh, axis="tp",
+                                    src=0, dst=2))
+    np.testing.assert_array_equal(got, x)
+    cfg, eng = _engine()
+    reqs = _requests(cfg, seed=10)[:3]
+    ref, _ = _run_fused(eng, reqs)
+    got, sched = _run_disagg(eng, reqs,
+                             transport=ICITransport(mesh, axis="tp"))
+    _assert_same(ref, got, "ici transport")
+    assert sched.stats()["transport"] == "ici"

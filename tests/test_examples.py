@@ -76,6 +76,14 @@ def test_tp_serving_example_runs():
     _run_example("17_tp_serving.py")
 
 
+@pytest.mark.slow
+def test_disaggregation_example_runs():
+    # slow: same budget note — the disagg-vs-fused differential the
+    # example demos already runs in-suite (tests/test_disagg.py);
+    # tools/disagg_smoke.sh and manual runs cover the example itself
+    _run_example("18_disaggregation.py")
+
+
 def test_socket_serving_two_process():
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled

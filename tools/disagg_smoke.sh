@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Disaggregated-serving smoke — the full disagg-vs-fused bitwise
+# differential matrix (tests/test_disagg.py) on the forced
+# multi-device CPU mesh, the same substrate tier-1 uses. Tier-1's
+# 870 s budget keeps only the greedy core + churn guard + fault
+# matrix; this script runs EVERYTHING — the sampled/spec arms,
+# preemption + host tier, overlap, threaded workers, the ICI/DCN
+# device transports, and the example — and archives the pass count
+# with a delta vs the previous run, tp_smoke.sh-style.
+# Run from the repo root: bash tools/disagg_smoke.sh
+set -o pipefail
+rm -f /tmp/_disagg_smoke.log
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_disagg.py \
+    "tests/test_examples.py::test_disaggregation_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_disagg_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_disagg_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_disagg_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "DISAGG_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "DISAGG_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

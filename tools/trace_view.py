@@ -70,7 +70,8 @@ def summarize(dump: dict, top_k: int = 5) -> str:
             out.append(f"  poll #{seq:<6} {_fmt_ms(e['dur'])}  "
                        f"at {e['ts'] / 1e3:.3f}ms")
 
-    # --- instants (watchdog fires, preemptions, drains, kv moves)
+    # --- instants (watchdog fires, preemptions, drains, kv demote/
+    # promote, and the disagg transfer plane's kv_push/kv_install)
     if instants:
         kinds = {}
         for e in instants:

@@ -57,6 +57,43 @@ def _p2p_pallas(x_loc, *, n: int, axis: str, reverse: bool,
     )(x_loc)
 
 
+def p2p_push_pages(x, *, mesh: Mesh, axis: str = "tp", src: int = 0,
+                   dst: int = 1,
+                   collective_id: Optional[int] = None):
+    """One-to-one KV page-payload handoff over the ICI neighbor tier
+    (disaggregated serving — models/disagg.py ICITransport): the bytes
+    of `x` (a host or device array — an extract_pages_host payload in
+    practice: raw pool-dtype pages, int8 scale planes, the arming
+    logits row) start on the PREFILL worker's mesh position `src` and
+    land on the DECODE worker's position `dst`, hopping
+    ``(dst - src) % n`` cyclic neighbor puts (_p2p_shift_kernel — the
+    reference's one-sided `p2p_put` : signal : drain sequence per
+    hop). Returns the payload as it arrived at `dst`, bitwise equal to
+    the input (the kernel moves raw bytes; tests/test_disagg.py pins
+    it). Prefill and decode planes are adjacent in any sane placement,
+    so the common case is ONE hop; non-adjacent placements pay one put
+    per intervening chip. Cost note: the cyclic shift is uniform SPMD
+    — every chip puts its plane each hop, so a hop moves n*P bytes of
+    ICI traffic for a P-byte payload (the other planes are zeros). A
+    predicated src-only put kernel would move P; at KV-page payload
+    sizes the simplicity wins until a deployment proves otherwise."""
+    n = mesh.shape[axis]
+    src, dst = src % n, dst % n
+    hops = (dst - src) % n
+    if hops == 0:
+        return jnp.asarray(x)
+    buf = jnp.zeros((n,) + tuple(x.shape), x.dtype).at[src].set(
+        jnp.asarray(x))
+    buf = jax.device_put(
+        buf, jax.sharding.NamedSharding(
+            mesh, P(axis, *(None,) * x.ndim)))
+    for _ in range(hops):
+        buf = p2p_shift(buf, mesh=mesh, axis=axis,
+                        collective_id=collective_id)
+        collective_id = None        # fresh id per hop
+    return buf[dst]
+
+
 def p2p_shift(x, *, mesh: Mesh, axis: str = "pp", reverse: bool = False,
               collective_id: Optional[int] = None):
     """Cyclic stage handoff: x [n, ...] sharded on dim 0 over `axis`;

@@ -38,6 +38,38 @@ from triton_dist_tpu.kernels.reduce_scatter import (ReduceScatterMethod,
 from triton_dist_tpu.runtime import next_collective_id
 
 
+def kv_push_slices(x, *, mesh: Mesh, slice_axis: str = "dcn",
+                   src: int = 0, dst: int = 1):
+    """Cross-slice KV page-payload push over DCN (disaggregated
+    serving — models/disagg.py DCNTransport): the bytes of `x` (an
+    extract_pages_host payload) start on the PREFILL slice `src` and
+    land on the DECODE slice `dst`. Per this module's design rule —
+    DCN has no one-sided semantics, so the slow tier is expressed as
+    an XLA collective — the slice hop is one ``jax.lax.ppermute`` on
+    `slice_axis`, which XLA schedules and overlaps on DCN; within a
+    slice the payload needs no distribution (a head-sharded pool's
+    restore broadcasts into every chip's plane on install). Returns
+    the payload as it arrived at `dst`, bitwise equal to the input."""
+    n_s = mesh.shape[slice_axis]
+    src, dst = src % n_s, dst % n_s
+    x = jnp.asarray(x)
+    if src == dst:
+        return x
+    buf = jnp.zeros((n_s,) + tuple(x.shape), x.dtype).at[src].set(x)
+    buf = jax.device_put(
+        buf, jax.sharding.NamedSharding(
+            mesh, P(slice_axis, *(None,) * x.ndim)))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(slice_axis, *(None,) * x.ndim),
+        out_specs=P(slice_axis, *(None,) * x.ndim), check_vma=False)
+    def _f(x_loc):
+        return jax.lax.ppermute(x_loc, slice_axis, perm=[(src, dst)])
+
+    return _f(buf)[dst]
+
+
 def all_gather_2d(x, *, mesh: Mesh, chip_axis: str = "tp",
                   slice_axis: str = "dcn",
                   collective_id: Optional[int] = None):

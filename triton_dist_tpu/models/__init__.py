@@ -7,6 +7,11 @@ from triton_dist_tpu.models.config import (ModelConfig, qwen3_30b_a3b,  # noqa: 
                                            qwen3_32b, tiny_qwen3,
                                            tiny_qwen3_moe)
 from triton_dist_tpu.models.dense import DenseLLM  # noqa: F401
+from triton_dist_tpu.models.disagg import (DCNTransport,  # noqa: F401
+                                           DisaggScheduler,
+                                           HostTransport, ICITransport,
+                                           KVHandoff, PrefillWorker,
+                                           PrefillWorkerDied)
 from triton_dist_tpu.models.engine import Engine  # noqa: F401
 from triton_dist_tpu.models.kv_cache import KVCache, PagedSlotCache  # noqa: F401
 from triton_dist_tpu.models.prefix_cache import (PoolExhausted,  # noqa: F401

@@ -627,7 +627,11 @@ class Engine:
 
     def extract_pages_host(self, pcache, page_ids, *, heads=None,
                            pad_to: int = 8):
-        """DEMOTION d2h: gather the listed physical pages out of every
+        """DEMOTION d2h (also the disaggregated-serving WIRE FORMAT —
+        models/disagg.py ships exactly these arrays from the prefill
+        plane's staging pool to the decode pool, a transferred page
+        being a demoted page with a different destination): gather the
+        listed physical pages out of every
         layer's K/V pool and return them as host arrays
         (k, v each [L, N, page, d], pool dtype — the raw bytes, so a
         later restore is bitwise; an int8 pool appends its scale

@@ -146,6 +146,15 @@ pipeline mutate page TABLES and masks, never payloads, so the same
 scheduler code serves TP=1 and TP=8 with bitwise-identical streams
 (tests/test_tp_serving.py). stats() reports tp_size plus aggregate
 AND per-chip tok/s.
+
+Disaggregation (models/disagg.py — DistServe, 2401.09670): chunked
+prefill BOUNDS the prefill stall on live streams; `DisaggScheduler`
+(a subclass of ContinuousScheduler) REMOVES it — dedicated prefill
+workers compute admissions' KV into staging paged pools and stream
+the finished page-groups to this scheduler's decode pool over the
+p2p/DCN transfer plane, so decode polls never run a mixed tick at
+all. Streams stay bitwise identical disagg vs fused
+(tests/test_disagg.py).
 """
 
 from __future__ import annotations
@@ -1135,31 +1144,28 @@ class PagedDecodeSlots(DecodeSlots):
         out.update(self.prefix.stats())
         return out
 
-    def _reserve_pages(self, req: Request, tokens: np.ndarray):
-        """Validation + prefix lookup + page reservation shared by the
-        monolithic and CHUNKED paged admissions. Returns (slot_groups,
-        m, rows, cow_src, cow_dst, r, boundary) with every ref taken
-        (release `boundary` after the device-side CoW ran); raises with
-        everything released."""
+    def validate_admission(self, req: Request, tokens: np.ndarray
+                           ) -> None:
+        """The cheap upfront refusals of a paged admission — ONE copy,
+        shared by _reserve_pages and the disagg scheduler's routing
+        (models/disagg.py rejects before burning prefill-plane work):
+        - empty prompt: the suffix forward needs at least one token
+          (and a zero-length prompt would leak the refs _reserve_pages
+          retains when the engine refused it);
+        - prompt + gen beyond slot capacity;
+        - TOTAL footprint beyond the whole pool (shared + fresh groups
+          must all coexist): reject upfront with a plain ValueError so
+          the scheduler does not preempt every live slot discovering
+          it (the cheap denial-of-service a repeated never-fits
+          request would otherwise buy)."""
         n = len(tokens)
         if n == 0:
-            # reject before touching the pool: the suffix forward needs
-            # at least one token (and a zero-length prompt would leak
-            # the refs retained below when the engine refused it)
             raise ValueError(f"request {req.rid!r}: empty prompt")
         if n + req.gen_len > self.capacity:
             raise ValueError(
                 f"request {req.rid!r}: prompt {n} + gen {req.gen_len} "
                 f"exceeds slot capacity {self.capacity}")
         pool = self.prefix.pool
-        # a request whose TOTAL footprint (shared + fresh groups must
-        # all coexist in the pool) exceeds the pool can never be
-        # admitted — reject upfront with a plain ValueError so the
-        # scheduler does not preempt every live slot discovering it
-        # (the cheap denial-of-service a repeated never-fits request
-        # would otherwise buy)
-        # total page groups the admitted slot will map (shared + fresh
-        # must all coexist in the pool); `need` below is total - full
         total = -(-(n + req.gen_len + self.margin - 1) // self.page)
         usable = (pool.num_pages - 1) // pool.n_kv_heads
         if total > usable:
@@ -1167,6 +1173,19 @@ class PagedDecodeSlots(DecodeSlots):
                 f"request {req.rid!r}: worst-case footprint {total} "
                 f"page groups exceeds the whole pool ({usable} usable "
                 f"groups) — page pool exhausted for this request alone")
+
+    def _reserve_pages(self, req: Request, tokens: np.ndarray):
+        """Validation + prefix lookup + page reservation shared by the
+        monolithic and CHUNKED paged admissions. Returns (slot_groups,
+        m, rows, cow_src, cow_dst, r, boundary) with every ref taken
+        (release `boundary` after the device-side CoW ran); raises with
+        everything released."""
+        n = len(tokens)
+        self.validate_admission(req, tokens)
+        pool = self.prefix.pool
+        # total page groups the admitted slot will map (shared + fresh
+        # must all coexist in the pool); `need` below is total - full
+        total = -(-(n + req.gen_len + self.margin - 1) // self.page)
         m, shared = self.prefix.lookup(tokens)
         full, r = m // self.page, m % self.page
         retained: List[np.ndarray] = []
@@ -1813,6 +1832,43 @@ class ContinuousScheduler:
                    key=lambda b: (slots.emitted(b),
                                   -int(slots.admit_tick[b])))
 
+    def _preempt_for(self, rid, preempted_now: set, reason: str, *,
+                     drop, requeue_at: int = 1) -> bool:
+        """The preempt-or-wait ladder of one PoolExhausted admission —
+        ONE copy, shared by the fused _admit and the disagg
+        scheduler's install/resume paths (models/disagg.py). Returns
+        False = stop admitting this poll (an in-flight resident may
+        become eligible, or this rid was already preempted-for once);
+        True = retry (a victim was freed, or preemption is off and the
+        request was hard-rejected via `drop(reason)`). requeue_at: the
+        victim's queue position — 1 when the displacer is _queue[0]
+        (the victim must NOT jump ahead of the request it was evicted
+        for, or the two ping-pong the slot while the displacer
+        starves), 0 when the displacer is not in the queue (the disagg
+        transfer queue installs ahead of the queue anyway)."""
+        can_preempt = (self.preempt and self.slots.occupied
+                       and hasattr(self.slots, "preempt"))
+        if not can_preempt:
+            drop(reason)
+            return True
+        if rid in preempted_now:
+            return False
+        victims = self._eligible_victims()
+        if not victims:
+            # in-flight slots exist but none has banked progress yet
+            # (fresh admissions / mid-chunked-prefill): WAIT a poll
+            # instead of displacing them — the step advances them to
+            # eligibility (or retirement), where preempting now could
+            # throw away eviction-fragile prefill work forever
+            return False
+        victim = self.slots.preempt(self._pick_victim(victims))
+        self._c_preemptions.inc()
+        self.tele.req_event(victim.rid, "preempt")
+        self.tele.instant("preempt", str(victim.rid))
+        preempted_now.add(victim.rid)
+        self._queue.insert(min(requeue_at, len(self._queue)), victim)
+        return True
+
     def _pipeline_idle(self) -> bool:
         """No dispatched-but-unlanded tick and no staged retires — the
         host mirrors equal what sync mode would show at this poll
@@ -1901,30 +1957,15 @@ class ContinuousScheduler:
                     self._drain(self._carry_out if out_acc is None
                                 else out_acc, done)
                     continue
-                can_preempt = (self.preempt and self.slots.occupied
-                               and hasattr(self.slots, "preempt"))
-                if not can_preempt:
+
+                def _drop(reason, req=req):
                     self._queue.popleft()
-                    self._reject(req.rid, str(e))
+                    self._reject(req.rid, reason)
                     done.append(req.rid)
-                    continue
-                if req.rid in preempted_now:
+
+                if not self._preempt_for(req.rid, preempted_now,
+                                         str(e), drop=_drop):
                     return
-                victims = self._eligible_victims()
-                if not victims:
-                    # in-flight slots exist but none has banked
-                    # progress yet (fresh admissions / mid-chunked-
-                    # prefill): WAIT a poll instead of displacing them
-                    # — the step below advances them to eligibility (or
-                    # retirement), where preempting now could throw
-                    # away eviction-fragile prefill work forever
-                    return
-                victim = self.slots.preempt(self._pick_victim(victims))
-                self._c_preemptions.inc()
-                self.tele.req_event(victim.rid, "preempt")
-                self.tele.instant("preempt", str(victim.rid))
-                preempted_now.add(victim.rid)
-                self._queue.insert(1, victim)
             except ValueError as e:
                 self._queue.popleft()
                 self._reject(req.rid, str(e))

@@ -59,16 +59,39 @@ class FaultInjector:
     tierless eviction would, so the cross-tier zero-leak invariant
     (device ``available + outstanding == num_pages`` AND host
     ``pages_resident == sum(entries)``) must survive
-    (tests/test_resilience.py, tests/test_kv_tier.py)."""
+    (tests/test_resilience.py, tests/test_kv_tier.py).
+
+    TRANSFER faults (disaggregated serving — models/disagg.py):
+    ``drop_transfers`` / ``dup_transfers`` name 0-based transfer
+    ATTEMPT indices (every consult of the ``transfer`` hook counts,
+    retries included) at which a KV page push is LOST in flight
+    (the scheduler must re-queue the request to the prefill plane)
+    or DELIVERED TWICE (the decode side must discard the duplicate
+    idempotently at install). ``kill_prefills`` names 0-based prefill
+    JOB indices at which the worker dies mid-transfer — after the
+    forward, before delivery — so the job's staging pages must be
+    released by the worker's own cleanup and the request must retry.
+    The zero-leak invariant must hold on BOTH the staging and decode
+    pools throughout (tests/test_disagg.py)."""
 
     def __init__(self, *, exhaust_admissions: Iterable[int] = (),
-                 exhaust_host_demotions: Iterable[int] = ()):
+                 exhaust_host_demotions: Iterable[int] = (),
+                 drop_transfers: Iterable[int] = (),
+                 dup_transfers: Iterable[int] = (),
+                 kill_prefills: Iterable[int] = ()):
         self.exhaust_admissions = {int(i) for i in exhaust_admissions}
         self.exhaust_host_demotions = {int(i)
                                        for i in exhaust_host_demotions}
+        self.drop_transfers = {int(i) for i in drop_transfers}
+        self.dup_transfers = {int(i) for i in dup_transfers}
+        self.kill_prefills = {int(i) for i in kill_prefills}
         self.admissions_seen = 0
         self.host_demotions_seen = 0
-        self.injected = {"pool_exhausted": 0, "host_exhausted": 0}
+        self.transfers_seen = 0
+        self.prefills_seen = 0
+        self.injected = {"pool_exhausted": 0, "host_exhausted": 0,
+                         "transfer_drop": 0, "transfer_dup": 0,
+                         "prefill_death": 0}
 
     def admission(self, req) -> None:
         i = self.admissions_seen
@@ -89,6 +112,34 @@ class FaultInjector:
             self.injected["host_exhausted"] += 1
             return False
         return True
+
+    def transfer(self, rid):
+        """Consulted by the disagg scheduler once per completed
+        prefill, right before the push crosses the transfer plane.
+        Returns "drop" (the push is lost — re-queue to prefill),
+        "dup" (delivered twice — install must discard the second), or
+        None (deliver normally)."""
+        i = self.transfers_seen
+        self.transfers_seen += 1
+        if i in self.drop_transfers:
+            self.injected["transfer_drop"] += 1
+            return "drop"
+        if i in self.dup_transfers:
+            self.injected["transfer_dup"] += 1
+            return "dup"
+        return None
+
+    def prefill_worker(self, rid) -> bool:
+        """Consulted by each PrefillWorker between its forward and the
+        payload extraction; True = the worker dies NOW (mid-transfer —
+        models/disagg.py raises PrefillWorkerDied, staging pages are
+        released by the worker's cleanup, the request retries)."""
+        i = self.prefills_seen
+        self.prefills_seen += 1
+        if i in self.kill_prefills:
+            self.injected["prefill_death"] += 1
+            return True
+        return False
 
 
 class FlakyDrafter:

@@ -158,7 +158,9 @@ class TokenServer:
                  prefill_budget: Optional[int] = None,
                  host_pool_pages: int = 0, overlap: bool = False,
                  metrics_port: Optional[int] = None,
-                 trace: Optional[bool] = None):
+                 trace: Optional[bool] = None,
+                 disagg: bool = False, prefill_workers: int = 1,
+                 disagg_threads: bool = True, transport=None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -221,21 +223,49 @@ class TokenServer:
         setting TDTPU_TRACE=path also makes serve_forever dump the
         trace to `path` on exit). Clients can fetch the live stats
         snapshot — ttft_ms / inter_token_ms histograms included —
-        with a `{"op": "stats"}` request."""
+        with a `{"op": "stats"}` request.
+
+        disagg=True serves in PREFILL/DECODE DISAGGREGATED mode
+        (models/disagg.py — the DistServe split): admissions prefill
+        on `prefill_workers` dedicated workers (their own threads by
+        default — disagg_threads) and stream finished KV pages to the
+        decode mesh over `transport` (HostTransport default;
+        ICITransport/DCNTransport for the device tiers), so decode
+        polls never carry a prefill q_len and inter-token latency
+        stays flat under long-prompt admission load. Always paged;
+        mutually exclusive with prefill_budget (chunked prefill is
+        the fused alternative disaggregation replaces). Streams are
+        bitwise identical either way (tests/test_disagg.py)."""
+        from triton_dist_tpu.models.disagg import DisaggScheduler
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
         self.tok = tokenizer
         self.batch = batch
         self.chunk = chunk
-        self.paged = paged
-        self.sched = ContinuousScheduler(
-            engine, batch=batch, chunk=chunk, paged=paged,
-            prefix_cache=prefix_cache, page=page, num_pages=num_pages,
-            spec=spec, drafter=drafter, max_queue=max_queue,
-            watchdog_s=watchdog_s, fault=fault,
-            prefill_budget=prefill_budget,
-            host_pool_pages=host_pool_pages, overlap=overlap,
-            trace=trace)
+        self.paged = paged or disagg
+        if disagg:
+            if prefill_budget is not None:
+                raise ValueError(
+                    "disagg=True replaces chunked prefill — drop "
+                    "prefill_budget (the decode mesh never prefills)")
+            self.sched = DisaggScheduler(
+                engine, batch=batch, chunk=chunk,
+                prefix_cache=prefix_cache, page=page,
+                num_pages=num_pages, spec=spec, drafter=drafter,
+                max_queue=max_queue, watchdog_s=watchdog_s,
+                fault=fault, host_pool_pages=host_pool_pages,
+                overlap=overlap, trace=trace,
+                prefill_workers=prefill_workers,
+                threads=disagg_threads, transport=transport)
+        else:
+            self.sched = ContinuousScheduler(
+                engine, batch=batch, chunk=chunk, paged=paged,
+                prefix_cache=prefix_cache, page=page,
+                num_pages=num_pages, spec=spec, drafter=drafter,
+                max_queue=max_queue, watchdog_s=watchdog_s,
+                fault=fault, prefill_budget=prefill_budget,
+                host_pool_pages=host_pool_pages, overlap=overlap,
+                trace=trace)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -615,6 +645,10 @@ class TokenServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # disaggregated mode: stop the prefill worker threads too
+        close = getattr(self.sched, "close", None)
+        if close is not None:
+            close()
         if self._msock is not None:
             try:
                 self._msock.close()
