@@ -33,11 +33,55 @@ import time
 _METRIC = "qwen3_decode_tok_per_s_per_chip"
 _SERVE_METRIC = "serving_tok_per_s_per_chip"
 
+# perf-regression ledger (tools/bench_compare.py): every capture
+# appends to BENCH_history.jsonl next to this script — one JSON line
+# per row, stamped with a per-invocation run id, git sha, host and
+# timestamp so runs can be grouped and same-window pairs compared
+# (this class of host swings >25% between boxes — the comparer, not
+# the ledger, owns the noise policy). TDTPU_BENCH_HISTORY overrides
+# the path; set it EMPTY to disable.
+_HISTORY_DEFAULT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_history.jsonl")
+_RUN_ID = f"{int(time.time())}-{os.getpid()}"
+_GIT_SHA = None
+
+
+def _git_sha():
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        try:
+            _GIT_SHA = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except (OSError, subprocess.SubprocessError):
+            _GIT_SHA = "unknown"
+    return _GIT_SHA
+
+
+def _history_append(obj):
+    """Best-effort ledger append; a read-only checkout or full disk
+    must never fail the bench."""
+    path = os.environ.get("TDTPU_BENCH_HISTORY", _HISTORY_DEFAULT)
+    if not path:
+        return
+    import platform
+    row = dict(obj, run=_RUN_ID, git_sha=_git_sha(),
+               host=platform.node(), unix=round(time.time(), 3))
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
 
 def _emit_json(obj):
     """One bench row: stdout (the driver's capture) + optional file
     capture when TDTPU_BENCH_JSON names a path (append, one JSON line
-    per row — ad-hoc runs keep their history without tee plumbing)."""
+    per row — ad-hoc runs keep their history without tee plumbing) +
+    the BENCH_history.jsonl perf-regression ledger (every capture,
+    diffable over time with tools/bench_compare.py)."""
     line = json.dumps(obj)
     print(line, flush=True)
     path = os.environ.get("TDTPU_BENCH_JSON")
@@ -47,6 +91,7 @@ def _emit_json(obj):
                 f.write(line + "\n")
         except OSError:
             pass
+    _history_append(obj)
 
 
 def _run_captured(cmd, env, timeout):
@@ -141,10 +186,12 @@ def _cpu_fallback(reason):
                   timeout=1800, note=reason):
         return 0
     for metric in (_METRIC, _SERVE_METRIC):
-        print(json.dumps({
+        row = {
             "metric": metric, "value": 0.0, "unit": "tok/s/chip",
             "vs_baseline": 0.0, "backend": "none", "error": reason,
-        }))
+        }
+        print(json.dumps(row))
+        _history_append(row)     # the ledger records outages too
     return 0
 
 

@@ -139,7 +139,13 @@ def test_disagg_greedy_equals_fused_no_churn():
        program, a second run over the same shapes — install/restore/
        decode ticks included — compiles ZERO programs (the transfer
        plane reuses the chunked-admission install and host-tier
-       restore executables)."""
+       restore executables). The churn run now also runs TRACE-ON
+       (the disagg trace path: worker-track spans + cross-plane flow
+       events are host-side only), so one run proves trace-on ==
+       trace-off bitwise AND zero new programs on the traced disagg
+       path, and its export pins the merged-timeline contract: one
+       complete route -> prefill:compute -> kv_push -> kv_install
+       flow chain per request across both planes."""
     cfg, eng = _engine()
     reqs = _requests(cfg)
     ref, _ = _run_fused(eng, reqs)
@@ -177,14 +183,62 @@ def test_disagg_greedy_equals_fused_no_churn():
     prev = jax.config.jax_log_compiles
     jax.config.update("jax_log_compiles", True)
     try:
-        got2, _ = _run_disagg(eng, reqs)
+        # trace=ON: the churn guard extends to the disagg trace path
+        # (cross-plane spans + flow events are host-side only)
+        got2, sched2 = _run_disagg(eng, reqs, trace=True)
         assert not counter.names, (
-            f"disagg run compiled {len(counter.names)} program(s) "
-            f"after warmup: {counter.names}")
+            f"traced disagg run compiled {len(counter.names)} "
+            f"program(s) after warmup: {counter.names}")
     finally:
         jax.config.update("jax_log_compiles", prev)
         logger.removeHandler(counter)
-    _assert_same(ref, got2, "churn run")
+    _assert_same(ref, got2, "traced churn run")
+
+    # the merged cross-plane timeline: the prefill worker has its own
+    # track, its compute/push spans live there, and each request's
+    # journey is ONE complete flow chain ending at the decode-side
+    # kv_install (route -> prefill:compute -> kv_push -> kv_install)
+    exp = sched2.tele.export()
+    evs = exp["traceEvents"]
+    meta = {e["args"]["name"] for e in evs if e.get("ph") == "M"
+            and e.get("name") == "thread_name"}
+    assert "prefill-worker-0" in meta, "no worker track in the trace"
+    worker_tid = next(e["tid"] for e in evs if e.get("ph") == "M"
+                      and e.get("args", {}).get("name")
+                      == "prefill-worker-0")
+    span_names_on_worker = {e["name"] for e in evs
+                            if e.get("ph") == "X"
+                            and e.get("tid") == worker_tid}
+    assert {"prefill:compute", "kv_push"} <= span_names_on_worker
+    host_spans = {e["name"] for e in evs if e.get("ph") == "X"
+                  and e.get("tid") == 0}
+    assert "kv_install" in host_spans
+    starts = [e for e in evs if e.get("ph") == "s"]
+    ends = [e for e in evs if e.get("ph") == "f"]
+    assert len(starts) == len(reqs) and len(ends) == len(reqs)
+    assert {e["id"] for e in starts} == {e["id"] for e in ends}
+    # flow steps cross planes: the push step is stamped on the worker
+    # track, the start/end on the host track
+    assert all(e["tid"] == 0 for e in starts + ends)
+    assert any(e.get("tid") == worker_tid for e in evs
+               if e.get("ph") == "t")
+
+    # tools/trace_view.py renders the merged timeline: per-plane time,
+    # complete flows with per-request transfer latency (--json form)
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "trace_view.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    a = tv.analyze(exp)
+    assert "prefill-worker-0" in a["planes"]
+    assert len(a["flows"]) == len(reqs)
+    assert all(fl["complete"] and fl["transfer_ms"] is not None
+               for fl in a["flows"])
+    rendered = tv.summarize(exp)
+    assert "prefill-worker-0" in rendered and "flows:" in rendered
 
 
 @pytest.mark.slow

@@ -153,6 +153,122 @@ def test_prometheus_text_exposition():
     assert cums == sorted(cums)
 
 
+def test_prometheus_label_escaping():
+    """Labeled metrics render as `{k="v"}` blocks with backslash /
+    double-quote / newline escaped (a hostile label value must not
+    corrupt the exposition), share ONE `# TYPE` line per base name,
+    and keep distinct registry keys per label set."""
+    reg = MetricsRegistry()
+    reg.counter("slo_goodput", labels={"slo": "interactive"}).inc(2)
+    reg.counter("slo_goodput", labels={"slo": "batch"}).inc(3)
+    reg.counter("slo_goodput",
+                labels={"slo": 'we"ird\\cl\nass'}).inc(1)
+    h = reg.histogram("lat_ms", lo=1.0, hi=16.0, growth=2.0,
+                      labels={"slo": "interactive"})
+    h.record(3.0)
+    text = prometheus_text(reg)
+    assert 'tdtpu_slo_goodput{slo="interactive"} 2' in text
+    assert 'tdtpu_slo_goodput{slo="batch"} 3' in text
+    assert 'tdtpu_slo_goodput{slo="we\\"ird\\\\cl\\nass"} 1' in text
+    assert "\nass" not in text.replace("\\nass", "")  # no raw newline
+    assert text.count("# TYPE tdtpu_slo_goodput counter") == 1
+    assert 'tdtpu_lat_ms_bucket{le="4",slo="interactive"} 1' in text
+    assert 'tdtpu_lat_ms_count{slo="interactive"} 1' in text
+    # registry keys stay distinct and snapshot-addressable
+    snap = reg.snapshot()
+    assert snap["slo_goodput{slo=interactive}"] == 2
+    assert snap["slo_goodput{slo=batch}"] == 3
+    # label variants of one name must agree on the metric type
+    with pytest.raises(TypeError):
+        reg.gauge("slo_goodput", labels={"slo": "interactive"})
+    # GROUPING: v0.0.4 wants ALL samples of one metric name in a
+    # single group — label variants registered LATER (with unrelated
+    # metrics in between, the configure_slo pattern) must still render
+    # contiguously with their unlabeled sibling
+    reg2 = MetricsRegistry()
+    reg2.counter("reqs").inc(1)
+    reg2.gauge("depth").set(2)
+    reg2.counter("reqs", labels={"slo": "batch"}).inc(5)
+    grouped = prometheus_text(reg2).splitlines()
+    i = grouped.index("# TYPE tdtpu_reqs counter")
+    assert grouped[i + 1] == "tdtpu_reqs 1"
+    assert grouped[i + 2] == 'tdtpu_reqs{slo="batch"} 5'
+    assert sum(1 for ln in grouped
+               if ln.startswith("# TYPE tdtpu_reqs ")) == 1
+
+
+def test_per_class_histogram_quantiles_vs_numpy():
+    """The per-SLO-class histograms are full Histogram instances: the
+    geometric-midpoint quantile bound (sqrt(growth)) holds on them
+    exactly as on the aggregate ones."""
+    from triton_dist_tpu.runtime.telemetry import Telemetry
+    t = Telemetry()
+    t.configure_slo({"interactive": {"ttft_target_ms": 200.0,
+                                     "itl_target_ms": 50.0}})
+    h = t.slo_classes["interactive"].h_ttft
+    assert h.labels == {"slo": "interactive"}
+    rng = np.random.RandomState(3)
+    samples = rng.lognormal(mean=3.0, sigma=1.0, size=4000)
+    for v in samples:
+        h.record(v)
+    tol = float(np.sqrt(h.growth)) + 1e-9
+    for q in (50, 95, 99):
+        exact = float(np.percentile(samples, q))
+        got = h.quantile(q / 100.0)
+        assert exact / tol <= got <= exact * tol, \
+            f"p{q}: got {got}, exact {exact}"
+    # and the registry snapshot carries it under the labeled key
+    snap = t.registry.snapshot()
+    assert snap["ttft_ms{slo=interactive}"]["count"] == 4000
+
+
+def test_slo_goodput_judgement():
+    """Goodput iff retired normally within BOTH class targets; a late
+    first token, a stalled gap, or any non-retired final state is a
+    violation — and goodput + violations partition the class's
+    finished requests exactly."""
+    t = Telemetry()
+    t.configure_slo({
+        "fast": {"ttft_target_ms": 1e9, "itl_target_ms": 1e9},
+        "strict": {"ttft_target_ms": 0.0, "itl_target_ms": 0.0},
+    })
+    # within targets -> goodput
+    t.queued("a", slo="fast")
+    t.emit("a", 1)
+    t.emit("a", 1)
+    t.retire("a")
+    # impossible targets -> violation (TTFT > 0.0ms always)
+    t.queued("b", slo="strict")
+    t.emit("b", 1)
+    t.retire("b")
+    # cancelled mid-stream -> violation even within targets
+    t.queued("c", slo="fast")
+    t.emit("c", 1)
+    t.retire("c", "cancelled")
+    # never emitted (rejected) -> violation
+    t.queued("d", slo="fast")
+    t.retire("d", "rejected")
+    # untagged requests stay out of the partition
+    t.queued("e")
+    t.emit("e", 1)
+    t.retire("e")
+    snap = t.registry.snapshot()
+    assert snap["slo_goodput{slo=fast}"] == 1
+    assert snap["slo_violations{slo=fast}"] == 2
+    assert snap["slo_goodput{slo=strict}"] == 0
+    assert snap["slo_violations{slo=strict}"] == 1
+    # per-class histograms got exactly the tagged samples
+    assert snap["ttft_ms{slo=fast}"]["count"] == 2
+    assert snap["ttft_ms{slo=strict}"]["count"] == 1
+    assert snap["ttft_ms"]["count"] == 4          # aggregate: all
+    # an UNKNOWN class registers lazily with no targets instead of
+    # crashing the driver (bounded-cardinality policy is serving-side)
+    t.queued("f", slo="surprise")
+    t.emit("f", 1)
+    t.retire("f")
+    assert t.registry.snapshot()["slo_goodput{slo=surprise}"] == 1
+
+
 def test_request_lifecycle_derivations():
     """queued -> emit -> emit -> retire yields one ttft sample, one
     inter-token sample, one e2e sample; repeat retires no-op; trace-off

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Perf-regression ledger: diff two bench captures (or the history
+tail) with noise-aware thresholds.
+
+`bench.py` emits one JSON line per row and appends every capture —
+stamped with a run id, git sha, backend and timestamp — to
+`BENCH_history.jsonl` (override the path with TDTPU_BENCH_HISTORY;
+set it empty to disable). This CLI closes the loop: nothing previously
+compared captures over time, so the bench trajectory was write-only.
+
+Usage:
+  python tools/bench_compare.py BENCH_a.json BENCH_b.json
+  python tools/bench_compare.py --history [--file BENCH_history.jsonl]
+  ... [--threshold 0.25] [--strict] [--json]
+
+Rows are matched by metric name (the LAST row per metric in each
+capture wins — a capture file may append multiple runs). Direction is
+inferred from the unit: latency rows ("ms") regress UP, throughput
+rows (tok/s, fractions) regress DOWN. A delta within --threshold
+(default 0.25 — this class of host swings >25% between boxes, see the
+ROADMAP tier-1 budget note) is flagged `noise`, beyond it
+`improved`/`regressed` with direction + magnitude.
+
+NEVER hard-fails on CPU smoke noise: rows from a cpu backend, and
+pairs whose backends differ, are advisory (`cpu-smoke` /
+`cross-backend` note) and exit 0 regardless. --strict exits 1 only
+when a SAME-backend, non-cpu row regressed past the threshold — the
+only comparison a real chip regression gate should trust. Importable:
+`compare(rows_a, rows_b, threshold)` is pure.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLD = 0.25
+DEFAULT_HISTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_history.jsonl")
+
+
+def load_rows(path):
+    """Read one capture: JSON lines (comments/garbage skipped), keep
+    only dict rows that carry a metric and a numeric value."""
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "metric" in d \
+                    and isinstance(d.get("value"), (int, float)):
+                rows.append(d)
+    return rows
+
+
+def _by_metric(rows):
+    out = {}
+    for r in rows:                      # last row per metric wins
+        out[r["metric"]] = r
+    return out
+
+
+def _lower_is_better(row) -> bool:
+    """Regression direction from the unit: latencies ("ms"/"s"/"us"),
+    overhead percentages ("%") and slowdown factors ("x slowdown")
+    regress UP; throughputs (tok/s), fractions and capacity
+    multipliers regress DOWN."""
+    unit = str(row.get("unit", ""))
+    return ("ms" in unit or unit in ("s", "us", "%")
+            or "slowdown" in unit)
+
+
+def compare(rows_a, rows_b, threshold: float = DEFAULT_THRESHOLD):
+    """Pure diff of two captures' rows. Returns a list of per-metric
+    dicts: {metric, a, b, delta_pct, direction, flag, notes} — flag in
+    {improved, regressed, noise, added, removed}; notes carries the
+    advisory markers (cpu-smoke, cross-backend, zero-baseline) that
+    make a flagged row non-gating."""
+    am, bm = _by_metric(rows_a), _by_metric(rows_b)
+    out = []
+    for metric in sorted(set(am) | set(bm)):
+        ra, rb = am.get(metric), bm.get(metric)
+        if ra is None or rb is None:
+            out.append({"metric": metric,
+                        "a": None if ra is None else ra["value"],
+                        "b": None if rb is None else rb["value"],
+                        "delta_pct": None, "direction": None,
+                        "flag": "added" if ra is None else "removed",
+                        "notes": []})
+            continue
+        a, b = float(ra["value"]), float(rb["value"])
+        notes = []
+        back_a = str(ra.get("backend", "?"))
+        back_b = str(rb.get("backend", "?"))
+        if back_a != back_b:
+            notes.append("cross-backend")
+        if "cpu" in (back_a, back_b) or "none" in (back_a, back_b):
+            notes.append("cpu-smoke")
+        lower = _lower_is_better(ra)
+        if a == 0.0:
+            # a zero baseline (outage fallback rows) has no meaningful
+            # ratio — report, never flag
+            notes.append("zero-baseline")
+            out.append({"metric": metric, "a": a, "b": b,
+                        "delta_pct": None, "direction": None,
+                        "flag": "noise", "notes": notes})
+            continue
+        delta = (b - a) / abs(a)
+        better = (delta < 0) if lower else (delta > 0)
+        if abs(delta) < threshold:
+            flag = "noise"
+        else:
+            flag = "improved" if better else "regressed"
+        out.append({
+            "metric": metric, "a": a, "b": b,
+            "delta_pct": round(delta * 100.0, 2),
+            "direction": ("lower-is-better" if lower
+                          else "higher-is-better"),
+            "flag": flag, "notes": notes,
+        })
+    return out
+
+
+def gating_regressions(results):
+    """The only rows a regression gate should trust: regressed, same
+    backend, not a cpu smoke."""
+    return [r for r in results
+            if r["flag"] == "regressed" and not r["notes"]]
+
+
+def history_runs(path):
+    """Group a BENCH_history.jsonl into runs (by the `run` stamp
+    bench.py writes; rows without one fall into a shared legacy
+    bucket), ordered oldest -> newest by first appearance."""
+    order, runs = [], {}
+    for r in load_rows(path):
+        run = str(r.get("run", "legacy"))
+        if run not in runs:
+            runs[run] = []
+            order.append(run)
+        runs[run].append(r)
+    return [(run, runs[run]) for run in order]
+
+
+def render(results, label_a: str, label_b: str) -> str:
+    out = [f"bench compare: {label_a} -> {label_b}"]
+    width = max([len(r["metric"]) for r in results] + [6])
+    for r in results:
+        if r["flag"] in ("added", "removed"):
+            out.append(f"  {r['metric']:<{width}s} {r['flag']}")
+            continue
+        d = r["delta_pct"]
+        arrow = "=" if d is None else ("+" if d >= 0 else "")
+        notes = (" [" + ",".join(r["notes"]) + "]") if r["notes"] \
+            else ""
+        out.append(
+            f"  {r['metric']:<{width}s} {r['a']:>12.4g} -> "
+            f"{r['b']:>12.4g}  "
+            f"{'n/a' if d is None else f'{arrow}{d:.1f}%':>8s}  "
+            f"{r['flag']}{notes}")
+    gates = gating_regressions(results)
+    out.append(f"regressions (gating): {len(gates)}"
+               + ("" if not gates
+                  else "  <- " + ", ".join(g["metric"] for g in gates)))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("captures", nargs="*",
+                    help="two capture files (JSON lines) to diff")
+    ap.add_argument("--history", action="store_true",
+                    help="diff the last two runs of the history ledger")
+    ap.add_argument("--file", default=None,
+                    help=f"history ledger path (default "
+                         f"TDTPU_BENCH_HISTORY or {DEFAULT_HISTORY})")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="noise threshold as a fraction (default 0.25)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on a gating regression (same-backend, "
+                         "non-cpu) — never fails on smoke noise")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable result list")
+    args = ap.parse_args(argv)
+
+    if args.history:
+        path = args.file or os.environ.get("TDTPU_BENCH_HISTORY") \
+            or DEFAULT_HISTORY
+        if not os.path.exists(path):
+            print(f"no history ledger at {path}", file=sys.stderr)
+            return 2
+        runs = history_runs(path)
+        if len(runs) < 2:
+            print(f"history has {len(runs)} run(s); need 2",
+                  file=sys.stderr)
+            return 2
+        (la, rows_a), (lb, rows_b) = runs[-2], runs[-1]
+        label_a, label_b = f"run {la}", f"run {lb}"
+    elif len(args.captures) == 2:
+        label_a, label_b = args.captures
+        rows_a, rows_b = load_rows(label_a), load_rows(label_b)
+    else:
+        ap.error("pass two capture files, or --history")
+        return 2
+    results = compare(rows_a, rows_b, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(results, indent=1))
+    else:
+        print(render(results, label_a, label_b))
+    if args.strict and gating_regressions(results):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Observability smoke — the FULL observability matrix (tier-1's 870 s
+# budget keeps only the cheap arms: the telemetry units, the SLO
+# partition burst, the inline cross-plane flow assertions riding the
+# disagg churn guard). This script runs EVERYTHING — the threaded
+# TokenServer(disagg=True, prefill_workers=2) merged-trace run, the
+# disagg trace-on==off bitwise arm, the slow telemetry/disagg arms —
+# on the forced multi-device CPU mesh, and archives the pass count
+# with a delta vs the previous run (tp_smoke.sh/disagg_smoke.sh
+# pattern). Run from the repo root: bash tools/obs_smoke.sh
+set -o pipefail
+rm -f /tmp/_obs_smoke.log
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_observability.py \
+    tests/test_telemetry.py tests/test_disagg.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_obs_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_obs_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_obs_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "OBS_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "OBS_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

@@ -2,8 +2,12 @@
 # Tier-1 gate — the EXACT command from ROADMAP.md ("Tier-1 verify"),
 # plus a --durations report so builders and reviewers see the same
 # timing picture they would use to (re)assign `slow` marks (pytest.ini),
-# and a DOTS_PASSED delta vs the previous run (count stored next to the
-# log) so a regression is one glance, not two terminal scrollbacks.
+# a DOTS_PASSED delta vs the previous run (count stored next to the
+# log) so a regression is one glance, not two terminal scrollbacks,
+# and a tier1_history.tsv ledger (date, pass count, wall seconds, rc)
+# next to the archived trace artifact so the suite's trajectory on
+# this host — pass count AND wall-vs-the-870s-budget — is greppable
+# across runs instead of living in lost scrollback.
 # Run from the repo root: bash tools/tier1.sh
 set -o pipefail
 rm -f /tmp/_t1.log /tmp/_t1.trace.json
@@ -11,12 +15,14 @@ rm -f /tmp/_t1.log /tmp/_t1.trace.json
 # is stream-exact by contract, so this doubles as a suite-wide
 # integration check); the last TokenServer to exit leaves its
 # perfetto-loadable timeline next to this log — inspect with
-# python tools/trace_view.py /tmp/_t1.trace.json
+# python tools/trace_view.py /tmp/_t1.trace.json  (--json for CI)
+t0=$SECONDS
 timeout -k 10 870 env JAX_PLATFORMS=cpu TDTPU_TRACE=/tmp/_t1.trace.json \
     python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly --durations=20 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
+wall=$((SECONDS - t0))
 passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 last_file=/tmp/_t1.last
 if [ -f "$last_file" ]; then
@@ -28,6 +34,10 @@ else
     echo "DOTS_PASSED=$passed"
 fi
 echo "$passed" > "$last_file"
+hist=/tmp/tier1_history.tsv
+[ -f "$hist" ] || printf 'date\tdots_passed\twall_s\trc\n' > "$hist"
+printf '%s\t%s\t%s\t%s\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$passed" "$wall" "$rc" >> "$hist"
+echo "TIER1_HISTORY=$hist ($(($(wc -l < "$hist") - 1)) runs; wall ${wall}s of the 870s budget)"
 if [ -s /tmp/_t1.trace.json ]; then
     echo "TRACE_ARTIFACT=/tmp/_t1.trace.json ($(wc -c < /tmp/_t1.trace.json) bytes; summarize: python tools/trace_view.py /tmp/_t1.trace.json)"
 fi

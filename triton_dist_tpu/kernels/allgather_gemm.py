@@ -374,13 +374,13 @@ def ag_gemm(a, b, ctx: Optional[AllGatherGEMMTensorParallelContext] = None,
     (tools/profiler/language.py:38) within what Mosaic exposes — see
     tools/kprof.py.
     """
-    # comm-kernel trace counter (runtime/telemetry.py, process-global
-    # registry): counts each time this kernel is BUILT into a program
-    # (python call = jit trace time) — paired with the Engine's
-    # per-dispatch `comm_kernel_dispatches`, the observable proof that
-    # a serving topology actually routes through the comm kernels.
-    from triton_dist_tpu.runtime.telemetry import default_registry
-    default_registry().counter("comm_kernel_traces").inc()
+    # comm-kernel trace + bytes-moved accounting (runtime/telemetry.py
+    # trace_comm_kernel, process-global registry): counts each build
+    # of this kernel into a program and the A panel the ring gathers,
+    # so a trace derives per-kernel effective bandwidth — paired with
+    # the Engine's per-dispatch `comm_kernel_dispatches`.
+    from triton_dist_tpu.runtime.telemetry import trace_comm_kernel
+    trace_comm_kernel("ag_gemm", int(a.size) * a.dtype.itemsize)
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
     bq = b.q if quant else b

@@ -221,15 +221,16 @@ def all_reduce(x_partials, *, mesh: Mesh, axis: str = "tp",
     x_partials: [n, M, cols] sharded on dim 0 over `axis`. Returns
     [M, cols] = sum_d x_partials[d].
     """
-    # comm-kernel trace counter (runtime/telemetry.py, process-global
-    # registry): counts each time this kernel is BUILT into a program
-    # (python call = jit trace time) — paired with the Engine's
-    # per-dispatch `comm_kernel_dispatches`, the observable proof that
-    # a serving topology actually routes through the comm kernels.
-    from triton_dist_tpu.runtime.telemetry import default_registry
-    default_registry().counter("comm_kernel_traces").inc()
+    # comm-kernel trace + bytes-moved accounting (runtime/telemetry.py
+    # trace_comm_kernel, process-global registry): counts each build
+    # of this kernel into a program and the payload it reduces, so a
+    # trace derives per-kernel effective bandwidth — paired with the
+    # Engine's per-dispatch `comm_kernel_dispatches`.
+    from triton_dist_tpu.runtime.telemetry import trace_comm_kernel
     n = mesh.shape[axis]
     _, M, cols = x_partials.shape
+    trace_comm_kernel("all_reduce",
+                      int(M) * int(cols) * x_partials.dtype.itemsize)
     if n == 1:
         return x_partials[0]
     if collective_id is None:

@@ -251,16 +251,17 @@ def gemm_allreduce(a, b, ctx: Optional[GemmARContext] = None, *,
     [M, N] replicated over `axis` — the torch-AR-equivalent TP epilogue
     but without a separate collective.
     """
-    # comm-kernel trace counter (runtime/telemetry.py, process-global
-    # registry): counts each time this kernel is BUILT into a program
-    # (python call = jit trace time) — paired with the Engine's
-    # per-dispatch `comm_kernel_dispatches`, the observable proof that
-    # a serving topology actually routes through the comm kernels.
-    from triton_dist_tpu.runtime.telemetry import default_registry
-    default_registry().counter("comm_kernel_traces").inc()
+    # comm-kernel trace + bytes-moved accounting (runtime/telemetry.py
+    # trace_comm_kernel, process-global registry): counts each build
+    # of this kernel into a program and the C payload it allreduces,
+    # so a trace derives per-kernel effective bandwidth — paired with
+    # the Engine's per-dispatch `comm_kernel_dispatches`.
+    from triton_dist_tpu.runtime.telemetry import trace_comm_kernel
     from triton_dist_tpu.kernels.quant import QuantW
     quant = isinstance(b, QuantW)
     bq = b.q if quant else b
+    trace_comm_kernel("gemm_ar", int(a.shape[0]) * int(bq.shape[1])
+                      * a.dtype.itemsize)
     if ctx is None:
         assert mesh is not None, "pass ctx or mesh"
         ctx = create_gemm_ar_context(mesh, axis)

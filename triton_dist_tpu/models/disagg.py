@@ -77,6 +77,21 @@ so decode polls never block on a prefill forward at all (the CPU smoke
 approximation of dedicated prefill chips; on a real deployment each
 worker is its own mesh slice and `transport` picks ICI or DCN).
 
+OBSERVABILITY (runtime/telemetry.py — PR 11): a disaggregated trace
+is ONE merged timeline. Each prefill worker owns a named track
+(`prefill:compute` / `kv_push` spans — inline and threaded alike),
+and a request's trace context (`KVHandoff.flow_id`) propagates across
+the transfer wire so its journey draws as a Chrome flow-arrow chain
+route -> prefill compute -> kv_push -> kv_install joining both
+planes; `tools/trace_view.py` reports per-plane time and per-request
+transfer latency. The staging pools are gauge-visible per worker
+(`staging_pages_resident{worker=...}` — 0 at idle IS the zero-leak
+invariant — plus peak/occupancy), prefill-plane and transfer device
+time land in their own `device_wait_s_by_kind` buckets, and SLO
+classes (`Request.slo`) ride through unchanged. All host-side only:
+trace-on == trace-off bitwise with zero new XLA programs
+(tests/test_disagg.py churn guard, tests/test_observability.py).
+
 When fused chunked prefill is still the right call: see the README
 "Disaggregated serving" section — at low admission rates or tiny
 prompts the transfer latency buys nothing and one mesh is simpler.
@@ -111,13 +126,19 @@ class KVHandoff:
     planes when the pool is int8), and the arming logits row the
     decode slot needs (the fused admission gets it from the same
     forward — the device transports ship it alongside the pages).
-    `t_push` stamps the push for kv_transfer_latency_ms."""
+    `t_push` stamps the push for kv_transfer_latency_ms. `flow_id` is
+    the request's TRACE CONTEXT, propagated across the prefill ->
+    decode transfer wire: the decode-side install ends the same
+    Chrome-trace flow chain the prefill plane started, so ONE merged
+    trace shows the request's journey across both planes (0 = tracing
+    off, no chain)."""
     req: Request
     n: int                              # prompt length
     npp: int                            # prompt page-groups staged
     payload: Dict[str, Optional[np.ndarray]]
     logits_row: np.ndarray              # [V] f32
     t_push: float = 0.0
+    flow_id: int = 0
 
     def wire_arrays(self) -> Dict[str, Optional[np.ndarray]]:
         """Everything a device transport must move: the page payload
@@ -225,10 +246,12 @@ class PrefillWorker:
     under injected worker death (tests/test_disagg.py)."""
 
     def __init__(self, engine, *, page: int = 16,
-                 num_pages: Optional[int] = None, fault=None):
+                 num_pages: Optional[int] = None, fault=None,
+                 name: str = "prefill-worker-0"):
         from triton_dist_tpu.models.prefix_cache import RefcountedPages
         self.engine = engine
         self.page = page
+        self.name = name             # trace track + gauge label
         self.cache = engine.make_paged_slot_cache(1, page=page,
                                                   num_pages=num_pages)
         Hkv = engine.model.config.num_kv_heads
@@ -237,6 +260,16 @@ class PrefillWorker:
         assert self.pool.trash == self.cache.trash
         self.fault = fault
         self.prefill_tokens = 0      # prompt tokens this worker forwarded
+        # staging-pool visibility (the decode pool's gauges exist; this
+        # is the other half of the zero-leak invariant): pages held NOW
+        # (0 between jobs — a nonzero idle value IS a leak) and the
+        # high-water mark across jobs, surfaced per worker by
+        # DisaggScheduler.stats()
+        self.pages_peak = 0
+        # wall time this worker spent blocked on its plane's device
+        # programs (prefill forward + payload extraction) — the
+        # "prefill" bucket of device_wait_s_by_kind
+        self.device_s = 0.0
 
     @property
     def capacity(self) -> int:
@@ -260,9 +293,12 @@ class PrefillWorker:
                 f"staging capacity {self.capacity}")
         npp = -(-n // self.page)
         groups: List[np.ndarray] = []
+        t_dev = time.perf_counter()
         try:
             for _ in range(npp):
                 groups.append(self.pool.alloc_group())
+            if self.pool.pages_in_use > self.pages_peak:
+                self.pages_peak = self.pool.pages_in_use
             maxp = self.cache.table.shape[1]
             rows = np.full((self.hkv, maxp), self.cache.trash, np.int32)
             for j, g in enumerate(groups):
@@ -285,6 +321,7 @@ class PrefillWorker:
             payload.setdefault("vs", None)
             logits_np = np.asarray(jax.device_get(row), np.float32)
         finally:
+            self.device_s += time.perf_counter() - t_dev
             for g in groups:
                 self.pool.release(g)
         self.prefill_tokens += n
@@ -317,7 +354,8 @@ class DisaggScheduler(ContinuousScheduler):
                  telemetry=None, trace: Optional[bool] = None,
                  prefill_workers: int = 1, threads: bool = False,
                  transport=None, staging_pages: Optional[int] = None,
-                 prefill_jobs_per_poll: int = 1):
+                 prefill_jobs_per_poll: int = 1,
+                 slo_classes: Optional[dict] = None):
         """prefill_workers: dedicated prefill workers, each with its
         own staging pool and engine facade — a THREAD-MODE knob.
         threads=True runs them on daemon threads so decode polls never
@@ -339,7 +377,7 @@ class DisaggScheduler(ContinuousScheduler):
                          preempt=preempt, fault=fault,
                          host_pool_pages=host_pool_pages,
                          overlap=overlap, telemetry=telemetry,
-                         trace=trace)
+                         trace=trace, slo_classes=slo_classes)
         self.engine = engine
         self.transport = transport if transport is not None \
             else HostTransport()
@@ -364,8 +402,16 @@ class DisaggScheduler(ContinuousScheduler):
         self._workers = [
             PrefillWorker(_sibling_engine(engine) if self.threads
                           else engine, page=page,
-                          num_pages=staging_pages, fault=fault)
-            for _ in range(n_workers)]
+                          num_pages=staging_pages, fault=fault,
+                          name=f"prefill-worker-{i}")
+            for i in range(n_workers)]
+        # cross-plane trace context: rid -> flow id, allocated at
+        # ROUTING when tracing is on; the id rides the KVHandoff over
+        # the transfer wire and the decode-side install ends the chain
+        # (route -> prefill compute -> kv_push -> kv_install as flow
+        # arrows in ONE merged trace). Mutations under _pf_cond.
+        self._flow_ids: Dict[object, int] = {}
+        self._flow_seq = 0
         reg = self.tele.registry
         reg.gauge("disagg", "1 = prefill/decode disaggregation on"
                   ).set(1)
@@ -450,6 +496,13 @@ class DisaggScheduler(ContinuousScheduler):
         rid = req.rid
         if rid not in self._pending:       # cancelled while queued
             return
+        # cross-plane tracing: this job's spans land on the WORKER's
+        # own timeline track, joined to the decode plane by the
+        # request's flow chain (flow id allocated at routing)
+        tele = self.tele
+        tid = tele.track(worker.name) if tele.trace else 0
+        fid = self._flow_ids.get(rid, 0)
+        t_job = time.monotonic()
         try:
             handoff = worker.prefill(req)
         except PrefillWorkerDied:
@@ -464,9 +517,16 @@ class DisaggScheduler(ContinuousScheduler):
             with self._lock:
                 with self._pf_cond:
                     self._pending.pop(rid, None)
+                    self._flow_ids.pop(rid, None)
                 self._reject(rid, str(e))
                 self._async_done.append(rid)
             return
+        handoff.flow_id = fid
+        tele.span("prefill:compute", t_job, time.monotonic(), tid=tid,
+                  args={"rid": str(rid), "tokens": handoff.n})
+        if fid:
+            tele.flow("kv_transfer", fid, phase="t", tid=tid,
+                      args={"rid": str(rid)})
         self._c_plane_tokens.inc(handoff.n)
         action = None
         if self.fault is not None:
@@ -485,13 +545,21 @@ class DisaggScheduler(ContinuousScheduler):
         # push IS the transfer, and kv_transfer_latency_ms exists to
         # show an operator a slow fabric
         t_push = time.perf_counter()
+        t_span = time.monotonic()
         handoff = self.transport.push(handoff)
         handoff.t_push = t_push
+        if fid:
+            tele.flow("kv_transfer", fid, phase="t", tid=tid,
+                      args={"rid": str(rid), "at": "kv_push"})
+        tele.span("kv_push", t_span, time.monotonic(), tid=tid,
+                  args={"rid": str(rid),
+                        "transport": getattr(self.transport, "name",
+                                             "?")})
         self._c_pages.inc(handoff.npp * worker.hkv)
         self._c_bytes.inc(sum(a.nbytes for a in
                               handoff.wire_arrays().values()
                               if a is not None))
-        self.tele.instant("kv_push", str(rid))
+        self.tele.instant("kv_push", str(rid), tid=tid)
         with self._pf_cond:
             self._transfers.append(handoff)
             if action == "dup":
@@ -554,6 +622,8 @@ class DisaggScheduler(ContinuousScheduler):
         hkv = pool.n_kv_heads
         npp = -(-n // slots.page)
         full = m // slots.page
+        t_dev = time.perf_counter()
+        t_span = time.monotonic()
         try:
             trash_vec = np.full((hkv,), slots.cache.trash, np.int32)
             slots.cache = self.engine.install_slot_paged(
@@ -571,6 +641,12 @@ class DisaggScheduler(ContinuousScheduler):
             for g in slot_groups:
                 pool.release(g)
             raise
+        # the table install + payload restore are the transfer plane's
+        # device programs — attributed to the "transfer" bucket of
+        # device_wait_s_by_kind (the decode/verify buckets stay pure)
+        slots.device_wait_by_kind["transfer"] = \
+            slots.device_wait_by_kind.get("transfer", 0.0) \
+            + (time.perf_counter() - t_dev)
         slots._groups[slot] = slot_groups
         slots._tokens[slot] = _TokenLog(tokens)
         slots.prefix.record(n, m)
@@ -582,6 +658,15 @@ class DisaggScheduler(ContinuousScheduler):
         if handoff.t_push:
             self._h_transfer.record(
                 (time.perf_counter() - handoff.t_push) * 1e3)
+        # end the cross-plane flow chain on the host track: the
+        # kv_install span + "f" arrowhead the prefill plane's
+        # kv_push points at (ONE merged trace, both planes)
+        if handoff.flow_id:
+            self.tele.flow("kv_transfer", handoff.flow_id, phase="f",
+                           args={"rid": str(req.rid),
+                                 "at": "kv_install"})
+        self.tele.span("kv_install", t_span, time.monotonic(),
+                       args={"rid": str(req.rid), "slot": slot})
         self.tele.instant("kv_install", str(req.rid))
 
     # ------------------------------------------------------------------
@@ -627,6 +712,17 @@ class DisaggScheduler(ContinuousScheduler):
             del self._queue[i]
             with self._pf_cond:
                 self._pending[req.rid] = req
+                if self.tele.trace:
+                    # start the request's cross-plane flow chain on
+                    # the host track (inside the bookkeep span): the
+                    # worker's compute/push and the decode-side
+                    # install continue it
+                    self._flow_seq += 1
+                    self._flow_ids[req.rid] = self._flow_seq
+                    self.tele.flow("kv_transfer", self._flow_seq,
+                                   phase="s",
+                                   args={"rid": str(req.rid),
+                                         "at": "route"})
             self._submit_prefill(req)
         # inline prefill service: the driver stands in for the worker
         # pool, bounded per poll so a deep admission burst cannot
@@ -662,6 +758,7 @@ class DisaggScheduler(ContinuousScheduler):
                     self._install(free[0], handoff)
                     with self._pf_cond:
                         self._pending.pop(rid, None)
+                        self._flow_ids.pop(rid, None)
                     self.tele.req_event(rid, "admitted", free[0])
                     continue
                 except PoolExhausted as e:
@@ -678,6 +775,7 @@ class DisaggScheduler(ContinuousScheduler):
                             return
                         with self._pf_cond:
                             self._pending.pop(h.req.rid, None)
+                            self._flow_ids.pop(h.req.rid, None)
                         self._reject(h.req.rid, reason)
                         done.append(h.req.rid)
 
@@ -690,6 +788,7 @@ class DisaggScheduler(ContinuousScheduler):
                 except ValueError as e:
                     with self._pf_cond:
                         self._pending.pop(rid, None)
+                        self._flow_ids.pop(rid, None)
                     self._reject(rid, str(e))
                     done.append(rid)
                     continue
@@ -747,6 +846,7 @@ class DisaggScheduler(ContinuousScheduler):
         with self._pf_cond:
             for rid in expired:
                 req = self._pending.pop(rid, None)
+                self._flow_ids.pop(rid, None)
                 if req is not None:
                     victims.append(req)
             if victims:
@@ -770,6 +870,7 @@ class DisaggScheduler(ContinuousScheduler):
             with self._pf_cond:
                 if rid in self._pending:
                     self._pending.pop(rid)
+                    self._flow_ids.pop(rid, None)
                     self._prefill_q = deque(
                         r for r in self._prefill_q if r.rid != rid)
                     self._deadline.pop(rid, None)
@@ -783,6 +884,11 @@ class DisaggScheduler(ContinuousScheduler):
 
     def stats(self) -> dict:
         reg = self.tele.registry
+        # the prefill plane's device time rolls into the attribution
+        # split BEFORE the superclass snapshots it (threads=True: this
+        # is plane-busy time, not driver wait — same bucket either way)
+        self.slots.device_wait_by_kind["prefill"] = round(
+            sum(w.device_s for w in self._workers), 4)
         with self._lock, reg.lock:
             with self._pf_cond:
                 reg.gauge("prefill_queue_depth",
@@ -794,6 +900,26 @@ class DisaggScheduler(ContinuousScheduler):
                 pend = len(self._pending)
             reg.gauge("prefill_pending",
                       "requests owned by the prefill plane").set(pend)
+            # staging-pool gauges, per worker (decode pool gauges
+            # already exist — this is the other half of the zero-leak
+            # invariant made visible: resident must be 0 between jobs)
+            staging_resident = 0
+            staging_peak = 0
+            for w in self._workers:
+                usable = max(1, w.pool.num_pages - 1)  # minus trash
+                in_use = w.pool.pages_in_use
+                lb = {"worker": w.name}
+                reg.gauge("staging_pages_resident",
+                          "staging pages held right now (nonzero at "
+                          "idle = leak)", labels=lb).set(in_use)
+                reg.gauge("staging_pages_peak",
+                          "staging high-water mark across jobs",
+                          labels=lb).set(w.pages_peak)
+                reg.gauge("staging_occupancy",
+                          "resident / usable staging pages",
+                          labels=lb).set(round(in_use / usable, 4))
+                staging_resident += in_use
+                staging_peak = max(staging_peak, w.pages_peak)
             out = super().stats()
         out.update({
             "disagg": True,
@@ -807,5 +933,7 @@ class DisaggScheduler(ContinuousScheduler):
             "transfer_drops": self._c_drops.value,
             "transfer_retries": self._c_retries.value,
             "prefill_worker_deaths": self._c_deaths.value,
+            "staging_pages_resident": staging_resident,
+            "staging_pages_peak": staging_peak,
         })
         return out

@@ -130,8 +130,15 @@ and `inter_token_ms` p50/p95/p99 histograms — and, with
 ``trace=True`` (or TDTPU_TRACE set), a perfetto-loadable poll-loop
 timeline: host phase spans, device occupancy (dispatch → `_fetch`
 landing), and instants for watchdog fires / preemptions / drains.
+Requests may carry an SLO CLASS (`Request.slo`, classes + targets via
+``slo_classes=``): latencies then also land in per-class histograms
+and partition exactly into slo_goodput/slo_violations — the signal an
+SLO-aware admission/preemption policy consumes (ROADMAP item 4). The
+coalesced device wait additionally splits per program kind
+(``stats()["device_wait_s_by_kind"]``, keyed off mark_dispatch(kind)).
 Tracing is host-side only: streams stay BITWISE identical trace-on
-vs trace-off with zero new XLA programs (tests/test_telemetry.py).
+vs trace-off with zero new XLA programs (tests/test_telemetry.py,
+tests/test_observability.py).
 
 Multi-chip TP (ROADMAP open item 1): ONE scheduler drives a whole
 TP=N mesh. The paged pool's page payloads are head-sharded over the
@@ -191,13 +198,19 @@ class Request:
 
     deadline_ms: optional latency budget from submit(); an expired
     request is cancelled with a visible error instead of occupying a
-    slot past its usefulness. resume: set internally by preemption —
-    callers never construct it."""
+    slot past its usefulness. slo: optional SLO class name
+    (runtime/telemetry.py DEFAULT_SLO_CLASSES — "interactive" /
+    "batch", or any class the scheduler's `slo_classes` configured):
+    lifecycle latencies then land in per-class histograms and the
+    request is judged into slo_goodput / slo_violations at its final
+    transition. resume: set internally by preemption — callers never
+    construct it."""
     rid: object                    # caller's id (any hashable)
     ids: np.ndarray                # prompt token ids [S]
     gen_len: int
     seed: int = 0
     deadline_ms: Optional[float] = None
+    slo: Optional[str] = None
     resume: Optional[ResumeState] = None
 
 
@@ -285,6 +298,18 @@ class _InFlight:
     arm: list = dataclasses.field(default_factory=list)
 
 
+# mark_dispatch kinds -> the attribution buckets stats() reports
+# (device_wait_s_by_kind): the chunk scan is the decode tick, and a
+# mixed verify is still a mixed tick — the operator-facing question is
+# "which program CLASS am I waiting on", not which jit entry point
+_DISPATCH_KIND = {"chunk": "decode", "mixed_verify": "mixed"}
+
+# _InFlight.kind -> the same buckets, for the overlap land (which must
+# charge the LANDED tick's kind, not whatever dispatched since)
+_INFLIGHT_KIND = {"chunk": "decode", "mixed": "mixed",
+                  "spec": "verify", "mixed_spec": "mixed"}
+
+
 def _merge_out(acc: Dict[object, np.ndarray], rid, toks) -> None:
     """Append landed tokens for one rid to a poll's output dict (a
     drained tick and a freshly landed one can both deliver in the same
@@ -357,6 +382,21 @@ class DecodeSlots:
         # dispatch-to-dispatch interval to report host_ms_per_poll
         self._inflight: Optional[_InFlight] = None
         self.device_wait_s = 0.0
+        # device-time ATTRIBUTION: the same blocking wait split per
+        # program kind, keyed off the kind of the most recent
+        # mark_dispatch (decode/verify/mixed; "admit" for the
+        # out-of-band arming fetches). The disagg plane owns the
+        # "prefill"/"transfer" buckets (models/disagg.py) — together
+        # the per-kind gauges tell an operator WHICH program class the
+        # host actually waits on (stats()["device_wait_s_by_kind"]).
+        # PRE-SEEDED with every bucket so the driver's _fetch only
+        # ever updates existing keys — cross-thread stats() readers
+        # iterate this dict, and a mid-iteration dict RESIZE (unlike a
+        # value update) would raise under them.
+        self.device_wait_by_kind: Dict[str, float] = {
+            "prefill": 0.0, "decode": 0.0, "verify": 0.0,
+            "mixed": 0.0, "admit": 0.0, "transfer": 0.0,
+            "other": 0.0}
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
@@ -565,7 +605,8 @@ class DecodeSlots:
         if self.spec:
             self._hist[slot] = _TokenLog()
 
-    def _fetch(self, arrs: tuple, *, land: bool = True) -> tuple:
+    def _fetch(self, arrs: tuple, *, land: bool = True,
+               kind: Optional[str] = None) -> tuple:
         """The ONE blocking readback of a tick: a single coalesced
         jax.device_get over every array the tick hands back, timed
         into device_wait_s (the scheduler reports host_ms_per_poll =
@@ -574,11 +615,29 @@ class DecodeSlots:
         one poll later). land=False for out-of-band readbacks (the
         spec arming seed fetches): they must NOT close the device-
         occupancy span of a tick still in flight — under overlap,
-        admission runs between a verify's dispatch and its land."""
+        admission runs between a verify's dispatch and its land.
+
+        kind: explicit attribution bucket for the wait. The overlap
+        land passes its in-flight tick's own kind — by land time the
+        NEXT tick's dispatch has already overwritten tele.last_kind,
+        so deriving it here would misattribute every transition poll.
+        None (the sync paths, where the fetch directly follows its
+        own mark_dispatch) derives from last_kind; land=False charges
+        "admit" (arming fetches block on the admission forward)."""
         import jax
         t0 = time.perf_counter()
         out = jax.device_get(arrs)
-        self.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.device_wait_s += dt
+        if kind is None:
+            kind = (_DISPATCH_KIND.get(self.tele.last_kind,
+                                       self.tele.last_kind)
+                    if land else "admit")
+        # pre-seeded buckets only: stats() readers iterate this dict
+        # cross-thread, so _fetch must never RESIZE it
+        if kind not in self.device_wait_by_kind:
+            kind = "other"
+        self.device_wait_by_kind[kind] += dt
         if land:
             # close the device-occupancy span stamped at dispatch
             # (no-op when tracing is off or nothing is pending)
@@ -1008,7 +1067,8 @@ class DecodeSlots:
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
         if inf.kind in ("chunk", "mixed"):
-            (toks,) = self._fetch(inf.arrs)
+            (toks,) = self._fetch(inf.arrs,
+                                  kind=_INFLIGHT_KIND[inf.kind])
             toks = np.asarray(toks)
             for b, rid, keep in inf.plan:
                 assert self.rids[b] == rid, \
@@ -1019,7 +1079,8 @@ class DecodeSlots:
                 self._record(b, kept)
             finished = inf.finishing
         else:                                  # "spec" / "mixed_spec"
-            n_emit, t0n = self._fetch(inf.arrs)
+            n_emit, t0n = self._fetch(inf.arrs,
+                                      kind=_INFLIGHT_KIND[inf.kind])
             n_emit, t0n = np.asarray(n_emit), np.asarray(t0n)
             for b, rid in inf.plan:
                 assert self.rids[b] == rid, \
@@ -1400,7 +1461,8 @@ class ContinuousScheduler:
                  prefill_budget: Optional[int] = None,
                  host_pool_pages: int = 0, overlap: bool = False,
                  telemetry: Optional[Telemetry] = None,
-                 trace: Optional[bool] = None):
+                 trace: Optional[bool] = None,
+                 slo_classes: Optional[dict] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): admissions
         reuse cached prefix pages and skip that prefill work;
@@ -1477,7 +1539,16 @@ class ContinuousScheduler:
         occupancy); the default is the TDTPU_TRACE env convention.
         Tracing is host-side only — streams stay bitwise identical
         and no new XLA program compiles (tests/test_telemetry.py).
-        Pass `telemetry` to share or pre-configure the bundle."""
+        Pass `telemetry` to share or pre-configure the bundle.
+
+        slo_classes: {class_name: {"ttft_target_ms": float,
+        "itl_target_ms": float}} — the SLO classes requests may tag at
+        submit (Request.slo). None registers the telemetry defaults
+        (interactive/batch, runtime/telemetry.DEFAULT_SLO_CLASSES).
+        Tagged requests land their latencies in per-class histograms
+        and partition into slo_goodput / slo_violations counters at
+        their final transition — the measurement substrate ROADMAP
+        item 4's admission/preemption policies will consume."""
         if prefill_budget is not None and prefill_budget < 1:
             raise ValueError(f"prefill_budget must be >= 1, got "
                              f"{prefill_budget}")
@@ -1487,6 +1558,7 @@ class ContinuousScheduler:
             if trace is None:
                 trace = trace_env_enabled()
             self.tele = Telemetry(trace=trace)
+        self.tele.configure_slo(slo_classes)
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
@@ -1607,7 +1679,7 @@ class ContinuousScheduler:
             # lifecycle stamp INSIDE the lock: the driver may admit
             # (and emit for) this request the instant it is visible in
             # the queue, and emit/retire need the record to exist
-            self.tele.queued(req.rid)
+            self.tele.queued(req.rid, slo=req.slo)
             self._queue.append(req)
         return True
 
@@ -1686,6 +1758,17 @@ class ContinuousScheduler:
             reg.gauge("prefills_in_progress").set(
                 len(self.slots.prefill_slots))
             reg.gauge("device_wait_s").set(self.slots.device_wait_s)
+            # device-time attribution: the coalesced wait split per
+            # program kind (decode/verify/mixed/admit — the fused
+            # planes; the disagg subclass owns prefill/transfer). A
+            # DISTINCT base name from the device_wait_s total, so
+            # summing the labeled series never double-counts it.
+            by_kind = {k: round(v, 4) for k, v in
+                       self.slots.device_wait_by_kind.items()}
+            for k in ("prefill", "decode", "verify", "mixed",
+                      "admit", "transfer"):
+                reg.gauge("device_wait_kind_s",
+                          labels={"kind": k}).set(by_kind.get(k, 0.0))
             # live throughput, aggregate AND per-chip (one scheduler
             # drives the whole TP mesh — the per-chip number is the
             # one comparable across topologies)
@@ -1724,6 +1807,11 @@ class ContinuousScheduler:
                 "host_ms_per_poll": (0.0 if self._host_ms_ema is None
                                      else round(self._host_ms_ema, 3)),
                 "device_wait_s": round(self.slots.device_wait_s, 4),
+                "device_wait_s_by_kind": by_kind,
+                "slo_classes": {
+                    name: {"ttft_target_ms": c.ttft_target_ms,
+                           "itl_target_ms": c.itl_target_ms}
+                    for name, c in self.tele.slo_classes.items()},
             })
             if self._hang is not None:
                 out["hang"] = self._hang

@@ -16,10 +16,14 @@ from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
 )
 from triton_dist_tpu.runtime.telemetry import (  # noqa: F401
     Counter,
+    DEFAULT_SLO_CLASSES,
     Gauge,
     Histogram,
     MetricsRegistry,
     Telemetry,
     default_registry,
+    escape_label_value,
+    labeled_name,
     prometheus_text,
+    trace_comm_kernel,
 )

@@ -39,6 +39,36 @@ its observability substrate:
   drain stalls are spans you can measure instead of numbers you
   infer.
 
+- SLO CLASSES + GOODPUT: requests may tag an SLO class at submit
+  (`interactive` / `batch` by default — `DEFAULT_SLO_CLASSES`; a
+  scheduler passes its own via `configure_slo`). Lifecycle latencies
+  then ALSO land in per-class `ttft_ms{slo=...}` /
+  `inter_token_ms{slo=...}` histograms, and every final transition is
+  judged against the class targets: a request that retired normally
+  with TTFT <= `ttft_target_ms` and every inter-token gap <=
+  `itl_target_ms` counts into `slo_goodput{slo=...}`, anything else
+  (late, stalled, cancelled, expired, rejected) into
+  `slo_violations{slo=...}` — the two counters PARTITION the class's
+  finished requests exactly. This is the signal an SLO-aware
+  admission/preemption policy consumes (DistServe's per-phase SLO
+  framing — ROADMAP item 4).
+
+- CROSS-PLANE TIMELINE: beyond the host(0)/device(1) tracks, callers
+  can allocate named TRACKS (`track()` — the disagg prefill workers
+  each get one) and stamp spans on them (`span()`), and connect
+  related work across planes with Chrome trace FLOW events
+  (`flow()`: s/t/f arrows — the disagg transfer plane draws
+  route -> prefill compute -> kv_push -> kv_install as one arrow
+  chain per request, so a single request's journey reads across both
+  planes in one merged trace).
+
+- DEVICE-TIME ATTRIBUTION: `mark_dispatch(kind)` always remembers the
+  LAST dispatched program kind (one attribute write — trace-off stays
+  a no-op for streams), so the scheduler's coalesced readback can
+  attribute its blocking wait per program kind
+  (DecodeSlots.device_wait_by_kind: decode/verify/mixed/admit, plus
+  the disagg plane's prefill/transfer buckets).
+
 Tracing OFF (the default) is a true no-op: every trace entry point
 early-outs on `self.trace` before touching a ring or stamping a
 span. Tracing ON is host-side only — no jax call anywhere in this
@@ -46,7 +76,8 @@ module — so token streams stay BITWISE identical and zero new XLA
 programs compile (asserted by tests/test_telemetry.py). Enable with
 `ContinuousScheduler(trace=True)` / `TokenServer(trace=True)` or by
 setting `TDTPU_TRACE=path` (the TokenServer also dumps the trace to
-that path on exit); summarize dumps with `tools/trace_view.py`.
+that path on exit); summarize dumps with `tools/trace_view.py`
+(`--json` for the machine-readable form CI and bench_compare read).
 """
 
 from __future__ import annotations
@@ -63,17 +94,31 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def labeled_name(name: str, labels: Optional[Dict[str, str]]) -> str:
+    """The registry/snapshot key of a (possibly labeled) metric:
+    `name` alone, or `name{k=v,...}` with the labels sorted — compact
+    and stable, so stats() consumers can address per-class series
+    (e.g. `ttft_ms{slo=interactive}`) without parsing exposition
+    syntax."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
 class Counter:
     """Monotonic event counter. `inc()` is a plain int add (GIL-atomic
     enough for the single-writer driver thread; cross-thread writers
     — e.g. busy rejections from reader threads — tolerate the same
     best-effort semantics the raw-int counters always had)."""
 
-    __slots__ = ("name", "help", "_v")
+    __slots__ = ("name", "help", "labels", "_v")
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", *,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
+        self.labels = labels
         self._v = 0
 
     def inc(self, n: int = 1) -> None:
@@ -90,11 +135,13 @@ class Counter:
 class Gauge:
     """Point-in-time value (pool occupancy, an EMA, a queue depth)."""
 
-    __slots__ = ("name", "help", "_v")
+    __slots__ = ("name", "help", "labels", "_v")
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", *,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
+        self.labels = labels
         self._v = 0.0
 
     def set(self, v: float) -> None:
@@ -128,17 +175,19 @@ class Histogram:
     sqrt(growth) (~9.3% at the default growth of 2**0.25) —
     tests/test_telemetry.py pins this against numpy.percentile."""
 
-    __slots__ = ("name", "help", "lo", "growth", "edges", "counts",
-                 "n", "total", "_log_lo", "_inv_log_g", "_nbins",
-                 "_top")
+    __slots__ = ("name", "help", "labels", "lo", "growth", "edges",
+                 "counts", "n", "total", "_log_lo", "_inv_log_g",
+                 "_nbins", "_top")
 
     def __init__(self, name: str, help: str = "", *, lo: float = 0.01,
-                 hi: float = 6e5, growth: float = 2.0 ** 0.25):
+                 hi: float = 6e5, growth: float = 2.0 ** 0.25,
+                 labels: Optional[Dict[str, str]] = None):
         if not (lo > 0 and hi > lo and growth > 1.0):
             raise ValueError(f"bad histogram bounds: lo={lo} hi={hi} "
                              f"growth={growth}")
         self.name = name
         self.help = help
+        self.labels = labels
         self.lo = float(lo)
         self.growth = float(growth)
         self._nbins = int(math.ceil(
@@ -218,25 +267,31 @@ class MetricsRegistry:
         self.lock = threading.RLock()
         self._metrics: "Dict[str, object]" = {}
 
-    def _get(self, name: str, cls, help: str, **kw):
+    def _get(self, name: str, cls, help: str, labels=None, **kw):
+        key = labeled_name(name, labels)
         with self.lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = self._metrics[name] = cls(name, help, **kw)
+                m = self._metrics[key] = cls(name, help,
+                                             labels=labels, **kw)
             elif type(m) is not cls:
                 raise TypeError(
-                    f"metric {name!r} already registered as "
+                    f"metric {key!r} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}")
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, Counter, help)
+    def counter(self, name: str, help: str = "", *,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(name, Counter, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, Gauge, help)
+    def gauge(self, name: str, help: str = "", *,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(name, Gauge, help, labels)
 
-    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
-        return self._get(name, Histogram, help, **kw)
+    def histogram(self, name: str, help: str = "", *,
+                  labels: Optional[Dict[str, str]] = None,
+                  **kw) -> Histogram:
+        return self._get(name, Histogram, help, labels, **kw)
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -258,47 +313,133 @@ def default_registry() -> MetricsRegistry:
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or a hostile/odd value (an rid,
+    an error string) corrupts the whole exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_block(labels: Optional[Dict[str, str]],
+                 extra: Optional[Dict[str, str]] = None) -> str:
+    """Render `{k="v",...}` (sorted, values escaped, keys sanitized);
+    `extra` merges in (histogram `le`). Empty dict -> empty string."""
+    merged: Dict[str, str] = dict(labels or {})
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{_NAME_RE.sub("_", k)}="{escape_label_value(v)}"'
+        for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
 def prometheus_text(*registries: MetricsRegistry) -> str:
     """Prometheus text exposition (v0.0.4) over one or more
     registries: counters/gauges as single samples, histograms as
     cumulative `_bucket{le=...}` series + `_sum`/`_count`. Names are
-    sanitized and prefixed `tdtpu_`."""
-    lines: List[str] = []
+    sanitized and prefixed `tdtpu_`; label values are escaped
+    (escape_label_value). The v0.0.4 format requires ALL samples of
+    one metric name in a single group under one `# TYPE` line, so
+    metrics are GROUPED BY BASE NAME first — label variants
+    registered later (configure_slo's per-class series) render
+    contiguously with their unlabeled sibling, not wherever registry
+    insertion order left them."""
+    groups: "Dict[str, List[object]]" = {}
     for reg in registries:
         with reg.lock:
             metrics = list(reg._metrics.values())
         for m in metrics:
             name = "tdtpu_" + _NAME_RE.sub("_", m.name)
+            groups.setdefault(name, []).append(m)
+    lines: List[str] = []
+    for name, members in groups.items():
+        m0 = members[0]
+        kind = ("counter" if isinstance(m0, Counter) else
+                "gauge" if isinstance(m0, Gauge) else "histogram")
+        lines.append(f"# TYPE {name} {kind}")
+        for m in members:
+            lb = _label_block(m.labels)
             if isinstance(m, Counter):
-                lines += [f"# TYPE {name} counter", f"{name} {m.value}"]
+                lines.append(f"{name}{lb} {m.value}")
             elif isinstance(m, Gauge):
-                lines += [f"# TYPE {name} gauge", f"{name} {m.value:g}"]
+                lines.append(f"{name}{lb} {m.value:g}")
             elif isinstance(m, Histogram):
-                lines.append(f"# TYPE {name} histogram")
                 cum = 0
                 for i in range(len(m.counts) - 1):
                     cum += int(m.counts[i])
                     le = m.edges[min(i, len(m.edges) - 1)]
-                    lines.append(f'{name}_bucket{{le="{le:g}"}} {cum}')
+                    blk = _label_block(m.labels, {"le": f"{le:g}"})
+                    lines.append(f"{name}_bucket{blk} {cum}")
                 cum += int(m.counts[-1])
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-                lines.append(f"{name}_sum {m.total:g}")
-                lines.append(f"{name}_count {m.n}")
+                blk = _label_block(m.labels, {"le": "+Inf"})
+                lines.append(f"{name}_bucket{blk} {cum}")
+                lines.append(f"{name}_sum{lb} {m.total:g}")
+                lines.append(f"{name}_count{lb} {m.n}")
     return "\n".join(lines) + "\n"
+
+
+# The default SLO classes (ROADMAP item 4: per-request SLO classes
+# driving admission/preemption — this module is the measurement half).
+# interactive = a human is waiting on the first token and every gap;
+# batch = throughput work that only needs to finish eventually.
+# Schedulers override via configure_slo / ContinuousScheduler(
+# slo_classes=...); targets are milliseconds.
+DEFAULT_SLO_CLASSES = {
+    "interactive": {"ttft_target_ms": 200.0, "itl_target_ms": 100.0},
+    "batch": {"ttft_target_ms": 30000.0, "itl_target_ms": 5000.0},
+}
+
+
+class _SloClass:
+    """One configured SLO class: its targets plus the per-class metric
+    handles (created once at configure time, so the emit/retire hot
+    paths never take the registry lock)."""
+
+    __slots__ = ("name", "ttft_target_ms", "itl_target_ms", "h_ttft",
+                 "h_itl", "c_good", "c_viol")
+
+    def __init__(self, name: str, targets: dict, registry):
+        self.name = name
+        self.ttft_target_ms = float(
+            targets.get("ttft_target_ms", math.inf))
+        self.itl_target_ms = float(
+            targets.get("itl_target_ms", math.inf))
+        lb = {"slo": name}
+        self.h_ttft = registry.histogram(
+            "ttft_ms", "queued -> first token, per request",
+            labels=lb)
+        self.h_itl = registry.histogram(
+            "inter_token_ms", "gap between consecutive deliveries of "
+                              "one stream", labels=lb)
+        self.c_good = registry.counter(
+            "slo_goodput", "requests retired within every class "
+                           "target", labels=lb)
+        self.c_viol = registry.counter(
+            "slo_violations", "requests that missed a class target or "
+                              "never finished cleanly", labels=lb)
 
 
 class _Req:
     """Per-request lifecycle state: the monotonic stamps the derived
-    histograms need (always), plus the event list (tracing only)."""
+    histograms need (always), plus the SLO class (goodput judgement at
+    retire needs the worst inter-token gap, tracked incrementally) and
+    the event list (tracing only)."""
 
-    __slots__ = ("t_q", "t_first", "t_last", "n", "ev")
+    __slots__ = ("t_q", "t_first", "t_last", "n", "ev", "slo",
+                 "itl_max")
 
-    def __init__(self, t: float, traced: bool):
+    def __init__(self, t: float, traced: bool,
+                 slo: "Optional[_SloClass]" = None):
         self.t_q = t
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
         self.n = 0
         self.ev: Optional[list] = [] if traced else None
+        self.slo = slo
+        self.itl_max = 0.0
 
 
 class _NullSpan:
@@ -405,6 +546,20 @@ class Telemetry:
         self._events: deque = deque(maxlen=max_events)
         self._dispatch = None           # pending device-track stamp
         self._poll_seq = 0
+        # the kind of the most recent device-program dispatch — set by
+        # EVERY mark_dispatch call (one attribute write, trace on or
+        # off) so the scheduler's coalesced readback can attribute its
+        # blocking wait per program kind (device_wait_by_kind)
+        self.last_kind = "step"
+        # SLO classes (module docstring): name -> _SloClass. Empty
+        # until configure_slo — requests without a class (or before
+        # configuration) skip the per-class accounting entirely.
+        self.slo_classes: Dict[str, _SloClass] = {}
+        # named timeline tracks beyond host(0)/device(1): the disagg
+        # prefill workers allocate one each (track())
+        self._tracks: Dict[str, int] = {"host phases": 0,
+                                        "device occupancy": 1}
+        self._next_tid = 2
 
     # ------------------------------------------------------------------
     # request lifecycle (histograms always; event ring when tracing)
@@ -413,14 +568,40 @@ class Telemetry:
     def _ms(self, t: float) -> float:
         return round((t - self._t0) * 1e3, 3)
 
-    def queued(self, rid) -> None:
+    def configure_slo(self, classes: Optional[dict] = None) -> None:
+        """Register the SLO classes this bundle judges requests
+        against (None = DEFAULT_SLO_CLASSES). Idempotent — re-running
+        with the same names reuses the registry metrics; each class
+        gets per-class ttft/inter-token histograms plus the
+        slo_goodput / slo_violations counter pair."""
+        for name, targets in (classes or DEFAULT_SLO_CLASSES).items():
+            if name not in self.slo_classes:
+                self.slo_classes[name] = _SloClass(
+                    str(name), dict(targets or {}), self.registry)
+
+    def _slo_of(self, slo) -> "Optional[_SloClass]":
+        """Resolve a submit-time class tag; an UNKNOWN tag registers
+        lazily with no targets (never violates on latency, still
+        partitions goodput/violations) so a stray class string can
+        never crash the driver."""
+        if slo is None:
+            return None
+        cls = self.slo_classes.get(slo)
+        if cls is None:
+            cls = self.slo_classes[slo] = _SloClass(
+                str(slo), {}, self.registry)
+        return cls
+
+    def queued(self, rid, slo=None) -> None:
         t = time.monotonic()
         with self._lock:
             rec = self._live.get(rid)
             if rec is None:
-                rec = self._live[rid] = _Req(t, self.trace)
+                rec = self._live[rid] = _Req(t, self.trace,
+                                             self._slo_of(slo))
         if rec.ev is not None:
-            rec.ev.append([self._ms(t), "queued", None])
+            rec.ev.append([self._ms(t), "queued",
+                           rec.slo.name if rec.slo else None])
 
     def req_event(self, rid, name: str, detail=None) -> None:
         """Trace-only annotation on a live request (admitted, resume,
@@ -435,18 +616,28 @@ class Telemetry:
 
     def emit(self, rid, n: int) -> None:
         """One delivery of n tokens to rid's stream: derives ttft_ms
-        (first delivery) / inter_token_ms (the rest) live."""
+        (first delivery) / inter_token_ms (the rest) live — into the
+        aggregate histograms always, and the request's per-class
+        histograms when it carries an SLO class."""
         t = time.monotonic()
         rec = self._live.get(rid)
         if rec is None:
             return
         if rec.t_first is None:
             rec.t_first = t
-            self.h_ttft.record((t - rec.t_q) * 1e3)
+            ttft = (t - rec.t_q) * 1e3
+            self.h_ttft.record(ttft)
+            if rec.slo is not None:
+                rec.slo.h_ttft.record(ttft)
             if rec.ev is not None:
                 rec.ev.append([self._ms(t), "first_token", int(n)])
         else:
-            self.h_itl.record((t - rec.t_last) * 1e3)
+            gap = (t - rec.t_last) * 1e3
+            self.h_itl.record(gap)
+            if rec.slo is not None:
+                rec.slo.h_itl.record(gap)
+                if gap > rec.itl_max:
+                    rec.itl_max = gap
             if rec.ev is not None:
                 rec.ev.append([self._ms(t), "tokens", int(n)])
         rec.t_last = t
@@ -454,13 +645,26 @@ class Telemetry:
 
     def retire(self, rid, status: str = "retired") -> None:
         """Final transition; repeat retires of the same rid no-op (a
-        rejected rid can reappear in a later done list)."""
+        rejected rid can reappear in a later done list). An SLO-tagged
+        request is judged HERE: goodput iff it retired normally, hit
+        first token within ttft_target_ms and never stalled past
+        itl_target_ms between tokens; every other final state —
+        late, stalled, cancelled, expired, rejected — is a violation.
+        The two counters partition the class's finished requests."""
         t = time.monotonic()
         with self._lock:
             rec = self._live.pop(rid, None)
         if rec is None:
             return
         self.h_e2e.record((t - rec.t_q) * 1e3)
+        cls = rec.slo
+        if cls is not None:
+            good = (status == "retired"
+                    and rec.t_first is not None
+                    and (rec.t_first - rec.t_q) * 1e3
+                    <= cls.ttft_target_ms
+                    and rec.itl_max <= cls.itl_target_ms)
+            (cls.c_good if good else cls.c_viol).inc()
         c = self._c_status.get(status)
         if c is None:
             c = self.registry.counter("requests_" + status)
@@ -501,7 +705,11 @@ class Telemetry:
     def mark_dispatch(self, kind: str = "step") -> None:
         """Stamp a device-program dispatch; the matching
         `device_land()` (DecodeSlots._fetch) closes the device-track
-        occupancy span dispatch -> readback-landing."""
+        occupancy span dispatch -> readback-landing. The kind is
+        ALWAYS remembered (`last_kind`, one attribute write) so the
+        blocking readback can be attributed per program kind even with
+        tracing off."""
+        self.last_kind = kind
         if self.trace:
             self._dispatch = (kind, time.monotonic())
 
@@ -512,12 +720,55 @@ class Telemetry:
         self._dispatch = None
         self._span("device:" + kind, t0, time.monotonic(), tid=1)
 
-    def instant(self, name: str, detail=None) -> None:
-        """Timeline instant (watchdog fire, preemption, drain stall,
-        KV demote/promote)."""
+    def track(self, name: str) -> int:
+        """Get-or-create a named timeline track (e.g. one per disagg
+        prefill worker) and return its tid. Callable from any thread.
+        The thread_name metadata is synthesized at export() time from
+        the persistent track map — NOT stored in the bounded event
+        ring, where a long run's events would evict it and leave the
+        track anonymous in the dump."""
+        with self._lock:
+            tid = self._tracks.get(name)
+            if tid is None:
+                tid = self._tracks[name] = self._next_tid
+                self._next_tid += 1
+            return tid
+
+    def span(self, name: str, t0: float, t1: float, *, tid: int = 0,
+             args: Optional[dict] = None) -> None:
+        """Stamp a complete span on any track from monotonic stamps
+        the caller took (the cross-plane entry point: disagg workers
+        stamp prefill compute / kv_push on their own tids). No-op when
+        tracing is off."""
         if not self.trace:
             return
-        ev = {"name": name, "ph": "i", "s": "p", "pid": 0, "tid": 0,
+        self._span(name, t0, t1, tid=tid, args=args)
+
+    def flow(self, name: str, fid: int, *, phase: str = "s",
+             tid: int = 0, args: Optional[dict] = None) -> None:
+        """One Chrome trace FLOW event: phase "s" starts an arrow
+        chain, "t" continues it, "f" ends it (bp="e" binds the arrow
+        to the enclosing slice). A shared `fid` joins events into one
+        chain ACROSS tracks — the disagg transfer plane uses it to
+        draw route -> prefill compute -> kv_push -> kv_install as one
+        request's journey over both planes."""
+        if not self.trace:
+            return
+        ev = {"name": name, "cat": "flow", "ph": phase, "id": int(fid),
+              "pid": 0, "tid": tid,
+              "ts": round((time.monotonic() - self._t0) * 1e6, 1)}
+        if phase == "f":
+            ev["bp"] = "e"
+        if args is not None:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def instant(self, name: str, detail=None, *, tid: int = 0) -> None:
+        """Timeline instant (watchdog fire, preemption, drain stall,
+        KV demote/promote, transfer-plane kv_push/kv_install)."""
+        if not self.trace:
+            return
+        ev = {"name": name, "ph": "i", "s": "p", "pid": 0, "tid": tid,
               "ts": round((time.monotonic() - self._t0) * 1e6, 1)}
         if detail is not None:
             ev["args"] = {"detail": detail}
@@ -531,13 +782,14 @@ class Telemetry:
         """The dump payload: perfetto loads it via the standard
         `traceEvents` key and ignores the extra `requests`/`metrics`
         sections tools/trace_view.py summarizes."""
-        meta = [
-            {"ph": "M", "pid": 0, "tid": 0, "name": "thread_name",
-             "args": {"name": "host phases"}},
-            {"ph": "M", "pid": 0, "tid": 1, "name": "thread_name",
-             "args": {"name": "device occupancy"}},
-        ]
         with self._lock:
+            # every track's metadata from the persistent map (ring
+            # eviction cannot anonymize a long run's worker tracks)
+            meta = [
+                {"ph": "M", "pid": 0, "tid": tid,
+                 "name": "thread_name", "args": {"name": name}}
+                for name, tid in sorted(self._tracks.items(),
+                                        key=lambda kv: kv[1])]
             events = meta + list(self._events)
             reqs = {}
             for rid, summary in self._retired:
@@ -557,6 +809,25 @@ class Telemetry:
         """Write the export to `path` (the TDTPU_TRACE contract)."""
         with open(path, "w") as f:
             json.dump(self.export(), f)
+
+
+def trace_comm_kernel(kernel: str, nbytes) -> None:
+    """Comm-kernel trace accounting, called from kernels/* each time a
+    comm kernel is BUILT into a program (python call = jit trace
+    time): the process-global `comm_kernel_traces` counter the TP
+    serving proofs assert, plus per-kernel trace and BYTES-MOVED
+    counters (`comm_kernel_builds{kernel=...}` /
+    `comm_kernel_trace_bytes{kernel=...}` — distinct base names, so a
+    PromQL sum() over the labeled series never double-counts the
+    unlabeled aggregate). nbytes is the logical payload the
+    collective moves (shape-derived at trace time), so a trace can
+    put a bandwidth denominator under each kernel's device-occupancy
+    spans."""
+    reg = default_registry()
+    reg.counter("comm_kernel_traces").inc()
+    lb = {"kernel": kernel}
+    reg.counter("comm_kernel_builds", labels=lb).inc()
+    reg.counter("comm_kernel_trace_bytes", labels=lb).inc(int(nbytes))
 
 
 def trace_env_enabled() -> bool:

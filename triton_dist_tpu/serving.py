@@ -160,7 +160,8 @@ class TokenServer:
                  metrics_port: Optional[int] = None,
                  trace: Optional[bool] = None,
                  disagg: bool = False, prefill_workers: int = 1,
-                 disagg_threads: bool = True, transport=None):
+                 disagg_threads: bool = True, transport=None,
+                 slo_classes: Optional[dict] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -235,7 +236,17 @@ class TokenServer:
         stays flat under long-prompt admission load. Always paged;
         mutually exclusive with prefill_budget (chunked prefill is
         the fused alternative disaggregation replaces). Streams are
-        bitwise identical either way (tests/test_disagg.py)."""
+        bitwise identical either way (tests/test_disagg.py).
+
+        slo_classes: the SLO classes clients may tag requests with
+        (the in-protocol `"slo"` field — e.g. "interactive"/"batch";
+        None = runtime/telemetry.DEFAULT_SLO_CLASSES). Tagged
+        requests land their lifecycle latencies in per-class
+        `ttft_ms{slo=...}` / `inter_token_ms{slo=...}` histograms and
+        partition into `slo_goodput`/`slo_violations` counters —
+        visible in stats(), `{"op": "stats"}` and `/metrics`. An
+        unknown class tag on a request is REFUSED (bounded metric
+        cardinality) with the configured names in the error."""
         from triton_dist_tpu.models.disagg import DisaggScheduler
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
@@ -256,7 +267,8 @@ class TokenServer:
                 fault=fault, host_pool_pages=host_pool_pages,
                 overlap=overlap, trace=trace,
                 prefill_workers=prefill_workers,
-                threads=disagg_threads, transport=transport)
+                threads=disagg_threads, transport=transport,
+                slo_classes=slo_classes)
         else:
             self.sched = ContinuousScheduler(
                 engine, batch=batch, chunk=chunk, paged=paged,
@@ -265,7 +277,7 @@ class TokenServer:
                 max_queue=max_queue, watchdog_s=watchdog_s,
                 fault=fault, prefill_budget=prefill_budget,
                 host_pool_pages=host_pool_pages, overlap=overlap,
-                trace=trace)
+                trace=trace, slo_classes=slo_classes)
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -384,6 +396,17 @@ class TokenServer:
                 deadline_ms = req.get("deadline_ms")
                 if deadline_ms is not None:
                     deadline_ms = float(deadline_ms)
+                slo = req.get("slo")
+                if slo is not None:
+                    slo = str(slo)
+                    # bounded metric cardinality: only configured
+                    # classes may be tagged over the wire (scheduler-
+                    # level callers can still register ad hoc)
+                    known = self.sched.tele.slo_classes
+                    if slo not in known:
+                        raise ValueError(
+                            f"unknown slo class {slo!r} (configured: "
+                            f"{sorted(known)})")
             except (ValueError, KeyError, TypeError) as e:
                 self._refuse(conn, f, {
                     "done": True, "n_tokens": 0,
@@ -407,7 +430,7 @@ class TokenServer:
                 accepted = self.sched.submit(Request(
                     rid=rid, ids=np.asarray(ids, np.int32),
                     gen_len=gen_len, seed=seed,
-                    deadline_ms=deadline_ms))
+                    deadline_ms=deadline_ms, slo=slo))
                 if accepted:
                     self._conns[rid] = self._ClientStream(conn, f)
                 else:
@@ -660,6 +683,7 @@ def request_stream(host: str, port: int, prompt: str, *,
                    gen_len: int = 16, seed: int = 0,
                    timeout: float = 300.0,
                    deadline_ms: Optional[float] = None,
+                   slo: Optional[str] = None,
                    connect_retries: int = 8,
                    connect_backoff_s: float = 0.05,
                    busy_retries: int = 4) -> Iterator[dict]:
@@ -678,6 +702,8 @@ def request_stream(host: str, port: int, prompt: str, *,
     payload = {"prompt": prompt, "gen_len": gen_len, "seed": seed}
     if deadline_ms is not None:
         payload["deadline_ms"] = deadline_ms
+    if slo is not None:
+        payload["slo"] = slo
     connects = 0
     busy_left = busy_retries
     while True:
