@@ -260,6 +260,33 @@ def test_bench_compare_flags_and_gating(tmp_path):
     assert bc.main([str(fa), str(fb2), "--strict"]) == 0
 
 
+def test_bench_compare_seconds_unit_is_latency_direction():
+    """ISSUE 12 satellite bugfix: plain-seconds rows — the new
+    `aot_warm_start_s` — are latency-direction (s UP = regressed),
+    both through the unit token ("s", annotated spellings) and the
+    metric-name `_s` suffix convention; throughput rows whose names
+    merely contain "_s_" (tok_per_s_aggregate) keep their
+    higher-is-better direction."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "aot_warm_start_s", "value": 2.0, "unit": "s",
+          "backend": "tpu"},
+         {"metric": "aot_warm_start_s2", "value": 2.0,
+          "unit": "s (restart)", "backend": "tpu"},
+         {"metric": "serving_tok_per_s_aggregate", "value": 100.0,
+          "unit": "tok/s", "backend": "tpu"}]
+    b = [{"metric": "aot_warm_start_s", "value": 6.0, "unit": "s",
+          "backend": "tpu"},              # 3x slower restart
+         {"metric": "aot_warm_start_s2", "value": 6.0,
+          "unit": "s (restart)", "backend": "tpu"},
+         {"metric": "serving_tok_per_s_aggregate", "value": 200.0,
+          "unit": "tok/s", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["aot_warm_start_s"]["flag"] == "regressed"
+    assert res["aot_warm_start_s"]["direction"] == "lower-is-better"
+    assert res["aot_warm_start_s2"]["flag"] == "regressed"
+    assert res["serving_tok_per_s_aggregate"]["flag"] == "improved"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

@@ -311,8 +311,12 @@ def test_spec_rejects_mega_backend():
                      dtype="bfloat16", max_position_embeddings=256)
     model = AutoLLM.from_config(cfg, mesh1)
     eng = Engine(model, max_seq=64, backend="mega")
-    with pytest.raises(ValueError, match="verify"):
+    # contiguous slots: refused for the paged-only fused tick
+    with pytest.raises(ValueError, match="paged=True"):
         ContinuousScheduler(eng, batch=2, spec=2)
+    # paged but spec=K: the verify window is the named missing piece
+    with pytest.raises(ValueError, match="verify"):
+        ContinuousScheduler(eng, batch=2, paged=True, page=8, spec=2)
 
 
 def test_sampled_spec_stream_smoke():

@@ -67,13 +67,26 @@ def _by_metric(rows):
 
 
 def _lower_is_better(row) -> bool:
-    """Regression direction from the unit: latencies ("ms"/"s"/"us"),
-    overhead percentages ("%") and slowdown factors ("x slowdown")
-    regress UP; throughputs (tok/s), fractions and capacity
-    multipliers regress DOWN."""
+    """Regression direction from the unit: latencies ("ms"/"s"/"us",
+    including annotated spellings like "s (restart)"), overhead
+    percentages ("%") and slowdown factors ("x slowdown") regress UP;
+    throughputs (tok/s), fractions and capacity multipliers regress
+    DOWN. Plain-seconds rows whose unit string is exotic still
+    resolve through the metric-NAME suffix convention every bench row
+    follows (`*_ms` / `*_us` / `*_s`, e.g. `aot_warm_start_s`) —
+    previously such rows fell through to higher-is-better and a
+    warm-start REGRESSION rendered as an improvement."""
     unit = str(row.get("unit", ""))
-    return ("ms" in unit or unit in ("s", "us", "%")
-            or "slowdown" in unit)
+    head = unit.split()[0] if unit.split() else ""
+    if ("ms" in unit and "tok" not in unit) \
+            or head in ("s", "us", "ms") or unit == "%" \
+            or "slowdown" in unit:
+        return True
+    if "/" in unit:
+        # a rate unit (tok/s, x pages/s, ...) is never a latency,
+        # whatever the metric name's suffix says
+        return False
+    return str(row.get("metric", "")).endswith(("_ms", "_us", "_s"))
 
 
 def compare(rows_a, rows_b, threshold: float = DEFAULT_THRESHOLD):

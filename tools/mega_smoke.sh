@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Megakernel paged-serving smoke — the FULL mega-vs-per-op
+# differential matrix (tests/test_mega_paged.py: kernel oracles,
+# dispatch-count guard, greedy/int8/overlap/chunked/preemption serving
+# arms) plus the contiguous megakernel suite (tests/test_mega.py) and
+# the AOT warm-start tests (tests/test_aot_serving.py), on the same
+# CPU substrate tier-1 uses. No `-m 'not slow'`: this loop exists to
+# run the arms tier-1's 870 s budget pushes behind the slow mark.
+# Archives the pass count and reports the delta vs the previous run,
+# tier1.sh-style. Run from the repo root: bash tools/mega_smoke.sh
+set -o pipefail
+rm -f /tmp/_mega_smoke.log
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_mega_paged.py tests/test_mega.py \
+    tests/test_aot_serving.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_mega_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_mega_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_mega_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "MEGA_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "MEGA_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

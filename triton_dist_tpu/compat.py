@@ -3,9 +3,13 @@ toolchain may lag the APIs this repo targets).
 
 The codebase is written against the modern surface — `jax.shard_map`,
 `jax.sharding.AxisType`, `Mesh.axis_types`, `pltpu.CompilerParams`,
-`pltpu.InterpretParams` — and this module maps each one back onto its
-older spelling when the installed jax predates the rename, so the
-oracle ("xla") and basic Pallas paths run on a jax-0.4.x stack too.
+`pltpu.MemorySpace`, `pltpu.InterpretParams` — and this module maps
+each one back onto its older spelling when the installed jax predates
+the rename, so the oracle ("xla") and basic Pallas paths — including
+the megakernels, which run under the generic interpreter — work on a
+jax-0.4.x stack too. (0.4.x `Mesh.axis_types` is None rather than a
+tuple; the call sites guard with `or ()` instead of a shim, since the
+attribute is per-instance.)
 Installed once from the package __init__; every shim is a no-op on a
 modern jax. The TPU-interpreter-specific features (remote DMA,
 semaphores, race detection) have NO pre-0.5 equivalent — kernels that
@@ -58,6 +62,12 @@ def install() -> None:
     # flag only guards DCE of pure-side-effect comm kernels, which need
     # the modern interpreter anyway) ------------------------------------
     from jax.experimental.pallas import tpu as pltpu
+
+    # --- pltpu.MemorySpace (renamed from TPUMemorySpace ~0.5; the
+    # megakernels pin their operand BlockSpecs to VMEM through it) ------
+    if not hasattr(pltpu, "MemorySpace") and hasattr(pltpu,
+                                                     "TPUMemorySpace"):
+        pltpu.MemorySpace = pltpu.TPUMemorySpace
     if not hasattr(pltpu, "CompilerParams") and hasattr(
             pltpu, "TPUCompilerParams"):
         import dataclasses

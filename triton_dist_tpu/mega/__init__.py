@@ -16,5 +16,7 @@ or pipeline-prologue cost.
 from triton_dist_tpu.mega.builder import MegaKernelBuilder  # noqa: F401
 from triton_dist_tpu.mega.decode_layer import (  # noqa: F401
     MegaDecodeLayer,
+    MegaPagedDecodeLayer,
     mega_decode_layer_ref,
+    mega_paged_decode_layer_ref,
 )

@@ -490,6 +490,536 @@ class MegaDecodeLayer:
         return res[0], res[1], res[2]
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MegaPagedDecodeLayer:
+    """One transformer decode layer as ONE Pallas kernel over the PAGED
+    serving pool (the paged serving contract of
+    kv_cache.PagedSlotCache — ISSUE 12 / ROADMAP item 5): the fused
+    layer learns exactly what `flash_decode_paged` + the per-op slot
+    ops already know, but inside one kernel:
+
+      - per-slot positions: `pos` [B] int32 — slot b's new token sits
+        at ITS position (kv_lens = pos + 1), not a shared offset; the
+        flash walk masks each stream to its own length;
+      - the page-table walk: every KV tile resolves through the
+        slot's table row (rows ride the scalar-prefetch operand, so
+        the per-tile page ids are static-index scalar reads — the
+        same machinery the BlockSpec index maps of flash_decode_paged
+        use, minus the grid);
+      - the trash-page write sink: a retired/padded slot's table rows
+        all point at the reserved trash page, so its masked-out
+        read-modify-write lands where no live slot ever maps;
+      - in-kernel int8 dequant (quant=int8 pool): the per-position
+        scale planes (PR-7, KIVI 2402.02750) ride the SAME page id as
+        the payload; K's scale multiplies the logits column-wise, V's
+        folds into P — the exact dequant of the per-op kernel — and
+        the new row quantizes with the shared quantizer's math
+        (quantize_kv_int8) before its write-back.
+
+    Decode-only (S == 1 per slot, the greedy tick); the spec-verify
+    window (q_lens > 1) and mixed prefill rows stay on the per-op
+    programs (engine._jit_programs falls back per poll). Single chip:
+    the TP=N paged pool keeps the per-op `shard_map` path (the
+    head-group plane split lives outside the kernel).
+
+    Perf stance (mega/CEILING.md): the walk is per-(head, slot) —
+    the same bx=1 stream economics the paged per-op kernel pays —
+    with page-granular DMAs under the online-softmax update. What the
+    fusion buys is the LAYER: one kernel launch where the per-op tick
+    pays ~7 op dispatches (norms, projections, rope/scatter, flash,
+    swiglu), with activations VMEM-resident across all of them."""
+
+    d_model: int = dataclasses.field(metadata=dict(static=True))
+    n_heads: int = dataclasses.field(metadata=dict(static=True))
+    n_kv_heads: int = dataclasses.field(metadata=dict(static=True))
+    head_dim: int = dataclasses.field(metadata=dict(static=True))
+    ffn: int = dataclasses.field(metadata=dict(static=True))
+    page: int = dataclasses.field(metadata=dict(static=True))
+    maxp: int = dataclasses.field(metadata=dict(static=True))
+    eps: float = dataclasses.field(default=1e-6,
+                                   metadata=dict(static=True))
+    block_n: int = dataclasses.field(default=256,
+                                     metadata=dict(static=True))
+    qk_norm: bool = dataclasses.field(default=True,
+                                      metadata=dict(static=True))
+
+    def __call__(self, x, pos, weights: Dict[str, jax.Array], pages_k,
+                 pages_v, table, scales_k=None, scales_v=None):
+        """x: [B, D] f32; pos: [B] int32 (tokens already cached per
+        slot — the new token lands at pos[b]); weights: the contiguous
+        layer's dict with PER-SLOT rope rows cos_row/sin_row [B, hd//2]
+        (gathered at each slot's own position); pages_k/v:
+        [NP, 1, page, d] (one layer's pool, single head-group plane);
+        table: [B*Hkv, maxp] int32 (trash-padded rows — every entry is
+        a valid physical page); scales_k/v: [NP, 1, page] f32 for the
+        int8 pool. Returns (y [B, D], pages_k, pages_v[, scales_k,
+        scales_v]) with the pools updated in place (aliased)."""
+        B, D = x.shape
+        Hq, Hkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        rep = Hq // Hkv
+        F = self.ffn
+        page, maxp = self.page, self.maxp
+        bn = self.block_n
+        eps = self.eps
+        Nqkv = (Hq + 2 * Hkv) * hd
+        scale = hd ** -0.5
+        X = B * Hkv
+        quant = scales_k is not None
+        assert (scales_k is None) == (scales_v is None)
+        assert D % bn == 0 and F % bn == 0 and (Hq * hd) % bn == 0, \
+            (D, F, Hq * hd, bn)
+        assert Hq % Hkv == 0, (Hq, Hkv)
+        assert pages_k.shape[1] == 1, (
+            "MegaPagedDecodeLayer is the single-chip tick: the TP pool "
+            f"has {pages_k.shape[1]} head-group planes; serve TP "
+            "meshes on the per-op backends")
+        assert pages_k.shape[2:] == (page, hd), (pages_k.shape,
+                                                 (page, hd))
+        assert table.shape == (X, maxp), (table.shape, (X, maxp))
+        pool_dt = pages_k.dtype
+        qdt = jnp.bfloat16 if quant else pool_dt
+
+        b = MegaKernelBuilder()
+        b.inputs("xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
+                 "w_ln2", "w_gu", "w_d", "cos", "sin", "pk", "pv",
+                 "ks", "vs", "scal", "copy_sem", "copy_sems", "y")
+        b.buffer("xn", (B, D), jnp.float32)
+        b.buffer("qkv", (B, Nqkv), jnp.float32)
+        b.buffer("attn", (B, Hq * hd), jnp.float32)
+        b.buffer("ores", (B, D), jnp.float32)
+        b.buffer("on", (B, D), jnp.float32)
+        b.buffer("h", (B, F), jnp.float32)
+        b.buffer("wt", (2, max(D, F, Hq * hd), bn), jnp.bfloat16)
+        # page-granular staging: the append is a read-modify-write of
+        # the slot's whole current page (pages of different slots are
+        # not adjacent, so single-row DMA cannot batch across slots)
+        b.buffer("pgst", (page, hd), pool_dt)
+        # flash tiles + per-(head, slot) online-softmax state
+        b.buffer("kt", (page, hd), pool_dt)
+        b.buffer("vt", (page, hd), pool_dt)
+        b.buffer("fm", (rep, 1), jnp.float32)
+        b.buffer("fl", (rep, 1), jnp.float32)
+        b.buffer("facc", (rep, hd), jnp.float32)
+        if quant:
+            b.buffer("sgst", (1, page), jnp.float32)
+            b.buffer("kst", (1, page), jnp.float32)
+            b.buffer("vst", (1, page), jnp.float32)
+
+        # scalar-prefetch layout: [pos (B) | in-page row (B) | write
+        # page id (X) | table (X * maxp)]. The write page id and row
+        # are precomputed OUTSIDE the kernel (pos // page indexing of
+        # the table is a dynamic scalar lookup the kernel body
+        # avoids — the same older-interpreter constraint
+        # flash_decode_paged's index maps note), so every in-kernel
+        # scalar read is at a STATIC offset.
+        def s_pos(env, bi):
+            return env["scal"][bi]
+
+        def s_row(env, bi):
+            return env["scal"][B + bi]
+
+        def s_wpid(env, bi, g):
+            return env["scal"][2 * B + bi * Hkv + g]
+
+        def s_table(env, bi, g, t):
+            return env["scal"][2 * B + X + (bi * Hkv + g) * maxp + t]
+
+        b.add_task("ln1", functools.partial(_rmsnorm, dst="xn", src="xv",
+                                            w_name="w_ln1", eps=eps),
+                   reads=("xv", "w_ln1"), writes=("xn",))
+        b.add_task("qkv_mm",
+                   functools.partial(_mm_tiles, dst="qkv", src="xn",
+                                     w="w_qkv", rows=D, cols=Nqkv,
+                                     bn=_pick_bn(Nqkv, bn),
+                                     wt_name="wt"),
+                   reads=("xn", "w_qkv"), writes=("qkv", "wt"))
+
+        def rope_norm(env):
+            # identical to the contiguous task, with PER-SLOT rope rows
+            # ([B, hd//2] — each slot rotates at its own position)
+            qkv = env["qkv"]
+            c = env["cos"][...]
+            s = env["sin"][...]
+            half = hd // 2
+            for hidx in range(Hq + Hkv):
+                off = hidx * hd
+                v = qkv[:, off:off + hd]
+                if self.qk_norm:
+                    gw = (env["q_norm"][...] if hidx < Hq
+                          else env["k_norm"][...])
+                    ms = jnp.mean(v * v, axis=-1, keepdims=True)
+                    v = v * jax.lax.rsqrt(ms + eps) * gw
+                x1 = v[:, :half]
+                x2 = v[:, half:]
+                qkv[:, off:off + half] = x1 * c - x2 * s
+                qkv[:, off + half:off + hd] = x2 * c + x1 * s
+
+        b.add_task("rope_norm", rope_norm,
+                   reads=("qkv", "cos", "sin", "q_norm", "k_norm"),
+                   writes=("qkv",))
+
+        def cache_write(env):
+            # per-slot paged append: slot b's new K/V row lands in the
+            # physical page its table row maps for pos[b] (a retired
+            # slot's rows map the trash page — the sanctioned sink).
+            # RMW of the whole page per (slot, head): read, mask-in row
+            # pos[b] % page, write back. int8 pools quantize the row
+            # through the SHARED quantizer (pure jnp, so it runs
+            # inside the kernel body) and RMW the scale row of the
+            # SAME page alongside — the repo-wide bitwise-identity
+            # contract of kernels/quant.quantize_kv_int8 rides on
+            # every int8 store calling the one helper.
+            from triton_dist_tpu.kernels.quant import quantize_kv_int8
+            qkv = env["qkv"]
+            sem = env["copy_sem"]
+            rowi = jax.lax.broadcasted_iota(jnp.int32, (page, hd), 0)
+            if quant:
+                srow = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+            for bi in range(B):
+                r = s_row(env, bi)
+                for g in range(Hkv):
+                    pid = s_wpid(env, bi, g)
+                    for which in ("k", "v"):
+                        base = ((Hq + g) * hd if which == "k"
+                                else (Hq + Hkv + g) * hd)
+                        buf = env["pk" if which == "k" else "pv"]
+                        dst = buf.at[pid, 0]
+                        cp = pltpu.make_async_copy(dst, env["pgst"], sem)
+                        cp.start()
+                        cp.wait()
+                        new = qkv[bi:bi + 1, base:base + hd]  # [1, hd]
+                        if quant:
+                            q8, sc = quantize_kv_int8(new)
+                            env["pgst"][...] = jnp.where(
+                                rowi == r,
+                                jnp.broadcast_to(q8, (page, hd)
+                                                 ).astype(pool_dt),
+                                env["pgst"][...])
+                        else:
+                            env["pgst"][...] = jnp.where(
+                                rowi == r,
+                                jnp.broadcast_to(new, (page, hd)
+                                                 ).astype(pool_dt),
+                                env["pgst"][...])
+                        cp = pltpu.make_async_copy(env["pgst"], dst, sem)
+                        cp.start()
+                        cp.wait()
+                        if quant:
+                            sbuf = env["ks" if which == "k" else "vs"]
+                            sdst = sbuf.at[pid]
+                            cp = pltpu.make_async_copy(sdst, env["sgst"],
+                                                       sem)
+                            cp.start()
+                            cp.wait()
+                            env["sgst"][...] = jnp.where(
+                                srow == r, sc[0], env["sgst"][...])
+                            cp = pltpu.make_async_copy(env["sgst"], sdst,
+                                                       sem)
+                            cp.start()
+                            cp.wait()
+
+        cw_reads = ("qkv", "scal", "pk", "pv") + (("ks", "vs") if quant
+                                                  else ())
+        cw_writes = ("pk", "pv", "pgst") + (("ks", "vs", "sgst")
+                                            if quant else ())
+        b.add_task("cache_write_paged", cache_write,
+                   reads=cw_reads, writes=cw_writes)
+
+        def flash(env):
+            # the paged flash walk, per (kv head, slot) stream: every
+            # logical tile resolves through the slot's table row (all
+            # entries valid — trash-padded), tiles past the slot's own
+            # kv_len are skipped (pl.when), and the in-tile column
+            # mask col <= pos[b] drops the tail of the last page.
+            qkv = env["qkv"]
+            sem = env["copy_sem"]
+            for g in range(Hkv):
+                for bi in range(B):
+                    p = s_pos(env, bi)
+                    kvl = p + 1
+                    q3 = (qkv[bi:bi + 1,
+                              g * rep * hd:(g + 1) * rep * hd]
+                          .reshape(rep, hd).astype(qdt))
+                    env["fm"][...] = jnp.full((rep, 1), -1e30,
+                                              jnp.float32)
+                    env["fl"][...] = jnp.zeros((rep, 1), jnp.float32)
+                    env["facc"][...] = jnp.zeros((rep, hd), jnp.float32)
+                    for t in range(maxp):
+                        pid = s_table(env, bi, g, t)
+
+                        @pl.when(t * page < kvl)
+                        def _tile(t=t, pid=pid, p=p, q3=q3):
+                            cp = pltpu.make_async_copy(
+                                env["pk"].at[pid, 0], env["kt"], sem)
+                            cp.start()
+                            cp.wait()
+                            kj = env["kt"][...]
+                            if quant:
+                                cp = pltpu.make_async_copy(
+                                    env["ks"].at[pid], env["kst"], sem)
+                                cp.start()
+                                cp.wait()
+                                kj = kj.astype(qdt)
+                            s = jax.lax.dot_general(
+                                q3, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * scale                  # [rep, page]
+                            if quant:
+                                # K's per-position scale multiplies the
+                                # logits column-wise (exact dequant)
+                                s = s * env["kst"][...]
+                            col = (t * page
+                                   + jax.lax.broadcasted_iota(
+                                       jnp.int32, (rep, page), 1))
+                            sm = jnp.where(col <= p, s, -1e30)
+                            m_prev = env["fm"][...]        # [rep, 1]
+                            m_new = jnp.maximum(
+                                m_prev, jnp.max(sm, -1, keepdims=True))
+                            alpha = jnp.exp(m_prev - m_new)
+                            pr = jnp.where(col <= p,
+                                           jnp.exp(sm - m_new), 0.0)
+                            env["fl"][...] = (env["fl"][...] * alpha
+                                              + jnp.sum(pr, -1,
+                                                        keepdims=True))
+                            cp = pltpu.make_async_copy(
+                                env["pv"].at[pid, 0], env["vt"], sem)
+                            cp.start()
+                            cp.wait()
+                            vj = env["vt"][...]
+                            if quant:
+                                cp = pltpu.make_async_copy(
+                                    env["vs"].at[pid], env["vst"], sem)
+                                cp.start()
+                                cp.wait()
+                                vj = vj.astype(qdt)
+                                # V's scale folds into P (diag(sv) V)
+                                pr = pr * env["vst"][...]
+                            env["facc"][...] = (
+                                env["facc"][...] * alpha
+                                + jax.lax.dot_general(
+                                    pr.astype(vj.dtype), vj,
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32))
+                            env["fm"][...] = m_new
+
+                    out = (env["facc"][...]
+                           / jnp.maximum(env["fl"][...], 1e-30))
+                    env["attn"][bi:bi + 1,
+                                g * rep * hd:(g + 1) * rep * hd] = \
+                        out.reshape(1, rep * hd)
+
+        fl_reads = ("qkv", "scal", "pk", "pv") + (("ks", "vs") if quant
+                                                  else ())
+        fl_writes = ("attn", "kt", "vt", "fm", "fl", "facc") + (
+            ("kst", "vst") if quant else ())
+        b.add_task("flash_paged", flash, reads=fl_reads,
+                   writes=fl_writes)
+        b.add_task("o_proj",
+                   functools.partial(_mm_tiles, dst="ores", src="attn",
+                                     w="w_o", rows=Hq * hd, cols=D,
+                                     bn=bn, wt_name="wt", add="xv"),
+                   reads=("attn", "w_o", "xv"), writes=("ores", "wt"))
+        b.add_task("ln2", functools.partial(_rmsnorm, dst="on",
+                                            src="ores", w_name="w_ln2",
+                                            eps=eps),
+                   reads=("ores", "w_ln2"), writes=("on",))
+
+        def gate_up(env):
+            wref = env["w_gu"]
+            wt = env["wt"]
+            sems = env["copy_sems"]
+            on_bf = None
+            for j in range(F // bn):
+                sl = slice(j * bn, (j + 1) * bn)
+                sl2 = slice(F + j * bn, F + (j + 1) * bn)
+                cpg = pltpu.make_async_copy(wref.at[:, sl],
+                                            wt.at[0, :D, :bn], sems.at[0])
+                cpu = pltpu.make_async_copy(wref.at[:, sl2],
+                                            wt.at[1, :D, :bn], sems.at[1])
+                cpg.start()
+                cpu.start()
+                if on_bf is None:
+                    on_bf = env["on"][...].astype(jnp.bfloat16)
+                cpg.wait()
+                g = jax.lax.dot(on_bf, wt[0, :D, :bn],
+                                preferred_element_type=jnp.float32)
+                cpu.wait()
+                u = jax.lax.dot(on_bf, wt[1, :D, :bn],
+                                preferred_element_type=jnp.float32)
+                env["h"][:, sl] = g * jax.lax.logistic(g) * u
+
+        b.add_task("gate_up_swiglu", gate_up, reads=("on", "w_gu"),
+                   writes=("h", "wt"))
+        b.add_task("down_proj",
+                   functools.partial(_mm_tiles, dst="y", src="h",
+                                     w="w_d", rows=F, cols=D, bn=bn,
+                                     wt_name="wt", add="ores"),
+                   reads=("h", "w_d", "ores"), writes=("y", "wt"))
+
+        in_names = ["xv", "w_ln1", "w_qkv", "q_norm", "k_norm", "w_o",
+                    "w_ln2", "w_gu", "w_d", "cos", "sin",
+                    "pk_in", "pv_in"] + (["ks_in", "vs_in"] if quant
+                                         else [])
+        out_names = ["y", "pk", "pv"] + (["ks", "vs"] if quant else [])
+        buf_names = list(b.buffers)
+        sem_names = ["copy_sem", "copy_sems"]
+
+        def kernel(scal_ref, *refs):
+            env = {"scal": scal_ref}
+            for i, nm in enumerate(in_names + out_names + buf_names
+                                   + sem_names):
+                env[nm] = refs[i]
+            if not quant:
+                env["ks"] = env["vs"] = None
+            b.emit_all(env)   # pk/pv (+ks/vs) resolve to the ALIASED
+            # outputs
+
+        vm = pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM)
+        anym = pl.BlockSpec(memory_space=pl.ANY)
+        scratch = [pltpu.VMEM(shape, dt)
+                   for (shape, dt) in b.buffers.values()]
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
+        out_shape = [jax.ShapeDtypeStruct((B, D), jnp.float32),
+                     jax.ShapeDtypeStruct(pages_k.shape, pages_k.dtype),
+                     jax.ShapeDtypeStruct(pages_v.shape, pages_v.dtype)]
+        out_specs = [vm, anym, anym]
+        in_specs = [vm, vm, anym, vm, vm, anym, vm, anym, anym,
+                    vm, vm, anym, anym]
+        aliases = {12: 1, 13: 2}
+        if quant:
+            out_shape += [
+                jax.ShapeDtypeStruct(scales_k.shape, scales_k.dtype),
+                jax.ShapeDtypeStruct(scales_v.shape, scales_v.dtype)]
+            out_specs += [anym, anym]
+            in_specs += [anym, anym]
+            aliases.update({14: 3, 15: 4})
+
+        pos = jnp.asarray(pos, jnp.int32)
+        # write page id per (slot, head) stream + the in-page row,
+        # resolved host/XLA-side so every in-kernel scalar read is at a
+        # static offset (see the scalar-layout comment above)
+        pos_x = jnp.repeat(pos, Hkv)                          # [X]
+        wpid = table[jnp.arange(X),
+                     jnp.minimum(pos_x // page, maxp - 1)]
+        scalars = jnp.concatenate([
+            pos, pos % page, wpid,
+            table.reshape(-1).astype(jnp.int32)])
+        args = [x.astype(jnp.float32),
+                weights["w_ln1"], weights["w_qkv"].astype(jnp.bfloat16),
+                weights["q_norm"], weights["k_norm"],
+                weights["w_o"].astype(jnp.bfloat16), weights["w_ln2"],
+                weights["w_gu"].astype(jnp.bfloat16),
+                weights["w_d"].astype(jnp.bfloat16),
+                weights["cos_row"], weights["sin_row"],
+                pages_k, pages_v]
+        if quant:
+            args += [scales_k, scales_v]
+        res = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=in_specs,
+                out_specs=tuple(out_specs),
+                scratch_shapes=scratch,
+            ),
+            out_shape=tuple(out_shape),
+            input_output_aliases=aliases,
+            compiler_params=shmem_compiler_params(
+                None, n=1, vmem_limit_bytes=100 << 20),
+            interpret=interpret_mode(),
+        )(scalars, *args)
+        return res
+
+
+def mega_paged_decode_layer_ref(x, pos, weights, pages_k, pages_v,
+                                table, scales_k=None, scales_v=None, *,
+                                n_heads, n_kv_heads, head_dim,
+                                eps=1e-6):
+    """jnp oracle of MegaPagedDecodeLayer: the same paged layer step
+    out of ordinary ops — per-slot qk-norm + rope, the (quantized)
+    row scatter through the table, per-slot-length attention over the
+    gathered pool, then the MLP half. Mirrors the per-op serving
+    semantics (`layers/tp_attn.py _attend_paged_slots`)."""
+    from triton_dist_tpu.kernels.quant import (dequantize_kv_int8,
+                                               quantize_kv_int8)
+    B, D = x.shape
+    Hq, Hkv, hd = n_heads, n_kv_heads, head_dim
+    rep = Hq // Hkv
+    quant = scales_k is not None
+    X, maxp = table.shape
+    page = pages_k.shape[2]
+    x = x.astype(jnp.float32)
+
+    def rms(v, g):
+        return v * jax.lax.rsqrt(
+            jnp.mean(v * v, -1, keepdims=True) + eps) * g
+
+    xn = rms(x, weights["w_ln1"][0])
+    qkv = xn @ weights["w_qkv"].astype(jnp.float32)
+    c = weights["cos_row"]            # [B, hd//2] — per-slot rows
+    s = weights["sin_row"]
+    half = hd // 2
+
+    def rope_head(v, g):
+        v = rms(v, g)
+        x1, x2 = v[:, :half], v[:, half:]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+
+    heads = []
+    for hi in range(Hq + Hkv):
+        off = hi * hd
+        g = (weights["q_norm"][0] if hi < Hq else weights["k_norm"][0])
+        heads.append(rope_head(qkv[:, off:off + hd], g))
+    q = jnp.stack(heads[:Hq], 1)                       # [B, Hq, hd]
+    k_new = jnp.stack(heads[Hq:], 1).reshape(X, hd)    # [X, hd]
+    v_new = qkv[:, (Hq + Hkv) * hd:].reshape(X, hd)
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_x = jnp.repeat(pos, Hkv)
+    pidx = table[jnp.arange(X), jnp.minimum(pos_x // page, maxp - 1)]
+    r = pos_x % page
+    pk, pv = pages_k[:, 0], pages_v[:, 0]
+    if quant:
+        sk, sv = scales_k[:, 0], scales_v[:, 0]
+        k8, k_s = quantize_kv_int8(k_new)
+        v8, v_s = quantize_kv_int8(v_new)
+        pk = pk.at[pidx, r].set(k8)
+        pv = pv.at[pidx, r].set(v8)
+        sk = sk.at[pidx, r].set(k_s)
+        sv = sv.at[pidx, r].set(v_s)
+        kd = dequantize_kv_int8(pk, sk)
+        vd = dequantize_kv_int8(pv, sv)
+    else:
+        pk = pk.at[pidx, r].set(k_new.astype(pk.dtype))
+        pv = pv.at[pidx, r].set(v_new.astype(pv.dtype))
+        kd, vd = pk, pv
+    T = maxp * page
+    kfull = kd[table].reshape(B, Hkv, T, hd).astype(jnp.float32)
+    vfull = vd[table].reshape(B, Hkv, T, hd).astype(jnp.float32)
+    col = jnp.arange(T)
+    attn = []
+    for g in range(Hkv):
+        qg = q[:, g * rep:(g + 1) * rep].astype(jnp.float32)
+        sc = jnp.einsum("brd,btd->brt", qg, kfull[:, g]) * hd ** -0.5
+        sc = jnp.where(col[None, None] <= pos[:, None, None], sc,
+                       -jnp.inf)
+        pr = jax.nn.softmax(sc, -1)
+        attn.append(jnp.einsum("brt,btd->brd", pr, vfull[:, g]))
+    a = jnp.concatenate(attn, 1).reshape(B, Hq * hd)
+    ores = a @ weights["w_o"].astype(jnp.float32) + x
+    on = rms(ores, weights["w_ln2"][0])
+    gu = on @ weights["w_gu"].astype(jnp.float32)
+    F = gu.shape[1] // 2
+    h = jax.nn.silu(gu[:, :F]) * gu[:, F:]
+    y = h @ weights["w_d"].astype(jnp.float32) + ores
+    out = (y, pk[:, None], pv[:, None])
+    if quant:
+        out += (sk[:, None], sv[:, None])
+    return out
+
+
 def mega_decode_layer_ref(x, pos, weights, cache_k, cache_v, *,
                           n_heads, n_kv_heads, head_dim, eps=1e-6):
     """jnp oracle: the same layer step out of ordinary ops."""

@@ -343,7 +343,8 @@ class DenseLLM:
         mlp_impl = "dist" if mode == "train" else "xla"
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         from jax.sharding import AxisType
-        if any(t == AxisType.Explicit for t in self.mesh.axis_types):
+        if any(t == AxisType.Explicit
+               for t in (self.mesh.axis_types or ())):
             # pin the embed-gather cotangent to replicated: its transpose
             # is a scatter-add into the (replicated) table, which
             # explicit-sharding mode rejects for a tp-sharded cotangent

@@ -65,9 +65,10 @@ class Engine:
             raise ValueError(f"unknown sampling mode {sampling!r}")
         if sampling != "greedy" and backend == "mega":
             raise ValueError(
-                "backend='mega' decodes greedily (the scan carries the "
-                "argmax token only); use the per-op backends for "
-                "sampled generation")
+                "backend='mega' serves GREEDY streams only (the fused "
+                "tick and the decode scan both carry the argmax token); "
+                "sampled decode is still unsupported — use the per-op "
+                "backends for sampled generation")
         self.sampling = sampling
         self._sample_params = dict(temperature=temperature, k=top_k,
                                    p=top_p)
@@ -109,12 +110,14 @@ class Engine:
                                            QuantW):
                 raise ValueError(
                     "backend='mega' repacks raw bf16 weight panels and "
-                    "has no dequant path; int8 models run on the other "
-                    "backends")
-            if kv_dtype is not None:
+                    "has no WEIGHT dequant path; int8-weight models run "
+                    "on the other backends (int8 paged KV is fine — "
+                    "the fused tick dequants the pool in-kernel)")
+            if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
                 raise ValueError(
-                    "backend='mega' reads the KV cache directly and has "
-                    "no dequant path; use the default bf16 cache")
+                    f"backend='mega' supports kv_dtype=None (pool "
+                    f"dtype) or jnp.int8 (in-kernel scale-plane "
+                    f"dequant), not {jnp.dtype(kv_dtype)}")
             n_mega = model.mesh.shape[model.mesh.axis_names[0]]
             if n_mega > 1 and (
                     model.config.num_heads % n_mega
@@ -150,44 +153,61 @@ class Engine:
         # time. Sharing is safe because every per-engine mutable piece
         # (scratch caches, dispatch counters) stays on the instance and
         # the model rides in as a traced argument.
+        progs = _jit_programs(backend, sampling,
+                              _params_key(self._sample_params),
+                              self.prefill_backend)
+        # AOT WARM START (ISSUE 12 / ROADMAP item 5): with
+        # TDTPU_AOT_CACHE=dir set, every serving program below is
+        # wrapped by a disk cache of jax.export blobs keyed on
+        # (backend, sampling, params, prefill mode, jax version, arg
+        # shapes) — a restarted server (or an elastically added
+        # worker) deserializes the lowered program instead of
+        # retracing it, and the XLA executable comes out of the
+        # persistent compilation cache pointed at the same directory
+        # (tools/aot.py AOTProgramCache). Programs the host cannot
+        # serialize (Pallas interpreter callbacks off-TPU) fall back
+        # to their jit wrappers, counted in the cache stats.
+        from triton_dist_tpu.tools.aot import wrap_serving_programs
+        progs, self._aot = wrap_serving_programs(
+            progs, context=(backend, sampling,
+                            _params_key(self._sample_params),
+                            self.prefill_backend))
+        self._prefill = progs["prefill"]
+        self._decode_scan = progs["decode_scan"]
+        # slot-masked chunked decode (continuous batching,
+        # models/scheduler.py) + the paged/verify/mixed program
+        # family — all lazy-compiled on first use (the program
+        # roles are documented on _jit_programs). backend='mega'
+        # carries the SAME per-op family (built at its prefill
+        # backend) as the admission/mixed/tier fallback plus the
+        # fused paged tick program (paged_slot_mega).
+        self._slot_scan = progs["slot_scan"]
+        self._prefill_slot = progs["prefill_slot"]
+        self._write_slot = progs["write_slot"]
+        # persistent 1-row scratch for prefill_into_slot, donated
+        # through each admission instead of reallocated per request
+        self._slot_scratch = None
+        self._paged_slot_scan = progs["paged_slot_scan"]
+        self._paged_admit = progs["paged_admit"]
+        self._paged_set_table = progs["paged_set_table"]
+        self._paged_scratch = None
+        if sampling != "greedy":
+            self._spec_seed = progs["spec_seed"]
+        self._slot_verify = progs["slot_verify"]
+        self._paged_slot_verify = progs["paged_slot_verify"]
+        self._slot_mixed = progs["slot_mixed"]
+        self._paged_slot_mixed = progs["paged_slot_mixed"]
+        self._slot_mixed_verify = progs["slot_mixed_verify"]
+        self._paged_slot_mixed_verify = \
+            progs["paged_slot_mixed_verify"]
+        self._paged_install = progs["paged_install"]
+        self._gather_pages = progs["gather_pages"]
+        self._restore_pages = progs["restore_pages"]
         if backend == "mega":
-            self._prefill = jax.jit(functools.partial(
-                _prefill_fn, mode=self.prefill_backend))
-            self._decode_scan = jax.jit(
-                _mega_scan_decode_fn, static_argnames=("gen_len",),
-                donate_argnums=(2,))
-        else:
-            progs = _jit_programs(backend, sampling,
-                                  _params_key(self._sample_params),
-                                  self.prefill_backend)
-            self._prefill = progs["prefill"]
-            self._decode_scan = progs["decode_scan"]
-            # slot-masked chunked decode (continuous batching,
-            # models/scheduler.py) + the paged/verify/mixed program
-            # family — all lazy-compiled on first use (the program
-            # roles are documented on _jit_programs)
-            self._slot_scan = progs["slot_scan"]
-            self._prefill_slot = progs["prefill_slot"]
-            self._write_slot = progs["write_slot"]
-            # persistent 1-row scratch for prefill_into_slot, donated
-            # through each admission instead of reallocated per request
-            self._slot_scratch = None
-            self._paged_slot_scan = progs["paged_slot_scan"]
-            self._paged_admit = progs["paged_admit"]
-            self._paged_set_table = progs["paged_set_table"]
-            self._paged_scratch = None
-            if sampling != "greedy":
-                self._spec_seed = progs["spec_seed"]
-            self._slot_verify = progs["slot_verify"]
-            self._paged_slot_verify = progs["paged_slot_verify"]
-            self._slot_mixed = progs["slot_mixed"]
-            self._paged_slot_mixed = progs["paged_slot_mixed"]
-            self._slot_mixed_verify = progs["slot_mixed_verify"]
-            self._paged_slot_mixed_verify = \
-                progs["paged_slot_mixed_verify"]
-            self._paged_install = progs["paged_install"]
-            self._gather_pages = progs["gather_pages"]
-            self._restore_pages = progs["restore_pages"]
+            self._paged_slot_mega = progs["paged_slot_mega"]
+            self._c_mega = _reg.counter(
+                "engine_mega_dispatches", "fused paged mega decode "
+                                          "ticks")
 
     def prefill(self, input_ids):
         """Run the prefill pass on a fresh cache; returns (logits, cache)."""
@@ -202,6 +222,13 @@ class Engine:
         benchmark times this call alone — it is the reference's measured
         decode loop (engine.py:166). `seed` feeds the sampler key for
         the non-greedy modes (ignored under greedy)."""
+        if self.backend == "mega" and self.kv_dtype is not None:
+            raise ValueError(
+                "backend='mega' dequants int8 KV only on the PAGED "
+                "pool (the fused tick's scale-plane dequant); the "
+                "contiguous decode scan reads the cache directly — "
+                "serve int8 through ContinuousScheduler(paged=True), "
+                "or use kv_dtype=None here")
         if self.sampling == "greedy" or self.backend == "mega":
             toks, _, _ = self._decode_scan(self.model, logits, cache,
                                            gen_len=gen_len)
@@ -283,8 +310,11 @@ class Engine:
         device_get per poll (_fetch), and overlap=True moves it past
         the next dispatch."""
         if self.backend == "mega":
-            raise ValueError("backend='mega' carries no resumable "
-                             "slot state; use the per-op backends")
+            raise ValueError(
+                "backend='mega' fuses the PAGED decode tick only "
+                "(paged_slot_chunk); contiguous slot serving runs the "
+                "per-op backends — use ContinuousScheduler(paged=True) "
+                "or backend='flash'")
         self._c_decode.inc()
         if self._comm_backend:
             self._c_comm.inc()
@@ -325,8 +355,10 @@ class Engine:
         t0_next [B] — the corrected next seed token, cache, pos, keys).
         """
         if self.backend == "mega":
-            raise ValueError("backend='mega' carries no resumable slot "
-                             "state; use the per-op backends")
+            raise ValueError(
+                "backend='mega' does not fuse the spec-decode verify "
+                "window yet (per-slot q_lens stay on the per-op "
+                "programs); serve spec=K on the per-op backends")
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         self._c_verify.inc()
@@ -349,6 +381,11 @@ class Engine:
         never touch a live or cached page; rejected rows stay in the
         slot's own mapped pages until the next window overwrites them).
         """
+        if self.backend == "mega":
+            raise ValueError(
+                "backend='mega' does not fuse the spec-decode verify "
+                "window yet (the fused tick is the greedy S == 1 "
+                "paged step); serve spec=K on the per-op backends")
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         self._c_verify.inc()
@@ -394,8 +431,10 @@ class Engine:
         cache, pos, keys). pos advances by q_lens for prefill rows and
         by 1 for active decode rows."""
         if self.backend == "mega":
-            raise ValueError("backend='mega' carries no resumable slot "
-                             "state; use the per-op backends")
+            raise ValueError(
+                "backend='mega' fuses the PAGED decode tick only; "
+                "contiguous mixed ticks run the per-op backends (the "
+                "paged mixed tick falls back automatically)")
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         prefilling = jnp.asarray(prefilling, bool)
@@ -436,8 +475,9 @@ class Engine:
         sel_logits [B, V] — arming logits at each row's last valid
         window position, cache, pos, keys)."""
         if self.backend == "mega":
-            raise ValueError("backend='mega' carries no resumable slot "
-                             "state; use the per-op backends")
+            raise ValueError(
+                "backend='mega' does not fuse the spec-decode verify "
+                "window yet; serve spec=K on the per-op backends")
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
         prefilling = jnp.asarray(prefilling, bool)
@@ -515,10 +555,13 @@ class Engine:
         compile); GQA replication (num_heads > num_kv_heads) is a
         query-side property and changes nothing about the pool split."""
         from triton_dist_tpu.models.kv_cache import PagedSlotCache
-        if self.backend == "mega":
-            raise ValueError("backend='mega' has no resumable slot "
-                             "state; paged serving uses the per-op "
-                             "backends")
+        if self.backend == "mega" and \
+                self.model.mesh.shape[self.model.axis] > 1:
+            raise ValueError(
+                "backend='mega' fuses the paged tick single-chip only "
+                "(the TP pool's head-group plane split stays on the "
+                "per-op shard_map path); serve TP meshes with "
+                "backend='flash'/'dist'/'ar'/'gemm_ar'")
         if not hasattr(self.model, "forward_tokens_slots_paged"):
             raise ValueError(
                 f"{type(self.model).__name__} has no paged slot decode "
@@ -595,10 +638,21 @@ class Engine:
         """slot_chunk over the paged pool: identical contract, but each
         row's KV scatter resolves through the page table (a retired
         row's table maps the trash page, so its masked-out writes can
-        never touch a live or cached page)."""
+        never touch a live or cached page).
+
+        backend='mega' routes this tick through the FUSED program
+        (_paged_slot_mega_scan_fn — one MegaPagedDecodeLayer kernel
+        per layer per step instead of the per-op dispatch chain),
+        greedy-only by construction; same contract, same carry."""
         self._c_decode.inc()
         if self._comm_backend:
             self._c_comm.inc()
+        if self.backend == "mega":
+            assert keys is None   # greedy enforced at __init__
+            self._c_mega.inc()
+            toks, logits, pcache, pos = self._paged_slot_mega(
+                self.model, logits, pcache, pos, active, gen_len=chunk)
+            return toks, logits, pcache, pos, None
         if self.sampling == "greedy":
             assert keys is None
             toks, logits, pcache, pos = self._paged_slot_scan(
@@ -648,9 +702,6 @@ class Engine:
         pool (head_groups > 1): it selects each page's owning payload
         plane so the gathered bytes are the true ones; ignored on a
         single-group pool."""
-        if self.backend == "mega":
-            raise ValueError("backend='mega' has no paged pool to "
-                             "demote from; use the per-op backends")
         import numpy as np
         ids = np.asarray(page_ids, np.int32).reshape(-1)
         n = len(ids)
@@ -685,9 +736,6 @@ class Engine:
         on the donated cache, run BEFORE the promoted prefix is mapped
         into any slot's table. Padded tail ids point at the trash page
         (zero payload — harmless)."""
-        if self.backend == "mega":
-            raise ValueError("backend='mega' has no paged pool to "
-                             "restore into; use the per-op backends")
         import numpy as np
         ids = np.asarray(page_ids, np.int32).reshape(-1)
         n = len(ids)
@@ -755,20 +803,40 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
       prefill mixed prefill+decode ticks;
     - gather_pages / restore_pages: the host-KV-tier d2h/h2d pair.
 
+    backend='mega' (the fused paged decode tick — ISSUE 12): the
+    per-op family above is built at the FALLBACK backend ("flash" —
+    the mega engine's prefill/mixed/admission programs are per-op by
+    design), decode_scan is the contiguous megakernel loop, and
+    paged_slot_mega is the fused greedy paged tick (one
+    MegaPagedDecodeLayer kernel per layer per step, scanned with a
+    donated pool).
+
     All lazy-compiled: a path never exercised costs nothing."""
     params = dict(temperature=pkey[0], k=pkey[1], p=pkey[2])
     greedy = sampling == "greedy"
+    # the per-op fallback backend: mega serves its admissions, mixed
+    # prefill+decode ticks and host-tier hops through these programs
+    fb = "flash" if backend == "mega" else backend
     P = {}
     P["prefill"] = jax.jit(functools.partial(_prefill_fn,
                                              mode=prefill_mode))
-    scan_fn = (functools.partial(_scan_decode_fn, backend) if greedy
-               else functools.partial(_sampled_scan_decode_fn, backend,
-                                      sampling, params))
-    P["decode_scan"] = jax.jit(scan_fn, static_argnames=("gen_len",),
-                               donate_argnums=(2,))
-    slot_fn = (functools.partial(_slot_scan_decode_fn, backend)
+    if backend == "mega":
+        P["decode_scan"] = jax.jit(
+            _mega_scan_decode_fn, static_argnames=("gen_len",),
+            donate_argnums=(2,))
+        P["paged_slot_mega"] = jax.jit(
+            _paged_slot_mega_scan_fn, static_argnames=("gen_len",),
+            donate_argnums=(2,))
+    else:
+        scan_fn = (functools.partial(_scan_decode_fn, backend) if greedy
+                   else functools.partial(_sampled_scan_decode_fn,
+                                          backend, sampling, params))
+        P["decode_scan"] = jax.jit(scan_fn,
+                                   static_argnames=("gen_len",),
+                                   donate_argnums=(2,))
+    slot_fn = (functools.partial(_slot_scan_decode_fn, fb)
                if greedy else
-               functools.partial(_sampled_slot_scan_decode_fn, backend,
+               functools.partial(_sampled_slot_scan_decode_fn, fb,
                                  sampling, params))
     P["slot_scan"] = jax.jit(slot_fn, static_argnames=("gen_len",),
                              donate_argnums=(2,))
@@ -776,9 +844,9 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
         functools.partial(_prefill_slot_fn, mode=prefill_mode),
         donate_argnums=(2,))
     P["write_slot"] = jax.jit(_write_slot_fn, donate_argnums=(0,))
-    paged_fn = (functools.partial(_paged_slot_scan_decode_fn, backend)
+    paged_fn = (functools.partial(_paged_slot_scan_decode_fn, fb)
                 if greedy else
-                functools.partial(_sampled_paged_slot_scan_fn, backend,
+                functools.partial(_sampled_paged_slot_scan_fn, fb,
                                   sampling, params))
     P["paged_slot_scan"] = jax.jit(paged_fn,
                                    static_argnames=("gen_len",),
@@ -789,12 +857,12 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
     P["paged_set_table"] = jax.jit(_paged_set_table_fn,
                                    donate_argnums=(0,))
     if greedy:
-        vfn = functools.partial(_slot_verify_fn, backend)
-        pvfn = functools.partial(_paged_slot_verify_fn, backend)
+        vfn = functools.partial(_slot_verify_fn, fb)
+        pvfn = functools.partial(_paged_slot_verify_fn, fb)
     else:
-        vfn = functools.partial(_sampled_slot_verify_fn, backend,
+        vfn = functools.partial(_sampled_slot_verify_fn, fb,
                                 sampling, params)
-        pvfn = functools.partial(_sampled_paged_slot_verify_fn, backend,
+        pvfn = functools.partial(_sampled_paged_slot_verify_fn, fb,
                                  sampling, params)
         P["spec_seed"] = jax.jit(functools.partial(_spec_seed_fn,
                                                    sampling, params))
@@ -802,17 +870,17 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
     P["paged_slot_verify"] = jax.jit(pvfn, donate_argnums=(1,))
     samp = None if greedy else sampling
     P["slot_mixed"] = jax.jit(
-        functools.partial(_mixed_step_fn, backend, samp, params, False),
+        functools.partial(_mixed_step_fn, fb, samp, params, False),
         donate_argnums=(2,))
     P["paged_slot_mixed"] = jax.jit(
-        functools.partial(_mixed_step_fn, backend, samp, params, True),
+        functools.partial(_mixed_step_fn, fb, samp, params, True),
         donate_argnums=(2,))
     P["slot_mixed_verify"] = jax.jit(
-        functools.partial(_mixed_verify_fn, backend, samp, params,
+        functools.partial(_mixed_verify_fn, fb, samp, params,
                           False),
         donate_argnums=(1,))
     P["paged_slot_mixed_verify"] = jax.jit(
-        functools.partial(_mixed_verify_fn, backend, samp, params,
+        functools.partial(_mixed_verify_fn, fb, samp, params,
                           True),
         donate_argnums=(1,))
     P["paged_install"] = jax.jit(_paged_install_fn, donate_argnums=(0,))
@@ -1513,7 +1581,9 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
         # the cache arrives head-sharded over the (size-1) tp axis; the
         # megakernel outputs are replicated — pin the scan carry to one
         # consistent (replicated) type under explicit-sharding meshes
-        if any(t == AxisType.Explicit for t in model.mesh.axis_types):
+        # (axis_types is None on jax 0.4.x meshes — treat as non-explicit)
+        if any(t == AxisType.Explicit
+               for t in (model.mesh.axis_types or ())):
             return jax.sharding.reshard(a, NamedSharding(model.mesh, _P()))
         return a
 
@@ -1569,3 +1639,91 @@ def _mega_scan_decode_fn(model, logits0, cache, *, gen_len: int):
         step, (jnp.argmax(logits0, axis=-1), cache.offset, ks, vs),
         None, length=gen_len)
     return toks.T, tok, None                         # [B, gen_len]
+
+
+def _paged_slot_mega_scan_fn(model, logits0, pcache, pos, active, *,
+                             gen_len: int):
+    """FUSED paged greedy decode tick (ISSUE 12 / ROADMAP item 5): the
+    paged_slot_chunk contract — same carry (logits, pcache, pos), same
+    masking, same token stream — with each scan step running ONE
+    MegaPagedDecodeLayer kernel per layer (mega/decode_layer.py: the
+    paged table walk, per-slot kv_lens, the trash-page write sink and
+    the int8 scale-plane dequant all inside the fused layer) instead
+    of the per-op dispatch chain. Weights repack into the megakernel
+    layout ONCE outside the scan; per-slot rope rows gather at each
+    slot's own position. Greedy only (the carry is the argmax chain);
+    single chip (make_paged_slot_cache refuses TP meshes up front)."""
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.layers.common import rms_norm
+    from triton_dist_tpu.mega import MegaPagedDecodeLayer
+
+    cfg = model.config
+    maxp = pcache.table.shape[1]
+    quant = pcache.quantized
+    layer = MegaPagedDecodeLayer(
+        d_model=cfg.hidden_size, n_heads=cfg.num_heads,
+        n_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        ffn=cfg.intermediate_size, page=pcache.page, maxp=maxp,
+        eps=cfg.rms_norm_eps, block_n=_pick_mega_bn(cfg),
+        qk_norm=model.layers[0].attn.q_norm is not None)
+    ones = jnp.ones((1, cfg.head_dim), jnp.float32)
+    bf = jnp.bfloat16
+    weights = []
+    for ly in model.layers:
+        attn, mlp = ly.attn, ly.mlp
+        weights.append(dict(
+            w_ln1=ly.ln_attn[None].astype(jnp.float32),
+            w_qkv=attn.w_qkv.astype(bf),
+            q_norm=(ones if attn.q_norm is None
+                    else attn.q_norm[None].astype(jnp.float32)),
+            k_norm=(ones if attn.k_norm is None
+                    else attn.k_norm[None].astype(jnp.float32)),
+            w_o=attn.w_o.astype(bf),
+            w_ln2=ly.ln_mlp[None].astype(jnp.float32),
+            w_gu=mlp.w_gate_up.astype(bf),
+            w_d=mlp.w_down.astype(bf)))
+    act = active.astype(jnp.int32)
+    cap = pcache.capacity
+    # pallas_call needs Manual mesh axes (the contiguous mega scan's
+    # rule): each layer call runs under shard_map, pool operands on
+    # the head-group sharding they were created with (size-1 plane at
+    # tp=1 — TP meshes are refused at pool construction)
+    ax = model.axis
+    pool4 = P(None, ax, None, None)
+    sc3 = P(None, ax, None)
+    rep2 = P(None, None)
+    wspec = {k: rep2 for k in ("w_ln1", "w_qkv", "q_norm", "k_norm",
+                               "w_o", "w_ln2", "w_gu", "w_d",
+                               "cos_row", "sin_row")}
+    in_specs = (rep2, P(None), wspec, pool4, pool4, rep2) + (
+        (sc3, sc3) if quant else ())
+    out_specs = (rep2, pool4, pool4) + ((sc3, sc3) if quant else ())
+    mega_call = jax.shard_map(
+        lambda x, p, wd, *kv: layer(x, p, wd, *kv),
+        mesh=model.mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)
+
+    def step(carry, _):
+        logits, pc, pos_ = carry
+        tok = jnp.where(active, jnp.argmax(logits, axis=-1), 0)
+        x = model.embed[tok].astype(jnp.float32)       # [B, D]
+        crow = model.cos[pos_]                         # [B, hd//2]
+        srow = model.sin[pos_]
+        for li, w in enumerate(weights):
+            wd = dict(w, cos_row=crow, sin_row=srow)
+            extra = ((pc.scales_k[li], pc.scales_v[li]) if quant
+                     else ())
+            outs = mega_call(x, pos_, wd, pc.pages_k[li],
+                             pc.pages_v[li], pc.table, *extra)
+            x = outs[0]
+            pc = pc.set_layer(li, *outs[1:])
+        xf = rms_norm(x, model.final_norm.astype(jnp.float32),
+                      cfg.rms_norm_eps)
+        logits = jnp.dot(xf.astype(model.lm_head.dtype), model.lm_head,
+                         preferred_element_type=jnp.float32)
+        pos_ = jnp.minimum(pos_ + act, cap - 1)
+        return (logits, pc, pos_), tok
+
+    (logits, pcache, pos), toks = jax.lax.scan(
+        step, (logits0, pcache, pos), None, length=gen_len)
+    return toks.T, logits, pcache, pos               # [B, gen_len]

@@ -155,7 +155,17 @@ class PagedSlotCache:
     of a page outside its owning head's group hold garbage by design
     (never read — the same argument that lets retired pages keep
     stale bytes); the host-tier d2h gather selects the owning plane
-    per page (Engine.extract_pages_host heads=...)."""
+    per page (Engine.extract_pages_host heads=...).
+
+    MEGAKERNEL TICK (mega/decode_layer.py MegaPagedDecodeLayer —
+    ISSUE 12): the fused decode tick consumes this exact layout —
+    [NP, 1, page, d] single-plane pools + the shared trash-padded
+    table as a scalar-prefetch operand, scale planes riding the same
+    page id — so everything host-side (allocator, radix tree, CoW,
+    preemption, host tier) is oblivious to WHICH program walks the
+    pool; the engine swaps the tick per poll
+    (engine.paged_slot_chunk). The fused tick is single-plane by
+    contract: TP pools (G > 1) stay on the per-op shard_map path."""
 
     pages_k: Tuple[jax.Array, ...]   # L x [NP, G, page, d]
     pages_v: Tuple[jax.Array, ...]

@@ -245,7 +245,8 @@ class Qwen3MoE:
         moe_mode = "train" if mode == "train" else "xla"
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         from jax.sharding import AxisType, NamedSharding
-        if any(t == AxisType.Explicit for t in self.mesh.axis_types):
+        if any(t == AxisType.Explicit
+               for t in (self.mesh.axis_types or ())):
             # pin the embed-gather cotangent replicated (see
             # models/dense.py::forward_train)
             x = jax.sharding.reshard(

@@ -302,11 +302,12 @@ class _InFlight:
 # (device_wait_s_by_kind): the chunk scan is the decode tick, and a
 # mixed verify is still a mixed tick — the operator-facing question is
 # "which program CLASS am I waiting on", not which jit entry point
-_DISPATCH_KIND = {"chunk": "decode", "mixed_verify": "mixed"}
+_DISPATCH_KIND = {"chunk": "decode", "mixed_verify": "mixed",
+                  "mega": "mega"}
 
 # _InFlight.kind -> the same buckets, for the overlap land (which must
 # charge the LANDED tick's kind, not whatever dispatched since)
-_INFLIGHT_KIND = {"chunk": "decode", "mixed": "mixed",
+_INFLIGHT_KIND = {"chunk": "decode", "mega": "mega", "mixed": "mixed",
                   "spec": "verify", "mixed_spec": "mixed"}
 
 
@@ -396,14 +397,16 @@ class DecodeSlots:
         self.device_wait_by_kind: Dict[str, float] = {
             "prefill": 0.0, "decode": 0.0, "verify": 0.0,
             "mixed": 0.0, "admit": 0.0, "transfer": 0.0,
-            "other": 0.0}
+            "mega": 0.0, "other": 0.0}
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
             if engine.backend == "mega":
-                raise ValueError("backend='mega' has no verify path; "
-                                 "spec decoding uses the per-op "
-                                 "backends")
+                raise ValueError(
+                    "backend='mega' does not fuse the spec-decode "
+                    "verify window yet (the fused tick is the greedy "
+                    "S == 1 paged step); serve spec=K on the per-op "
+                    "backends")
             self.drafter = drafter if drafter is not None \
                 else NgramDrafter()
             self._vocab = V
@@ -441,6 +444,13 @@ class DecodeSlots:
     def _make_cache(self):
         """Cache-flavor hook (PagedDecodeSlots swaps in the paged pool)."""
         return self.engine.make_slot_cache(self.batch)
+
+    def _tick_kind(self) -> str:
+        """mark_dispatch kind of one plain decode tick ("chunk"; the
+        paged subclass reports "mega" when the engine routes the tick
+        through the fused megakernel program — device_wait_s_by_kind
+        then attributes the fused tick separately)."""
+        return "chunk"
 
     @property
     def capacity(self) -> int:
@@ -791,7 +801,7 @@ class DecodeSlots:
         emits 1..K+1 tokens per call (seed + accepted drafts)."""
         if self.spec:
             return self._step_spec()
-        self.tele.mark_dispatch("chunk")
+        self.tele.mark_dispatch(self._tick_kind())
         (toks,) = self._fetch((self._run_chunk(chunk),))
         toks = np.asarray(toks)
         plan, finished = self._plan_chunk(chunk)
@@ -990,7 +1000,8 @@ class DecodeSlots:
         if self.spec:
             self.begin_spec(skip)
             return
-        self.tele.mark_dispatch("chunk")
+        kind = self._tick_kind()
+        self.tele.mark_dispatch(kind)
         toks_dev = self._run_chunk(chunk)
         plan, finishing = self._plan_chunk(chunk, skip)
         for b, _ in finishing:
@@ -998,7 +1009,7 @@ class DecodeSlots:
             # retires between ticks; the retire itself waits for
             # land — the radix-tree insert needs the token values)
             self.active = self.active.at[b].set(False)
-        self._inflight = _InFlight("chunk", (toks_dev,), plan, finishing)
+        self._inflight = _InFlight(kind, (toks_dev,), plan, finishing)
 
     def begin_spec(self, skip=frozenset()) -> None:
         """Dispatch one spec verify tick: drafting reads the LANDED
@@ -1066,15 +1077,15 @@ class DecodeSlots:
             return {}, []
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
-        if inf.kind in ("chunk", "mixed"):
+        if inf.kind in ("chunk", "mega", "mixed"):
             (toks,) = self._fetch(inf.arrs,
                                   kind=_INFLIGHT_KIND[inf.kind])
             toks = np.asarray(toks)
             for b, rid, keep in inf.plan:
                 assert self.rids[b] == rid, \
                     "slot reassigned under an unlanded tick"
-                kept = (toks[b, :keep] if inf.kind == "chunk"
-                        else toks[b:b + 1]).copy()
+                kept = (toks[b:b + 1] if inf.kind == "mixed"
+                        else toks[b, :keep]).copy()
                 out[b] = kept
                 self._record(b, kept)
             finished = inf.finishing
@@ -1164,6 +1175,12 @@ class PagedDecodeSlots(DecodeSlots):
     def _make_cache(self):
         return self.engine.make_paged_slot_cache(
             self.batch, page=self.page, num_pages=self._num_pages)
+
+    def _tick_kind(self) -> str:
+        # backend='mega' routes the pure-decode paged tick through the
+        # fused megakernel program (engine.paged_slot_chunk) — mixed
+        # ticks still dispatch per-op and keep their "mixed" kind
+        return "mega" if self.engine.backend == "mega" else "chunk"
 
     # host KV tier copy callbacks (prefix_cache.attach_host_tier):
     # the residency machine calls these from inside evict_until /
@@ -1559,6 +1576,12 @@ class ContinuousScheduler:
                 trace = trace_env_enabled()
             self.tele = Telemetry(trace=trace)
         self.tele.configure_slo(slo_classes)
+        if getattr(engine, "backend", None) == "mega" and not paged:
+            raise ValueError(
+                "backend='mega' fuses the PAGED decode tick only "
+                "(engine.paged_slot_chunk); construct "
+                "ContinuousScheduler(paged=True), or serve contiguous "
+                "slots on a per-op backend such as 'flash'")
         if paged:
             self.slots = PagedDecodeSlots(
                 engine, batch, page=page, num_pages=num_pages,
@@ -1635,6 +1658,14 @@ class ContinuousScheduler:
             engine.model.mesh.shape[engine.model.axis])
         reg.gauge("tp_size",
                   "TP mesh size this scheduler drives").set(self.tp_size)
+        # megakernel serving gauge (ISSUE 12 satellite): 1 when the
+        # pure-decode paged tick runs the fused program — paired with
+        # device_wait_kind_s{kind="mega"} it tells an operator the
+        # fused tick is live and what the host actually waits on
+        reg.gauge("mega_enabled",
+                  "1 = decode ticks run the fused megakernel "
+                  "program").set(
+            1.0 if getattr(engine, "backend", None) == "mega" else 0.0)
         self._c_tokens = reg.counter(
             "tokens_emitted", "tokens delivered to client streams")
         self._busy_s = 0.0
@@ -1766,7 +1797,7 @@ class ContinuousScheduler:
             by_kind = {k: round(v, 4) for k, v in
                        self.slots.device_wait_by_kind.items()}
             for k in ("prefill", "decode", "verify", "mixed",
-                      "admit", "transfer"):
+                      "mega", "admit", "transfer"):
                 reg.gauge("device_wait_kind_s",
                           labels={"kind": k}).set(by_kind.get(k, 0.0))
             # live throughput, aggregate AND per-chip (one scheduler

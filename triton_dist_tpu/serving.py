@@ -146,7 +146,17 @@ class TokenServer:
     streams keep flowing. Still single-threaded ON THE MODEL: socket
     threads only parse requests and write replies; every jax dispatch
     happens on the serve_forever thread (concurrency is batching, not
-    model threads — the discipline the old one-request loop had, kept)."""
+    model threads — the discipline the old one-request loop had, kept).
+
+    Engine(backend="mega") engines serve here unchanged with
+    paged=True (greedy streams): pure-decode polls run the FUSED
+    megakernel tick (one Pallas kernel per layer —
+    engine.paged_slot_chunk routes it), admissions and chunked-prefill
+    mixed polls fall back per-op per poll, and the `mega_enabled`
+    gauge + `device_wait_kind_s{kind="mega"}` ride the stats()/
+    Prometheus surfacing below. Unsupported combinations (sampled,
+    spec=K, paged=False, TP meshes) refuse at construction with the
+    precise missing capability named — never mid-stream."""
 
     def __init__(self, engine, tokenizer, *, batch: int,
                  host: str = "127.0.0.1", port: int = 0,
