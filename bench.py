@@ -1059,6 +1059,97 @@ def _bench():
             c.release_compilation_cache()
         shutil.rmtree(aot_dir, ignore_errors=True)
 
+    # --- MoE serving rows (ISSUE 13 / ROADMAP item 1): Qwen3MoE
+    # through the SAME paged serving stack — per-slot routing inside
+    # the tick, grouped-GEMM expert dispatch — plus the layer-level
+    # grouped-GEMM-vs-per-expert-dense-loop differential the dispatch
+    # replaces. CPU smoke shapes off-chip; real chips via
+    # tools/onchip_regen.sh per the ROADMAP standing note.
+    from triton_dist_tpu.models.config import tiny_qwen3_moe
+    mesh_m1 = jax.make_mesh((1,), ("tp",))
+    if on_tpu:
+        cfg_moe = tiny_qwen3_moe(
+            1, hidden_size=1024, num_heads=8, num_kv_heads=4,
+            head_dim=128, num_layers=4, num_experts=16,
+            num_experts_per_tok=2, moe_intermediate_size=512,
+            vocab_size=32768, dtype="bfloat16",
+            max_position_embeddings=512)
+        moe_n, moe_len, moe_gen, moe_batch = 16, 64, 64, 8
+    else:
+        cfg_moe = tiny_qwen3_moe(1, num_experts=4)
+        moe_n, moe_len, moe_gen, moe_batch = 4, 8, 6, 2
+    model_moe = AutoLLM.from_config(cfg_moe, mesh_m1,
+                                    capacity_factor="dropless")
+    eng_moe = Engine(model_moe, max_seq=moe_len + moe_gen + 16,
+                     backend="flash")
+
+    def moe_reqs():
+        r = np.random.RandomState(13)
+        return [Request(rid=i,
+                        ids=r.randint(0, cfg_moe.vocab_size,
+                                      size=(moe_len,)).astype(np.int32),
+                        gen_len=moe_gen, seed=i)
+                for i in range(moe_n)]
+
+    def moe_run():
+        sched = ContinuousScheduler(eng_moe, batch=moe_batch, chunk=4,
+                                    paged=True, page=8)
+        t0 = time.perf_counter()
+        out = sched.run(moe_reqs())
+        dt = time.perf_counter() - t0
+        return sum(len(t) for t in out.values()) / dt, sched.stats()
+
+    moe_run()                              # warm the slot programs
+    moe_rate, st_moe = moe_run()
+    _emit_json({
+        "metric": "moe_serving_tok_per_s_per_chip",
+        "value": round(moe_rate, 2),
+        "unit": "tok/s",
+        "model": "qwen3_moe",
+        "num_experts": cfg_moe.num_experts,
+        "top_k": cfg_moe.num_experts_per_tok,
+        "capacity_drops": st_moe.get("moe_capacity_drops"),
+        "expert_load_imbalance": st_moe.get("expert_load_imbalance"),
+        "requests": moe_n, "slots": moe_batch,
+        "backend": jax.default_backend(),
+    })
+
+    # layer-level dispatch differential: ONE decode tick's worth of
+    # tokens through the routed grouped-GEMM path (fwd_local — what
+    # the serving tick runs) vs the per-expert dense loop (fwd_xla —
+    # every token through every expert). value = dense / grouped wall,
+    # so > 1 means the grouped dispatch is winning; on the CPU smoke
+    # the tiny shapes make it noise, real chips are the measurement.
+    moe_layer = model_moe.layers[0].moe
+    x_tick = jnp.asarray(
+        np.random.RandomState(14).randn(
+            max(moe_batch, 8), cfg_moe.hidden_size
+        ).astype(np.float32)).astype(cfg_moe.jax_dtype)
+    grouped_f = jax.jit(lambda m, x: m(x, "flash"))
+    dense_f = jax.jit(lambda m, x: m(x, "xla"))
+
+    def _moe_time(f, n=5):
+        jax.block_until_ready(f(moe_layer, x_tick))   # compile + warm
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(moe_layer, x_tick))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_grouped = _moe_time(grouped_f)
+    t_dense = _moe_time(dense_f)
+    _emit_json({
+        "metric": "moe_grouped_gemm_speedup",
+        "value": round(t_dense / t_grouped, 3),
+        "unit": "x",
+        "grouped_us": round(t_grouped * 1e6, 1),
+        "dense_loop_us": round(t_dense * 1e6, 1),
+        "tick_tokens": int(x_tick.shape[0]),
+        "num_experts": cfg_moe.num_experts,
+        "backend": jax.default_backend(),
+    })
+
 
 def main():
     if os.environ.get("TDTPU_BENCH_CHILD") == "1":

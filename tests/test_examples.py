@@ -77,6 +77,14 @@ def test_tp_serving_example_runs():
 
 
 @pytest.mark.slow
+def test_moe_serving_example_runs():
+    # slow: same budget note — the MoE-vs-serve differential the
+    # example demos already runs in-suite (tests/test_moe_serving.py);
+    # tools/moe_smoke.sh and manual runs cover the example itself
+    _run_example("19_moe_serving.py")
+
+
+@pytest.mark.slow
 def test_disaggregation_example_runs():
     # slow: same budget note — the disagg-vs-fused differential the
     # example demos already runs in-suite (tests/test_disagg.py);

@@ -287,6 +287,30 @@ def test_bench_compare_seconds_unit_is_latency_direction():
     assert res["serving_tok_per_s_aggregate"]["flag"] == "improved"
 
 
+def test_bench_compare_moe_row_directions():
+    """ISSUE 13 satellite: the two new MoE bench rows resolve to the
+    right regression direction — `moe_serving_tok_per_s_per_chip`
+    (tok/s, a rate: DOWN = regressed) and `moe_grouped_gemm_speedup`
+    (unit "x", a speedup multiplier: DOWN = regressed, despite no
+    "/" in the unit)."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "moe_serving_tok_per_s_per_chip", "value": 100.0,
+          "unit": "tok/s", "backend": "tpu"},
+         {"metric": "moe_grouped_gemm_speedup", "value": 3.0,
+          "unit": "x", "backend": "tpu"}]
+    b = [{"metric": "moe_serving_tok_per_s_per_chip", "value": 50.0,
+          "unit": "tok/s", "backend": "tpu"},
+         {"metric": "moe_grouped_gemm_speedup", "value": 1.2,
+          "unit": "x", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["moe_serving_tok_per_s_per_chip"]["flag"] == "regressed"
+    assert res["moe_serving_tok_per_s_per_chip"]["direction"] \
+        == "higher-is-better"
+    assert res["moe_grouped_gemm_speedup"]["flag"] == "regressed"
+    assert res["moe_grouped_gemm_speedup"]["direction"] \
+        == "higher-is-better"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# MoE serving smoke — the FULL Qwen3MoE serving matrix
+# (tests/test_moe_serving.py: greedy/sampled/spec x prefix cache,
+# chunked prefill, overlap, preemption, host tier, int8, chaos,
+# disaggregation, the EP + hybrid-mesh arms and the example) on the
+# forced multi-device CPU mesh — the focused loop for iterating on the
+# MoE serving layer alone, since tier-1's 870 s budget keeps only the
+# greedy differential + churn guard + units (the tp_smoke/disagg_smoke
+# pattern). Archives the pass count next to the log and reports the
+# delta vs the previous run, tier1.sh-style.
+# Run from the repo root: bash tools/moe_smoke.sh
+set -o pipefail
+rm -f /tmp/_moe_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the whole matrix.
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_moe_serving.py \
+    "tests/test_examples.py::test_moe_serving_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_moe_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_moe_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_moe_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "MOE_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "MOE_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

@@ -113,6 +113,17 @@ class DispatchPlan:
         return jnp.sum(~self.valid).astype(jnp.int32)
 
 
+def expert_token_counts(topk_idx, num_experts: int):
+    """Routed entries per expert for ONE forward ([E] int32, from the
+    router's top-k indices) — the per-expert load the serving telemetry
+    surfaces (`expert_tokens{expert=...}` gauges, models/scheduler.py):
+    the observable half of dropless-or-loud. Counts every routed entry
+    the program computes, including capacity-dropped ones and masked
+    slot rows — it measures expert COMPUTE load, not emitted tokens."""
+    return jnp.bincount(topk_idx.reshape(-1),
+                        length=num_experts).astype(jnp.int32)
+
+
 def warn_on_drops(dropped, where: str):
     """In-program loud warning when a capacity drop occurred (traced
     scalar; prints only on the steps that actually drop).

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels.ep_a2a import (combine_a2a, combine_from_slots,
                                             dispatch_a2a, dispatch_a2a_int8,
+                                            expert_token_counts,
                                             fill_send_buffers,
                                             group_by_expert, pack_rows_int8,
                                             plan_dispatch,
@@ -74,6 +75,18 @@ class EP_MoE:
              capacity_factor: float = 2.0,
              slice_axis: Optional[str] = None,
              payload_int8: bool = False) -> "EP_MoE":
+        import numpy as np
+        E = np.shape(w_gate)[0]      # no device transfer for the check
+        n_ep = mesh.shape[axis] * (mesh.shape[slice_axis]
+                                   if slice_axis else 1)
+        if E % n_ep:
+            raise ValueError(
+                f"EP_MoE needs the expert count ({E}) divisible by the "
+                f"expert-parallel axis size ({n_ep}, mesh axis "
+                f"{axis!r}" + (f" x {slice_axis!r}" if slice_axis else
+                               "") + "): each device owns a whole group "
+                "of expert panels — pad the expert set or shrink the "
+                "ep axis")
         packed = jnp.concatenate([jnp.asarray(w_gate), jnp.asarray(w_up)],
                                  axis=-1)               # [E, D, 2I]
         espec = (P((slice_axis, axis), None, None) if slice_axis
@@ -137,10 +150,13 @@ class EP_MoE:
         disp/comb/gemm swap the a2a and grouped-GEMM callables (the
         train path passes the custom-VJP wrappers).
 
-        return_stats=True additionally returns {"dropped": scalar} — the
-        global count of routed entries lost to capacity this step
-        (always 0 with capacity_factor='dropless'); warn_drops keeps an
-        in-program warning on the others (dropless-or-loud)."""
+        return_stats=True additionally returns {"dropped": scalar,
+        "expert_tokens": [E] int32} — the global count of routed
+        entries lost to capacity this step (always 0 with
+        capacity_factor='dropless') and the global per-expert routed
+        load (the serving telemetry's `expert_tokens{expert=...}`
+        gauges); warn_drops keeps an in-program warning on the others
+        (dropless-or-loud)."""
         n = self.mesh.shape[self.axis]
         axis = self.axis
         epr = self.num_experts // n
@@ -175,7 +191,7 @@ class EP_MoE:
             jax.shard_map, mesh=self.mesh,
             in_specs=(P(axis, None), P(None, None),
                       P(axis, None, None), P(axis, None, None)),
-            out_specs=(P(axis, None), P(None)), check_vma=False)
+            out_specs=(P(axis, None), P(None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             t_loc = x_loc.shape[0]
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
@@ -205,11 +221,18 @@ class EP_MoE:
             else:
                 # no observer: skip the per-step cross-rank scalar psum
                 dropped = jnp.zeros((), jnp.int32)
-            return y.astype(x_loc.dtype), dropped[None]
+            if return_stats:
+                counts = jax.lax.psum(
+                    expert_token_counts(topk_idx, self.num_experts),
+                    axis)
+            else:
+                counts = jnp.zeros((self.num_experts,), jnp.int32)
+            return y.astype(x_loc.dtype), dropped[None], counts
 
-        y, dropped = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        y, dropped, counts = _f(x, self.w_router, self.w_gate_up,
+                                self.w_down)
         if return_stats:
-            return y, {"dropped": dropped[0]}
+            return y, {"dropped": dropped[0], "expert_tokens": counts}
         return y
 
     def _cap_e(self, t_loc: int) -> int:
@@ -268,7 +291,8 @@ class EP_MoE:
             in_specs=(P((sax, cax), None), P(None, None),
                       P((sax, cax), None, None),
                       P((sax, cax), None, None)),
-            out_specs=(P((sax, cax), None), P(None)), check_vma=False)
+            out_specs=(P((sax, cax), None), P(None), P(None)),
+            check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
             # int8 wire (payload_int8): tokens pack ONCE here and cross
@@ -340,11 +364,17 @@ class EP_MoE:
                     warn_on_drops(dropped, "EP_MoE.fwd_ep_2d")
             else:
                 dropped = jnp.zeros((), jnp.int32)
-            return y.astype(x_loc.dtype), dropped[None]
+            if return_stats:
+                counts = jax.lax.psum(
+                    expert_token_counts(topk_idx, E), (sax, cax))
+            else:
+                counts = jnp.zeros((E,), jnp.int32)
+            return y.astype(x_loc.dtype), dropped[None], counts
 
-        y, dropped = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        y, dropped, counts = _f(x, self.w_router, self.w_gate_up,
+                                self.w_down)
         if return_stats:
-            return y, {"dropped": dropped[0]}
+            return y, {"dropped": dropped[0], "expert_tokens": counts}
         return y
 
     def fwd_ep_fused(self, x, return_stats: bool = False,
@@ -383,7 +413,7 @@ class EP_MoE:
                             P(axis, None)),
                       qspec(self.w_down, P(axis, None, None),
                             P(axis, None))),
-            out_specs=(P(axis, None), P(None)), check_vma=False)
+            out_specs=(P(axis, None), P(None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             topk_w, topk_idx = route(x_loc @ router.astype(x_loc.dtype), k)
             # one "destination" per GLOBAL expert: the slot layout IS
@@ -411,17 +441,25 @@ class EP_MoE:
                     warn_on_drops(dropped, "EP_MoE.fwd_ep_fused")
             else:
                 dropped = jnp.zeros((), jnp.int32)
-            return y.astype(x_loc.dtype), dropped[None]
+            if return_stats:
+                counts = jax.lax.psum(expert_token_counts(topk_idx, E),
+                                      axis)
+            else:
+                counts = jnp.zeros((E,), jnp.int32)
+            return y.astype(x_loc.dtype), dropped[None], counts
 
-        y, dropped = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        y, dropped, counts = _f(x, self.w_router, self.w_gate_up,
+                                self.w_down)
         if return_stats:
-            return y, {"dropped": dropped[0]}
+            return y, {"dropped": dropped[0], "expert_tokens": counts}
         return y
 
-    def fwd_xla(self, x):
+    def fwd_xla(self, x, return_stats: bool = False):
         """Oracle (x row-sharded): dense all-experts math with XLA
         collectives — all_gather tokens, each device computes its experts
-        densely, psum the weighted sum, slice back."""
+        densely, psum the weighted sum, slice back. The oracle never
+        drops; its return_stats counts the routed load only (the gauge
+        differential against the routed paths)."""
         axis = self.axis
         n = self.mesh.shape[axis]
         epr = self.num_experts // n
@@ -432,7 +470,7 @@ class EP_MoE:
             jax.shard_map, mesh=self.mesh,
             in_specs=(P(axis, None), P(None, None),
                       P(axis, None, None), P(axis, None, None)),
-            out_specs=P(axis, None), check_vma=False)
+            out_specs=(P(axis, None), P(None)), check_vma=False)
         def _f(x_loc, router, wgu_loc, wd_loc):
             me = jax.lax.axis_index(axis)
             xg = jax.lax.all_gather(x_loc, axis, axis=0, tiled=True)
@@ -447,10 +485,16 @@ class EP_MoE:
             y = jnp.einsum("te,etd->td", w_e, y_all.astype(jnp.float32))
             y = jax.lax.psum(y, axis)
             t_loc = x_loc.shape[0]
-            return jax.lax.dynamic_slice_in_dim(
-                y, me * t_loc, t_loc).astype(x_loc.dtype)
+            # every rank routes the same gathered tokens -> replicated
+            counts = expert_token_counts(topk_idx, E)
+            return (jax.lax.dynamic_slice_in_dim(
+                y, me * t_loc, t_loc).astype(x_loc.dtype), counts)
 
-        return _f(x, self.w_router, self.w_gate_up, self.w_down)
+        y, counts = _f(x, self.w_router, self.w_gate_up, self.w_down)
+        if return_stats:
+            return y, {"dropped": jnp.zeros((), jnp.int32),
+                       "expert_tokens": counts}
+        return y
 
     def fwd_train(self, x):
         """Training path through the framework kernels (reference: the

@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels import all_gather, grouped_gemm, reduce_scatter
-from triton_dist_tpu.kernels.ep_a2a import (group_tokens_by_expert, route,
+from triton_dist_tpu.kernels.ep_a2a import (expert_token_counts,
+                                            group_tokens_by_expert, route,
                                             scatter_weighted)
 from triton_dist_tpu.kernels.swiglu import swiglu_ref
 from triton_dist_tpu.layers.common import shard_cols_packed
@@ -121,7 +122,19 @@ class TP_MoE:
 
         return f(x_e, self.w_gate_up, self.w_down)   # [n, E, cap, D]
 
-    def fwd_xla(self, x):
+    def _stats(self, topk_idx, inv_slot=None, cap: int = 0):
+        """Serving-telemetry stats dict (return_stats=True on the
+        forwards below): per-expert routed-entry counts + the capacity
+        drop count (`inv_slot >= E*cap` marks entries
+        group_tokens_by_expert clamped out; the dense oracle never
+        drops). The dropless-or-loud contract made observable."""
+        E = self.num_experts
+        dropped = (jnp.sum(inv_slot >= E * cap).astype(jnp.int32)
+                   if inv_slot is not None else jnp.zeros((), jnp.int32))
+        return {"expert_tokens": expert_token_counts(topk_idx, E),
+                "dropped": dropped}
+
+    def fwd_xla(self, x, return_stats: bool = False):
         """Oracle: dense all-experts math with XLA psum — every token
         through every expert, topk-weighted (the torch oracle role)."""
         M, D = x.shape
@@ -143,9 +156,12 @@ class TP_MoE:
         onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)
         w_e = jnp.einsum("tk,tke->te", topk_w, onehot)
         y = jnp.einsum("te,etd->td", w_e, y_all.astype(jnp.float32))
-        return y.astype(x.dtype)
+        y = y.astype(x.dtype)
+        if return_stats:
+            return y, self._stats(topk_idx)
+        return y
 
-    def fwd_dist(self, x):
+    def fwd_dist(self, x, return_stats: bool = False):
         """AG-GroupGEMM + MoE-reduce-RS (x row-sharded [M/n, D] ->
         row-sharded [M/n, D])."""
         n = self.mesh.shape[self.axis]
@@ -162,7 +178,10 @@ class TP_MoE:
             return scatter_weighted(y_e, inv_slot, token, topk_w, M)
 
         y_partial = jax.vmap(_scatter)(y_parts).astype(x.dtype)  # [n, M, D]
-        return reduce_scatter(y_partial, mesh=self.mesh, axis=self.axis)
+        y = reduce_scatter(y_partial, mesh=self.mesh, axis=self.axis)
+        if return_stats:
+            return y, self._stats(topk_idx, inv_slot, cap)
+        return y
 
     def fwd_fused(self, x):
         """Fully fused path: ag_group_gemm (ring-AG of capacity chunks
@@ -250,7 +269,7 @@ class TP_MoE:
         return scatter_weighted(y_e, inv_slot, token, topk_w,
                                 M).astype(x.dtype)
 
-    def fwd_local(self, x):
+    def fwd_local(self, x, return_stats: bool = False):
         """Single-chip framework path: route + grouped-GEMM kernels with
         everything resident (the MoE analog of TP_MLP.fwd_flash)."""
         M, D = x.shape
@@ -260,8 +279,11 @@ class TP_MoE:
             x, topk_idx, self.num_experts, cap)
         y_parts = self._expert_mlp_sharded(x_e)       # [n, E, cap, D]
         y_sum = jnp.sum(y_parts.astype(jnp.float32), axis=0).astype(x.dtype)
-        return scatter_weighted(y_sum, inv_slot, token, topk_w,
-                                M).astype(x.dtype)
+        y = scatter_weighted(y_sum, inv_slot, token, topk_w,
+                             M).astype(x.dtype)
+        if return_stats:
+            return y, self._stats(topk_idx, inv_slot, cap)
+        return y
 
     def fwd_train(self, x):
         """Training path through framework kernels: custom-VJP
@@ -298,15 +320,22 @@ class TP_MoE:
                          topk_w).astype(x.dtype)
         return reduce_scatter_grad(self.mesh, self.axis)(y_partial)
 
-    def __call__(self, x, mode: str = "dist"):
+    def __call__(self, x, mode: str = "dist", **kw):
+        """kw (`return_stats=True`) reaches the serving-reachable paths
+        (xla/dist/local) — the slot-tick forwards ask for the routing
+        load the telemetry gauges surface; the fused/train paths take
+        no kwargs (not serving tick modes)."""
         if mode == "train":
+            assert not kw, f"mode='train' takes no extra kwargs: {kw}"
             return self.fwd_train(x)
         if mode == "fused":
+            assert not kw, f"mode='fused' takes no extra kwargs: {kw}"
             return self.fwd_fused(x)
         if mode == "fused_ar":
+            assert not kw, f"mode='fused_ar' takes no extra kwargs: {kw}"
             return self.fwd_fused_ar(x)
         if mode in ("dist",):
-            return self.fwd_dist(x)
+            return self.fwd_dist(x, **kw)
         if mode in ("flash", "ar", "gemm_ar"):
-            return self.fwd_local(x)
-        return self.fwd_xla(x)
+            return self.fwd_local(x, **kw)
+        return self.fwd_xla(x, **kw)

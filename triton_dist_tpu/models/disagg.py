@@ -252,8 +252,13 @@ class PrefillWorker:
         self.engine = engine
         self.page = page
         self.name = name             # trace track + gauge label
+        # for_ticks=False: the staging pool only runs the bucketed
+        # admit forward (whose row count is the EP-aligned pad bucket),
+        # never a decode tick — the MoE-family batch gate must not
+        # refuse a 1-slot staging pool on an EP mesh
         self.cache = engine.make_paged_slot_cache(1, page=page,
-                                                  num_pages=num_pages)
+                                                  num_pages=num_pages,
+                                                  for_ticks=False)
         Hkv = engine.model.config.num_kv_heads
         self.hkv = Hkv
         self.pool = RefcountedPages(self.cache.num_pages, Hkv)

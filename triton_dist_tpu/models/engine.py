@@ -63,6 +63,43 @@ class Engine:
         self.kv_dtype = kv_dtype
         if sampling not in ("greedy", "top_k", "top_p"):
             raise ValueError(f"unknown sampling mode {sampling!r}")
+        # MODEL/BACKEND capability gate (ISSUE 13): every unsupported
+        # combination refuses HERE, at construction, naming the missing
+        # capability — not as a shape/attribute error deep inside the
+        # first jitted forward.
+        known = ("xla", "flash", "dist", "ar", "gemm_ar", "ep",
+                 "ep_flash", "mega")
+        if backend not in known:
+            raise ValueError(f"unknown backend {backend!r}; this engine "
+                             f"serves {known}")
+        self.moe_family = bool(getattr(model.config, "is_moe", False))
+        if backend in ("ep", "ep_flash"):
+            if not self.moe_family:
+                raise ValueError(
+                    f"backend={backend!r} routes the FFN through the EP "
+                    "dispatch/combine kernels; "
+                    f"{type(model).__name__} has no routed experts "
+                    "(missing capability: expert-parallel FFN) — dense "
+                    "models serve on 'flash'/'dist'/'ar'/'gemm_ar'")
+            if getattr(model, "moe_impl", None) != "ep":
+                raise ValueError(
+                    f"backend={backend!r} needs an expert-SHARDED model "
+                    f"(moe_impl='ep'); this Qwen3MoE was built "
+                    f"moe_impl={model.moe_impl!r} — TP-MoE serves its "
+                    "grouped-GEMM dispatch on 'flash' (or 'dist' for "
+                    "the comm-kernel attention)")
+        # An expert-SHARDED model feeds row-sharded token batches to
+        # the EP FFN (the a2a dispatch on the ep backends, the
+        # all-gather oracle on the rest): every forward's row count
+        # must divide by the ep axis, so the prefill pad buckets align
+        # to lcm(8, ep) and max_seq (the bucket clamp) rounds up to it.
+        ep = getattr(model, "ep_size", 1)
+        self._ep_rows = 1
+        if ep > 1:
+            import math
+            self._ep_rows = math.lcm(8, ep)
+            self.max_seq = -(-self.max_seq // self._ep_rows) \
+                * self._ep_rows
         if sampling != "greedy" and backend == "mega":
             raise ValueError(
                 "backend='mega' serves GREEDY streams only (the fused "
@@ -105,6 +142,14 @@ class Engine:
         # bandwidth win survives multi-chip TP decode (reference analog:
         # quantized comm payloads, low_latency_all_to_all_v2.py:213).
         if backend == "mega":
+            if self.moe_family or not all(hasattr(l, "mlp")
+                                          for l in model.layers):
+                raise ValueError(
+                    "backend='mega' fuses dense (attention + MLP) "
+                    "decode layers only (missing capability: megakernel "
+                    "routed-expert FFN) — MoE models serve their "
+                    "grouped-GEMM tick on backend='flash' (TP-MoE) or "
+                    "'ep'/'ep_flash' (expert-sharded)")
             from triton_dist_tpu.kernels.quant import QuantW
             if model.layers and isinstance(model.layers[0].attn.w_qkv,
                                            QuantW):
@@ -127,20 +172,28 @@ class Engine:
                     "backend='mega' TP needs heads/kv-heads/ffn "
                     "divisible by the mesh size (single-chip decode "
                     "has no such constraint)")
-            if not all(hasattr(l, "mlp") for l in model.layers):
-                raise ValueError(
-                    "backend='mega' supports dense (attention + MLP) "
-                    "layers only; MoE models have no megakernel path")
             # the megakernel's flash loop walks the cache in
             # block_t-sized tiles; round the cache capacity up
             from triton_dist_tpu.mega import MegaDecodeLayer
             bt = MegaDecodeLayer.block_t
             self.max_seq = -(-max_seq // bt) * bt
         # the reference prefills with the torch fwd (engine.py:121); the
-        # analog here is the XLA-collective mode unless overridden
+        # analog here is the XLA-collective mode unless overridden.
+        # The ep backends prefill through THEMSELVES: chunked-prefill
+        # differentials need the admit forward and the mixed tick on
+        # one numerics path (the same reason "dist"/"flash" do).
         self.prefill_backend = prefill_backend or (
-            backend if backend in ("dist", "flash") else
-            "flash" if backend == "mega" else "xla")
+            backend if backend in ("dist", "flash", "ep", "ep_flash")
+            else "flash" if backend == "mega" else "xla")
+        # MoE-family serving telemetry (ISSUE 13): every slot-tick
+        # program additionally returns the tick's routing-load vector
+        # [expert_tokens[0..E-1], capacity_dropped]; the engine stashes
+        # the device array FIFO here and the scheduler's coalesced
+        # readback (DecodeSlots._fetch) pops exactly one per landed
+        # tick — no extra sync, and the overlap pipeline never blocks
+        # on a still-in-flight tick's stats.
+        import collections
+        self._moe_pending = collections.deque()
         # The model is a jit ARGUMENT (weights must not be captured as
         # program constants — that would bake GBs into the executable).
         # The jitted program set is SHARED across Engine instances with
@@ -249,8 +302,45 @@ class Engine:
     # continuous-batching slot decode (models/scheduler.py drives these)
     # ------------------------------------------------------------------
 
+    def _note_moe_load(self, out: tuple) -> tuple:
+        """Strip + stash the routing-load vector every MoE-family slot
+        program appends as its LAST output ([E+1] int32 device array:
+        per-expert routed entries + capacity drops, summed over layers
+        and scan steps). FIFO order matches tick dispatch order —
+        scheduler._fetch pops one per landed tick and folds it into
+        the expert_tokens/moe_capacity_drops/expert_load_imbalance
+        metrics. Dense engines pass through untouched."""
+        if not self.moe_family:
+            return out
+        self._moe_pending.append(out[-1])
+        return out[:-1]
+
+    def pop_moe_load(self):
+        """The oldest undrained routing-load device array (or None).
+        Callers must only pop a tick they are about to LAND (its
+        outputs computed) — a device_get on it is then a plain d2h
+        copy, never a pipeline stall."""
+        if self.moe_family and self._moe_pending:
+            return self._moe_pending.popleft()
+        return None
+
+    def _moe_batch_check(self, batch: int) -> None:
+        """EP slot serving feeds [batch(*window), D] token rows to the
+        row-sharded expert dispatch: refuse a scheduler batch the ep
+        axis cannot split, at cache construction instead of as a
+        shard_map divisibility error deep in the first tick."""
+        ep = getattr(self.model, "ep_size", 1)
+        if ep > 1 and batch % ep:
+            raise ValueError(
+                f"EP serving needs the slot batch ({batch}) divisible "
+                f"by the expert-parallel axis size ({ep}): each tick "
+                f"row-shards its token batch over the ep mesh axis "
+                f"{self.model.ep_axis!r} — pad the batch or shrink "
+                f"the ep axis")
+
     def make_slot_cache(self, batch: int):
         """Fresh cache whose batch rows are independent decode SLOTS."""
+        self._moe_batch_check(batch)
         return self.model.make_cache(batch, self.max_seq,
                                      dtype=self.kv_dtype)
 
@@ -277,6 +367,13 @@ class Engine:
             raise ValueError(
                 f"prompt length {n} exceeds slot capacity {self.max_seq}")
         self._c_prefills.inc()
+        if self._ep_rows > 1:
+            # EP models: the prefill's row count feeds the row-sharded
+            # expert dispatch — buckets align to lcm(8, ep). max_seq
+            # was rounded up to the same at __init__, so the clamp
+            # below stays divisible.
+            import math
+            pad_to = math.lcm(pad_to, self._ep_rows)
         # the pad bucket must never write past the cache capacity
         # (max_seq need not be a pad_to multiple)
         P = min(-(-n // pad_to) * pad_to, self.max_seq)
@@ -320,11 +417,13 @@ class Engine:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
-            toks, logits, cache, pos = self._slot_scan(
-                self.model, logits, cache, pos, active, gen_len=chunk)
+            toks, logits, cache, pos = self._note_moe_load(
+                self._slot_scan(self.model, logits, cache, pos, active,
+                                gen_len=chunk))
             return toks, logits, cache, pos, None
-        toks, logits, cache, pos, keys = self._slot_scan(
-            self.model, logits, cache, pos, active, keys, gen_len=chunk)
+        toks, logits, cache, pos, keys = self._note_moe_load(
+            self._slot_scan(self.model, logits, cache, pos, active,
+                            keys, gen_len=chunk))
         return toks, logits, cache, pos, keys
 
 
@@ -366,11 +465,13 @@ class Engine:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
-            n_emit, t0n, cache, pos = self._slot_verify(
-                self.model, cache, pos, active, tokens, q_lens)
+            n_emit, t0n, cache, pos = self._note_moe_load(
+                self._slot_verify(self.model, cache, pos, active,
+                                  tokens, q_lens))
             return n_emit, t0n, cache, pos, None
-        n_emit, t0n, cache, pos, keys = self._slot_verify(
-            self.model, cache, pos, active, tokens, q_lens, keys)
+        n_emit, t0n, cache, pos, keys = self._note_moe_load(
+            self._slot_verify(self.model, cache, pos, active, tokens,
+                              q_lens, keys))
         return n_emit, t0n, cache, pos, keys
 
     def paged_slot_verify_chunk(self, pcache, pos, active, tokens,
@@ -393,11 +494,13 @@ class Engine:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
-            n_emit, t0n, pcache, pos = self._paged_slot_verify(
-                self.model, pcache, pos, active, tokens, q_lens)
+            n_emit, t0n, pcache, pos = self._note_moe_load(
+                self._paged_slot_verify(self.model, pcache, pos, active,
+                                        tokens, q_lens))
             return n_emit, t0n, pcache, pos, None
-        n_emit, t0n, pcache, pos, keys = self._paged_slot_verify(
-            self.model, pcache, pos, active, tokens, q_lens, keys)
+        n_emit, t0n, pcache, pos, keys = self._note_moe_load(
+            self._paged_slot_verify(self.model, pcache, pos, active,
+                                    tokens, q_lens, keys))
         return n_emit, t0n, pcache, pos, keys
 
     # ------------------------------------------------------------------
@@ -443,8 +546,9 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
-        return self._slot_mixed(self.model, logits, cache, pos, active,
-                                prefilling, tokens, q_lens, keys)
+        return self._note_moe_load(
+            self._slot_mixed(self.model, logits, cache, pos, active,
+                             prefilling, tokens, q_lens, keys))
 
     def paged_slot_mixed_chunk(self, logits, pcache, pos, active,
                                prefilling, tokens, q_lens, *, keys=None):
@@ -460,9 +564,10 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
-        return self._paged_slot_mixed(self.model, logits, pcache, pos,
-                                      active, prefilling, tokens, q_lens,
-                                      keys)
+        return self._note_moe_load(
+            self._paged_slot_mixed(self.model, logits, pcache, pos,
+                                   active, prefilling, tokens, q_lens,
+                                   keys))
 
     def slot_mixed_verify_chunk(self, cache, pos, active, prefilling,
                                 tokens, q_lens, *, keys=None):
@@ -486,8 +591,9 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
-        return self._slot_mixed_verify(self.model, cache, pos, active,
-                                       prefilling, tokens, q_lens, keys)
+        return self._note_moe_load(
+            self._slot_mixed_verify(self.model, cache, pos, active,
+                                    prefilling, tokens, q_lens, keys))
 
     def paged_slot_mixed_verify_chunk(self, pcache, pos, active,
                                       prefilling, tokens, q_lens, *,
@@ -501,9 +607,10 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
-        return self._paged_slot_mixed_verify(self.model, pcache, pos,
-                                             active, prefilling, tokens,
-                                             q_lens, keys)
+        return self._note_moe_load(
+            self._paged_slot_mixed_verify(self.model, pcache, pos,
+                                          active, prefilling, tokens,
+                                          q_lens, keys))
 
     def install_slot_paged(self, pcache, slot: int, rows, cow_src,
                            cow_dst, cow_rows: int):
@@ -534,7 +641,8 @@ class Engine:
     # ------------------------------------------------------------------
 
     def make_paged_slot_cache(self, batch: int, *, page: int = 16,
-                              num_pages: Optional[int] = None):
+                              num_pages: Optional[int] = None,
+                              for_ticks: bool = True):
         """Paged slot cache: per-layer physical pools behind ONE shared
         page table (kv_cache.PagedSlotCache). num_pages defaults to the
         no-sharing worst case (every slot full) + the reserved trash
@@ -565,7 +673,14 @@ class Engine:
         if not hasattr(self.model, "forward_tokens_slots_paged"):
             raise ValueError(
                 f"{type(self.model).__name__} has no paged slot decode "
-                "path (dense models only)")
+                "path (DenseLLM and Qwen3MoE carry the serving "
+                "surface)")
+        if for_ticks:
+            # a pool that will DRIVE decode/verify/mixed ticks feeds
+            # its batch rows to the row-sharded EP dispatch; staging
+            # pools (disagg prefill workers, for_ticks=False) only run
+            # bucketed admit forwards and skip the batch gate
+            self._moe_batch_check(batch)
         cfg = self.model.config
         tp = self.model.mesh.shape[self.model.axis]
         if cfg.num_kv_heads % tp:
@@ -606,6 +721,10 @@ class Engine:
         suffix bucket; kv_start/slot/cow are traced data.
         """
         ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        if self._ep_rows > 1:
+            # suffix buckets feed the row-sharded expert dispatch too
+            import math
+            pad_to = math.lcm(pad_to, self._ep_rows)
         n = int(ids.shape[0])
         m = int(kv_start)
         if not 0 <= m < n:
@@ -655,11 +774,13 @@ class Engine:
             return toks, logits, pcache, pos, None
         if self.sampling == "greedy":
             assert keys is None
-            toks, logits, pcache, pos = self._paged_slot_scan(
-                self.model, logits, pcache, pos, active, gen_len=chunk)
+            toks, logits, pcache, pos = self._note_moe_load(
+                self._paged_slot_scan(self.model, logits, pcache, pos,
+                                      active, gen_len=chunk))
             return toks, logits, pcache, pos, None
-        toks, logits, pcache, pos, keys = self._paged_slot_scan(
-            self.model, logits, pcache, pos, active, keys, gen_len=chunk)
+        toks, logits, pcache, pos, keys = self._note_moe_load(
+            self._paged_slot_scan(self.model, logits, pcache, pos,
+                                  active, keys, gen_len=chunk))
         return toks, logits, pcache, pos, keys
 
     def retire_slot_paged(self, pcache, slot: int):
@@ -803,6 +924,16 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
       prefill mixed prefill+decode ticks;
     - gather_pages / restore_pages: the host-KV-tier d2h/h2d pair.
 
+    MODEL FAMILIES (ISSUE 13): the same jit wrappers serve the dense
+    AND the `moe` model family — the model rides in as a traced
+    argument and its static config picks the trace (_is_moe), so a
+    Qwen3MoE compiles slot programs that run per-slot top-k routing +
+    grouped-GEMM expert dispatch inside every tick and append the
+    routing-load vector as one extra output (Engine._note_moe_load
+    strips and stashes it), while dense models' traces stay
+    byte-identical. ep/ep_flash backends (expert-sharded FFN over the
+    a2a kernels) flow through the same program set as a mode string.
+
     backend='mega' (the fused paged decode tick — ISSUE 12): the
     per-op family above is built at the FALLBACK backend ("flash" —
     the mega engine's prefill/mixed/admission programs are per-op by
@@ -924,6 +1055,17 @@ def _write_slot_fn(cache, scratch, slot):
     return out
 
 
+def _is_moe(model) -> bool:
+    """Static (trace-time) family switch of the slot programs below:
+    a MoE-family model's slot forwards additionally return the tick's
+    routing-load vector (the `moe` model family of _jit_programs —
+    same jit wrappers, the model's static config picks the trace).
+    config is static pytree metadata, so this never retraces a given
+    model inconsistently."""
+    return bool(getattr(model.config, "is_moe", False)) \
+        and hasattr(model, "forward_tokens_slots")
+
+
 def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
                          gen_len: int):
     """Slot-masked greedy decode chunk (continuous batching): same
@@ -931,22 +1073,40 @@ def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
     request at its own position. Inactive rows still flow through the
     program (masking keeps it ONE executable for every occupancy mix);
     their writes land in their own dead cache rows and their tokens are
-    discarded by the scheduler."""
+    discarded by the scheduler. MoE family: the routing-load vector
+    rides the scan carry and returns as one extra output (the dense
+    trace is untouched)."""
     act = active.astype(jnp.int32)
+    moe = _is_moe(model)
 
     def step(carry, _):
-        logits, cache, pos = carry
+        if moe:
+            logits, cache, pos, load = carry
+        else:
+            logits, cache, pos = carry
         tok = jnp.argmax(logits, axis=-1)           # greedy [B]
         tok = jnp.where(active, tok, 0)
-        logits, cache = model.forward_tokens_slots(tok[:, None], cache,
-                                                   pos, mode=backend)
+        if moe:
+            logits, cache, st = model.forward_tokens_slots(
+                tok[:, None], cache, pos, mode=backend,
+                return_moe_stats=True)
+        else:
+            logits, cache = model.forward_tokens_slots(
+                tok[:, None], cache, pos, mode=backend)
         # clamp: a slot that finished mid-chunk keeps stepping until the
         # chunk boundary; its surplus writes stay inside its own row
         pos = jnp.minimum(pos + act, cache.k[0].shape[2] - 1)
+        if moe:
+            return (logits, cache, pos, load + st), tok
         return (logits, cache, pos), tok
 
-    (logits, cache, pos), toks = jax.lax.scan(
-        step, (logits0, cache, pos), None, length=gen_len)
+    init = ((logits0, cache, pos, model._zero_load()) if moe
+            else (logits0, cache, pos))
+    out, toks = jax.lax.scan(step, init, None, length=gen_len)
+    if moe:
+        logits, cache, pos, load = out
+        return toks.T, logits, cache, pos, load      # [B, gen_len]
+    logits, cache, pos = out
     return toks.T, logits, cache, pos                # [B, gen_len]
 
 
@@ -970,20 +1130,37 @@ def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
                                 temperature=temp)
         return sample_top_p(k, logits, p=params["p"], temperature=temp)
 
+    moe = _is_moe(model)
+
     def step(carry, _):
-        logits, cache, pos, keys = carry
+        if moe:
+            logits, cache, pos, keys, load = carry
+        else:
+            logits, cache, pos, keys = carry
         split = jax.vmap(functools.partial(jax.random.split, num=2))
         ks = split(keys)
         keys, subs = ks[:, 0], ks[:, 1]
         tok = jax.vmap(sample_one)(subs, logits)    # [B]
         tok = jnp.where(active, tok, 0)
-        logits, cache = model.forward_tokens_slots(tok[:, None], cache,
-                                                   pos, mode=backend)
+        if moe:
+            logits, cache, st = model.forward_tokens_slots(
+                tok[:, None], cache, pos, mode=backend,
+                return_moe_stats=True)
+        else:
+            logits, cache = model.forward_tokens_slots(
+                tok[:, None], cache, pos, mode=backend)
         pos = jnp.minimum(pos + act, cache.k[0].shape[2] - 1)
+        if moe:
+            return (logits, cache, pos, keys, load + st), tok
         return (logits, cache, pos, keys), tok
 
-    (logits, cache, pos, keys), toks = jax.lax.scan(
-        step, (logits0, cache, pos, keys), None, length=gen_len)
+    init = ((logits0, cache, pos, keys, model._zero_load()) if moe
+            else (logits0, cache, pos, keys))
+    out, toks = jax.lax.scan(step, init, None, length=gen_len)
+    if moe:
+        logits, cache, pos, keys, load = out
+        return toks.T, logits, cache, pos, keys, load
+    logits, cache, pos, keys = out
     return toks.T, logits, cache, pos, keys          # [B, gen_len]
 
 
@@ -1028,16 +1205,47 @@ def _verify_accept(sampling, params, logits_all, tokens, q_lens, active,
     return n_emit, t0n, pos, keys
 
 
+def _verify_forward(backend, paged, model, cache, pos, tokens, q_lens):
+    """The verify-window forward shared by the verify AND mixed
+    programs (contiguous or paged), MoE-family aware: returns
+    (per-position logits [B, S, V], cache, capacity, load) where load
+    is the routing-load vector for MoE models and None for dense —
+    the dense traces are byte-identical to before the MoE family
+    existed."""
+    moe = _is_moe(model)
+    if paged:
+        if moe:
+            logits_all, cache, load = \
+                model.forward_tokens_slots_paged_verify(
+                    tokens, cache, pos, q_lens, mode=backend,
+                    return_moe_stats=True)
+        else:
+            logits_all, cache = model.forward_tokens_slots_paged_verify(
+                tokens, cache, pos, q_lens, mode=backend)
+            load = None
+        return logits_all, cache, cache.capacity, load
+    if moe:
+        logits_all, cache, load = model.forward_tokens_slots_verify(
+            tokens, cache, pos, q_lens, mode=backend,
+            return_moe_stats=True)
+    else:
+        logits_all, cache = model.forward_tokens_slots_verify(
+            tokens, cache, pos, q_lens, mode=backend)
+        load = None
+    return logits_all, cache, cache.k[0].shape[2], load
+
+
 def _slot_verify_fn(backend, model, cache, pos, active, tokens, q_lens):
     """Greedy speculative verify (contiguous cache): one forward over
     every slot's padded draft window + the shared on-device acceptance
     epilogue (_verify_accept). Inactive slots flow through masked
     (q_lens handed in as 1, writes land in their own dead rows)."""
-    logits_all, cache = model.forward_tokens_slots_verify(
-        tokens, cache, pos, q_lens, mode=backend)
+    logits_all, cache, cap, load = _verify_forward(
+        backend, False, model, cache, pos, tokens, q_lens)
     n_emit, t0n, pos, _ = _verify_accept(
-        None, None, logits_all, tokens, q_lens, active, pos,
-        cache.k[0].shape[2])
+        None, None, logits_all, tokens, q_lens, active, pos, cap)
+    if load is not None:
+        return n_emit, t0n, cache, pos, load
     return n_emit, t0n, cache, pos
 
 
@@ -1045,11 +1253,13 @@ def _sampled_slot_verify_fn(backend, sampling, params, model, cache, pos,
                             active, tokens, q_lens, keys):
     """Sampled _slot_verify_fn: leftover rejection sampling through the
     per-slot PRNG chains (see _verify_accept)."""
-    logits_all, cache = model.forward_tokens_slots_verify(
-        tokens, cache, pos, q_lens, mode=backend)
+    logits_all, cache, cap, load = _verify_forward(
+        backend, False, model, cache, pos, tokens, q_lens)
     n_emit, t0n, pos, keys = _verify_accept(
         sampling, params, logits_all, tokens, q_lens, active, pos,
-        cache.k[0].shape[2], keys)
+        cap, keys)
+    if load is not None:
+        return n_emit, t0n, cache, pos, keys, load
     return n_emit, t0n, cache, pos, keys
 
 
@@ -1057,11 +1267,12 @@ def _paged_slot_verify_fn(backend, model, pcache, pos, active, tokens,
                           q_lens):
     """_slot_verify_fn over the PAGED pool (the prefix-cache serving
     path): identical acceptance, KV resolved through the page table."""
-    logits_all, pcache = model.forward_tokens_slots_paged_verify(
-        tokens, pcache, pos, q_lens, mode=backend)
+    logits_all, pcache, cap, load = _verify_forward(
+        backend, True, model, pcache, pos, tokens, q_lens)
     n_emit, t0n, pos, _ = _verify_accept(
-        None, None, logits_all, tokens, q_lens, active, pos,
-        pcache.capacity)
+        None, None, logits_all, tokens, q_lens, active, pos, cap)
+    if load is not None:
+        return n_emit, t0n, pcache, pos, load
     return n_emit, t0n, pcache, pos
 
 
@@ -1069,26 +1280,14 @@ def _sampled_paged_slot_verify_fn(backend, sampling, params, model,
                                   pcache, pos, active, tokens, q_lens,
                                   keys):
     """Sampled _paged_slot_verify_fn (see _verify_accept)."""
-    logits_all, pcache = model.forward_tokens_slots_paged_verify(
-        tokens, pcache, pos, q_lens, mode=backend)
+    logits_all, pcache, cap, load = _verify_forward(
+        backend, True, model, pcache, pos, tokens, q_lens)
     n_emit, t0n, pos, keys = _verify_accept(
         sampling, params, logits_all, tokens, q_lens, active, pos,
-        pcache.capacity, keys)
+        cap, keys)
+    if load is not None:
+        return n_emit, t0n, pcache, pos, keys, load
     return n_emit, t0n, pcache, pos, keys
-
-
-def _mixed_forward(backend, paged, model, cache, pos, tokens, q_lens):
-    """Shared forward of the mixed-tick programs: the verify-shaped
-    per-slot-window pass (write window KV, attend kv_len prior tokens +
-    causal-within-window), returning (per-position logits [B, S, V],
-    cache, capacity)."""
-    if paged:
-        logits_all, cache = model.forward_tokens_slots_paged_verify(
-            tokens, cache, pos, q_lens, mode=backend)
-        return logits_all, cache, cache.capacity
-    logits_all, cache = model.forward_tokens_slots_verify(
-        tokens, cache, pos, q_lens, mode=backend)
-    return logits_all, cache, cache.k[0].shape[2]
 
 
 def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
@@ -1127,12 +1326,14 @@ def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
         tok = jax.vmap(sample_one)(subs, logits0).astype(jnp.int32)
     tok = jnp.where(active, tok, 0)
     toks = tokens.at[:, 0].set(jnp.where(active, tok, tokens[:, 0]))
-    logits_all, cache, cap = _mixed_forward(backend, paged, model, cache,
-                                            pos, toks, q_lens)
+    logits_all, cache, cap, load = _verify_forward(
+        backend, paged, model, cache, pos, toks, q_lens)
     sel = jnp.maximum(q_lens - 1, 0)
     sel_logits = logits_all[jnp.arange(B), sel]            # [B, V]
     adv = jnp.where(prefilling, q_lens, active.astype(jnp.int32))
     pos = jnp.minimum(pos + adv, cap - 1)
+    if load is not None:
+        return tok, sel_logits, cache, pos, keys, load
     return tok, sel_logits, cache, pos, keys
 
 
@@ -1146,14 +1347,16 @@ def _mixed_verify_fn(backend, sampling, params, paged, model, cache, pos,
     per-row last-valid-position logits (the arming logits when a final
     chunk lands)."""
     B, S = tokens.shape
-    logits_all, cache, cap = _mixed_forward(backend, paged, model, cache,
-                                            pos, tokens, q_lens)
+    logits_all, cache, cap, load = _verify_forward(
+        backend, paged, model, cache, pos, tokens, q_lens)
     n_emit, t0n, pos, keys = _verify_accept(
         sampling, params, logits_all, tokens, q_lens, active, pos, cap,
         keys)
     pos = jnp.minimum(pos + jnp.where(prefilling, q_lens, 0), cap - 1)
     sel = jnp.maximum(q_lens - 1, 0)
     sel_logits = logits_all[jnp.arange(B), sel]            # [B, V]
+    if load is not None:
+        return n_emit, t0n, sel_logits, cache, pos, keys, load
     return n_emit, t0n, sel_logits, cache, pos, keys
 
 
@@ -1411,18 +1614,34 @@ def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,
     resolved through the page table."""
     act = active.astype(jnp.int32)
     cap = pcache.capacity
+    moe = _is_moe(model)
 
     def step(carry, _):
-        logits, pc, pos = carry
+        if moe:
+            logits, pc, pos, load = carry
+        else:
+            logits, pc, pos = carry
         tok = jnp.argmax(logits, axis=-1)
         tok = jnp.where(active, tok, 0)
-        logits, pc = model.forward_tokens_slots_paged(tok[:, None], pc,
-                                                      pos, mode=backend)
+        if moe:
+            logits, pc, st = model.forward_tokens_slots_paged(
+                tok[:, None], pc, pos, mode=backend,
+                return_moe_stats=True)
+        else:
+            logits, pc = model.forward_tokens_slots_paged(
+                tok[:, None], pc, pos, mode=backend)
         pos = jnp.minimum(pos + act, cap - 1)
+        if moe:
+            return (logits, pc, pos, load + st), tok
         return (logits, pc, pos), tok
 
-    (logits, pcache, pos), toks = jax.lax.scan(
-        step, (logits0, pcache, pos), None, length=gen_len)
+    init = ((logits0, pcache, pos, model._zero_load()) if moe
+            else (logits0, pcache, pos))
+    out, toks = jax.lax.scan(step, init, None, length=gen_len)
+    if moe:
+        logits, pcache, pos, load = out
+        return toks.T, logits, pcache, pos, load      # [B, gen_len]
+    logits, pcache, pos = out
     return toks.T, logits, pcache, pos                # [B, gen_len]
 
 
@@ -1447,20 +1666,37 @@ def _sampled_paged_slot_scan_fn(backend, sampling, params, model,
                                 temperature=temp)
         return sample_top_p(k, logits, p=params["p"], temperature=temp)
 
+    moe = _is_moe(model)
+
     def step(carry, _):
-        logits, pc, pos, keys = carry
+        if moe:
+            logits, pc, pos, keys, load = carry
+        else:
+            logits, pc, pos, keys = carry
         split = jax.vmap(functools.partial(jax.random.split, num=2))
         ks = split(keys)
         keys, subs = ks[:, 0], ks[:, 1]
         tok = jax.vmap(sample_one)(subs, logits)
         tok = jnp.where(active, tok, 0)
-        logits, pc = model.forward_tokens_slots_paged(tok[:, None], pc,
-                                                      pos, mode=backend)
+        if moe:
+            logits, pc, st = model.forward_tokens_slots_paged(
+                tok[:, None], pc, pos, mode=backend,
+                return_moe_stats=True)
+        else:
+            logits, pc = model.forward_tokens_slots_paged(
+                tok[:, None], pc, pos, mode=backend)
         pos = jnp.minimum(pos + act, cap - 1)
+        if moe:
+            return (logits, pc, pos, keys, load + st), tok
         return (logits, pc, pos, keys), tok
 
-    (logits, pcache, pos, keys), toks = jax.lax.scan(
-        step, (logits0, pcache, pos, keys), None, length=gen_len)
+    init = ((logits0, pcache, pos, keys, model._zero_load()) if moe
+            else (logits0, pcache, pos, keys))
+    out, toks = jax.lax.scan(step, init, None, length=gen_len)
+    if moe:
+        logits, pcache, pos, keys, load = out
+        return toks.T, logits, pcache, pos, keys, load
+    logits, pcache, pos, keys = out
     return toks.T, logits, pcache, pos, keys          # [B, gen_len]
 
 

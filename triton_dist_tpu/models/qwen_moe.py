@@ -8,12 +8,41 @@ routed over ICI — the reference's EP a2a path), chosen at construction
 (`moe_impl`), since the two shard the same weights differently.
 
 Forward modes:
-  "xla"   — oracle (dense all-experts MoE + psum attention).
-  "flash" — single-chip framework kernels (flash-decode + grouped GEMM).
-  "dist"  — TP overlap kernels: AG-GEMM/GEMM-RS attention + AG-GroupGEMM
-            + MoE-reduce-RS FFN (moe_impl="tp").
-  "ep"    — AG-GEMM/GEMM-RS attention + EP dispatch/combine FFN
-            (moe_impl="ep"); activations stay row-sharded end to end.
+  "xla"      — oracle (dense all-experts MoE + psum attention).
+  "flash"    — single-chip framework kernels (flash-decode + grouped
+               GEMM expert dispatch).
+  "dist"     — TP overlap kernels: AG-GEMM/GEMM-RS attention +
+               AG-GroupGEMM + MoE-reduce-RS FFN (moe_impl="tp").
+  "ep"       — AG-GEMM/GEMM-RS attention + EP dispatch/combine FFN
+               (moe_impl="ep"); activations row-sharded end to end.
+  "ep_flash" — framework attention kernels + EP dispatch/combine FFN
+               (moe_impl="ep"): the EP SERVING mode on meshes whose
+               attention rides "flash" (single chip, or the EP+TP
+               hybrid below) — experts stay sharded and tokens still
+               cross the a2a wire, without the comm-kernel attention.
+
+SERVING (ISSUE 13 — the MoE paged serving subsystem): the model now
+carries the FULL slot surface the continuous-batching scheduler
+requires — `forward_tokens_slots` (+`_verify`),
+`forward_tokens_slots_paged` (+`_verify`) — mirroring DenseLLM exactly:
+attention layers are TP_Attn, so the paged/contiguous slot attends,
+per-slot `kv_lens`+`q_lens` verify masks and the KV-head-group pool
+split (PR 9) are REUSED unchanged; only the FFN differs — per-slot
+top-k routing runs INSIDE the tick and the expert MLPs dispatch
+through the grouped-GEMM kernel (kernels/group_gemm.py via
+layers/tp_moe.py fwd_local, or the EP a2a path via layers/ep_moe.py).
+`return_moe_stats=True` additionally returns the tick's routing-load
+vector [expert_tokens[0..E-1], capacity_dropped] (int32 [E+1]) that
+engine/scheduler surface as `expert_tokens{expert=...}` gauges,
+`moe_capacity_drops` and `expert_load_imbalance` — the loud half of
+dropless-or-loud, observable.
+
+EP+TP HYBRID MESH: `moe_axis` names the mesh axis the experts shard
+over (default: the attention `axis`). On a 2-D mesh like
+make_mesh((2, 4), ("expert", "tp")), attention KV head-groups split on
+"tp" exactly as PR 9 laid them out (the paged pool's G axis) while
+expert panels and the a2a dispatch ride "expert" — one scheduler
+drives the whole hybrid mesh through ONE sharded program per tick.
 """
 
 from __future__ import annotations
@@ -57,6 +86,25 @@ class Qwen3MoE:
     axis: str = dataclasses.field(metadata=dict(static=True))
     moe_impl: str = dataclasses.field(default="tp",
                                       metadata=dict(static=True))
+    # expert-parallel mesh axis (EP+TP hybrid serving): experts shard
+    # over THIS axis while attention KV head-groups stay on `axis`.
+    # None = same axis as attention (the single-axis meshes every
+    # pre-hybrid caller builds).
+    moe_axis: str = dataclasses.field(default=None,
+                                      metadata=dict(static=True))
+
+    @property
+    def ep_axis(self) -> str:
+        """The mesh axis expert panels shard over."""
+        return self.moe_axis or self.axis
+
+    @property
+    def ep_size(self) -> int:
+        """Expert-parallel degree: rows fed to an EP FFN must divide by
+        this (engine.make_*_cache validates the scheduler batch)."""
+        if self.moe_impl != "ep":
+            return 1
+        return self.mesh.shape[self.ep_axis]
 
     # ------------------------------------------------------------------
     # construction
@@ -64,7 +112,9 @@ class Qwen3MoE:
 
     @staticmethod
     def random_init(cfg: ModelConfig, mesh: Mesh, axis: str = "tp",
-                    seed: int = 0, moe_impl: str = "tp") -> "Qwen3MoE":
+                    seed: int = 0, moe_impl: str = "tp",
+                    moe_axis: str = None,
+                    capacity_factor=2.0) -> "Qwen3MoE":
         key = jax.random.key(seed)
         D, I = cfg.hidden_size, cfg.moe_intermediate_size
         E, k = cfg.num_experts, cfg.num_experts_per_tok
@@ -88,7 +138,8 @@ class Qwen3MoE:
                 k_norm=np.ones(hd, np.float32))
             moe = moe_cls.init(
                 w(D, E, scale=0.02), w(E, D, I), w(E, D, I), w(E, I, D),
-                mesh=mesh, axis=axis, top_k=k)
+                mesh=mesh, axis=moe_axis or axis, top_k=k,
+                capacity_factor=capacity_factor)
             layers.append(MoELayer(
                 attn=attn, moe=moe,
                 ln_attn=jnp.ones((D,), dt), ln_mlp=jnp.ones((D,), dt)))
@@ -101,11 +152,12 @@ class Qwen3MoE:
             lm_head=(embed.T if cfg.tie_word_embeddings
                      else w(D, cfg.vocab_size, scale=0.02)),
             cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis,
-            moe_impl=moe_impl)
+            moe_impl=moe_impl, moe_axis=moe_axis)
 
     @staticmethod
     def from_hf(path: str, mesh: Mesh, axis: str = "tp",
-                moe_impl: str = "tp") -> "Qwen3MoE":
+                moe_impl: str = "tp", moe_axis: str = None,
+                capacity_factor=2.0) -> "Qwen3MoE":
         """Load HF Qwen3-MoE safetensors, stacking per-expert projections
         (reference: models/qwen_moe.py HF loading + TP shard at load)."""
         from safetensors import safe_open
@@ -147,7 +199,9 @@ class Qwen3MoE:
                 for e in range(cfg.num_experts)])
             moe = moe_cls.init(
                 t(p + "mlp.gate.weight").T, gate, up, down,
-                mesh=mesh, axis=axis, top_k=cfg.num_experts_per_tok)
+                mesh=mesh, axis=moe_axis or axis,
+                top_k=cfg.num_experts_per_tok,
+                capacity_factor=capacity_factor)
             layers.append(MoELayer(
                 attn=attn, moe=moe,
                 ln_attn=t(p + "input_layernorm.weight"),
@@ -161,19 +215,42 @@ class Qwen3MoE:
             lm_head=(embed.T if cfg.tie_word_embeddings
                      else t("lm_head.weight").T),
             cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis,
-            moe_impl=moe_impl)
+            moe_impl=moe_impl, moe_axis=moe_axis)
 
     # ------------------------------------------------------------------
     # forward (mirrors DenseLLM.forward_tokens)
     # ------------------------------------------------------------------
 
     def _moe_modes(self, mode: str):
-        attn_mode = "dist" if mode == "ep" else mode
+        """(attention mode, FFN mode) for one model-level mode string.
+        "ep" pairs the comm-kernel attention (AG-GEMM/GEMM-RS) with the
+        EP dispatch; "ep_flash" pairs the framework attention kernels
+        with the SAME EP dispatch — the serving spelling for meshes
+        whose attention path is "flash" (single chip / hybrid EP+TP).
+        Every other mode runs the EP model's FFN through the dense
+        all-experts oracle (the differential-test arm)."""
+        attn_mode = ("dist" if mode == "ep" else
+                     "flash" if mode == "ep_flash" else mode)
         if self.moe_impl == "ep":
-            moe_mode = "ep" if mode == "ep" else "xla"
+            moe_mode = "ep" if mode in ("ep", "ep_flash") else "xla"
         else:
-            moe_mode = "dist" if mode == "ep" else mode
+            moe_mode = "dist" if mode in ("ep", "ep_flash") else mode
         return attn_mode, moe_mode
+
+    def _zero_load(self):
+        """Fresh routing-load accumulator: [expert_tokens[0..E-1],
+        capacity_dropped] — the serving tick's telemetry payload."""
+        return jnp.zeros((self.config.num_experts + 1,), jnp.int32)
+
+    def _moe_ffn(self, layer, h, moe_mode, load):
+        """One routed FFN call; accumulates the routing-load vector
+        when the caller asked for stats (load is None otherwise)."""
+        if load is None:
+            return layer.moe(h, moe_mode), None
+        y, st = layer.moe(h, moe_mode, return_stats=True)
+        upd = jnp.concatenate([st["expert_tokens"],
+                               st["dropped"].reshape(1)])
+        return y, load + upd
 
     def forward_tokens(self, ids, cache: KVCache, mode: str = "dist",
                        last_pos=None):
@@ -202,13 +279,17 @@ class Qwen3MoE:
         return logits, cache
 
     def forward_tokens_slots(self, ids, cache: KVCache, pos,
-                             mode: str = "dist"):
+                             mode: str = "dist",
+                             return_moe_stats: bool = False):
         """Slot-masked decode forward (continuous batching; mirrors
         DenseLLM.forward_tokens_slots): ids [B, 1], pos [B] int32 —
-        row b decodes at its own position. cache.offset is untouched."""
+        row b decodes at its own position. cache.offset is untouched.
+        return_moe_stats=True appends the tick's routing-load vector
+        (engine/scheduler telemetry — see the module docstring)."""
         B, S = ids.shape
         assert S == 1, "slot decode feeds one token per slot"
         attn_mode, moe_mode = self._moe_modes(mode)
+        load = self._zero_load() if return_moe_stats else None
         x = self.embed[ids].reshape(B, self.config.hidden_size)
         for li, layer in enumerate(self.layers):
             kv = cache.layer(li)
@@ -218,13 +299,119 @@ class Qwen3MoE:
             cache = cache.set_layer(li, kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
-            x = x + layer.moe(h, moe_mode).astype(x.dtype)
+            y, load = self._moe_ffn(layer, h, moe_mode, load)
+            x = x + y.astype(x.dtype)
         x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
         if mode in ("dist", "ep"):
             x = self._gather_rows(x)
         logits = jnp.dot(x, self.lm_head,
                          preferred_element_type=jnp.float32)
+        if return_moe_stats:
+            return logits, cache, load
         return logits, cache
+
+    def forward_tokens_slots_verify(self, ids, cache: KVCache, pos,
+                                    q_lens, mode: str = "dist",
+                                    return_moe_stats: bool = False):
+        """Speculative-verify forward over the CONTIGUOUS slot cache
+        (mirrors DenseLLM.forward_tokens_slots_verify): each batch row
+        scores a variable-length draft window in ONE pass via the
+        per-slot `q_lens`+`kv_lens` masks — the PR-3 machinery, reused
+        byte-for-byte since attention layers are TP_Attn. The routed
+        FFN sees the window rows exactly like decode rows (padded rows
+        are computed-and-discarded; their routed entries count toward
+        the load gauges — compute load, not emitted tokens)."""
+        B, S = ids.shape
+        attn_mode, moe_mode = self._moe_modes(mode)
+        load = self._zero_load() if return_moe_stats else None
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            kv = cache.layer(li)
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots_verify(
+                h, self.cos, self.sin, B, kv, pos, q_lens, attn_mode)
+            cache = cache.set_layer(li, kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            y, load = self._moe_ffn(layer, h, moe_mode, load)
+            x = x + y.astype(x.dtype)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode in ("dist", "ep"):
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        if return_moe_stats:
+            return logits.reshape(B, S, -1), cache, load
+        return logits.reshape(B, S, -1), cache
+
+    def forward_tokens_slots_paged(self, ids, pcache, pos,
+                                   mode: str = "flash",
+                                   return_moe_stats: bool = False):
+        """Slot-masked decode forward over the PAGED KV pool (mirrors
+        DenseLLM.forward_tokens_slots_paged — the shared-prefix serving
+        tick): identical attention math through the page table (slot b
+        attends whatever pages its table row maps, including pages
+        shared read-only with other slots' cached prefixes), with
+        PER-SLOT TOP-K ROUTING inside the tick and grouped-GEMM expert
+        dispatch replacing the per-expert dense loop. ids [B, 1];
+        pos [B] int32; pcache: PagedSlotCache."""
+        B, S = ids.shape
+        assert S == 1, "slot decode feeds one token per slot"
+        attn_mode, moe_mode = self._moe_modes(mode)
+        load = self._zero_load() if return_moe_stats else None
+        x = self.embed[ids].reshape(B, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots_paged(
+                h, self.cos, self.sin, B, pcache.layer(li),
+                pcache.table, pos, attn_mode)
+            pcache = pcache.set_layer(li, *kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            y, load = self._moe_ffn(layer, h, moe_mode, load)
+            x = x + y.astype(x.dtype)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode in ("dist", "ep"):
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        if return_moe_stats:
+            return logits, pcache, load
+        return logits, pcache
+
+    def forward_tokens_slots_paged_verify(self, ids, pcache, pos,
+                                          q_lens, mode: str = "flash",
+                                          return_moe_stats: bool = False):
+        """forward_tokens_slots_verify over the PAGED pool (mirrors the
+        dense twin): the draft window's K/V resolves through the page
+        table (padded rows scatter out of bounds and are dropped) and
+        attention walks the pool with per-slot kv_lens AND q_lens; the
+        routed FFN dispatches the whole mixed window through the
+        grouped GEMMs. This is ALSO the chunked-prefill mixed tick's
+        forward (engine._mixed_forward) — prefill chunk rows route
+        through the experts alongside live decode rows."""
+        B, S = ids.shape
+        attn_mode, moe_mode = self._moe_modes(mode)
+        load = self._zero_load() if return_moe_stats else None
+        x = self.embed[ids].reshape(B * S, self.config.hidden_size)
+        for li, layer in enumerate(self.layers):
+            h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
+            a, kv = layer.attn.fwd_cached_slots_paged_verify(
+                h, self.cos, self.sin, B, pcache.layer(li),
+                pcache.table, pos, q_lens, attn_mode)
+            pcache = pcache.set_layer(li, *kv)
+            x = x + a
+            h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
+            y, load = self._moe_ffn(layer, h, moe_mode, load)
+            x = x + y.astype(x.dtype)
+        x = rms_norm(x, self.final_norm, self.config.rms_norm_eps)
+        if mode in ("dist", "ep"):
+            x = self._gather_rows(x)
+        logits = jnp.dot(x, self.lm_head,
+                         preferred_element_type=jnp.float32)
+        if return_moe_stats:
+            return logits.reshape(B, S, -1), pcache, load
+        return logits.reshape(B, S, -1), pcache
 
     def forward_train(self, ids, mode: str = "train"):
         """Training forward (no KV cache), mirroring

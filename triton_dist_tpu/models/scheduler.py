@@ -398,6 +398,36 @@ class DecodeSlots:
             "prefill": 0.0, "decode": 0.0, "verify": 0.0,
             "mixed": 0.0, "admit": 0.0, "transfer": 0.0,
             "mega": 0.0, "other": 0.0}
+        # MoE-family serving telemetry (ISSUE 13): every tick program
+        # of a Qwen3MoE engine appends its routing-load vector
+        # [expert_tokens[0..E-1], capacity_dropped]; _fetch pops ONE
+        # per landed tick (engine.pop_moe_load — FIFO, so the overlap
+        # pipeline never syncs an in-flight tick's stats) and folds it
+        # into per-expert `expert_tokens{expert=...}` counters, the
+        # `moe_capacity_drops` counter, and the `expert_load_imbalance`
+        # (max/mean of cumulative expert load) gauge — the loud half
+        # of dropless-or-loud, observable in stats() and /metrics.
+        self._moe_family = bool(getattr(engine, "moe_family", False))
+        if self._moe_family:
+            # engines are shared across schedulers (the process-wide
+            # program cache); a prior scheduler that died mid-tick may
+            # have left an unlanded stats entry — start aligned
+            engine._moe_pending.clear()
+            reg = self.tele.registry
+            E = engine.model.config.num_experts
+            self._moe_tokens_cum = np.zeros((E,), np.int64)
+            self._c_expert = [
+                reg.counter("expert_tokens",
+                            "routed entries per expert (compute load)",
+                            labels={"expert": str(e)})
+                for e in range(E)]
+            self._c_moe_drops = reg.counter(
+                "moe_capacity_drops",
+                "routed entries lost to expert capacity (0 under "
+                "capacity_factor='dropless')")
+            self._g_moe_imb = reg.gauge(
+                "expert_load_imbalance",
+                "max/mean of cumulative per-expert routed load")
         self.spec = int(spec)
         if self.spec:
             from triton_dist_tpu.models.spec_decode import NgramDrafter
@@ -635,10 +665,21 @@ class DecodeSlots:
         own mark_dispatch) derives from last_kind; land=False charges
         "admit" (arming fetches block on the admission forward)."""
         import jax
+        moe_load = (self.engine.pop_moe_load()
+                    if land and self._moe_family else None)
         t0 = time.perf_counter()
-        out = jax.device_get(arrs)
+        if moe_load is not None:
+            # the landed tick's routing-load vector rides the SAME
+            # coalesced readback (its outputs are computed by now —
+            # this is a d2h copy, not a sync)
+            out = jax.device_get(arrs + (moe_load,))
+            out, moe_load = out[:-1], out[-1]
+        else:
+            out = jax.device_get(arrs)
         dt = time.perf_counter() - t0
         self.device_wait_s += dt
+        if moe_load is not None:
+            self._note_moe_load(moe_load)
         if kind is None:
             kind = (_DISPATCH_KIND.get(self.tele.last_kind,
                                        self.tele.last_kind)
@@ -653,6 +694,22 @@ class DecodeSlots:
             # (no-op when tracing is off or nothing is pending)
             self.tele.device_land()
         return out
+
+    def _note_moe_load(self, load: np.ndarray) -> None:
+        """Fold one landed tick's routing-load vector into the MoE
+        serving metrics (driver thread only — the same thread that
+        lands ticks)."""
+        load = np.asarray(load, np.int64)
+        counts, dropped = load[:-1], int(load[-1])
+        for e in np.nonzero(counts)[0]:
+            self._c_expert[int(e)].inc(int(counts[e]))
+        if dropped:
+            self._c_moe_drops.inc(dropped)
+        self._moe_tokens_cum += counts
+        mean = self._moe_tokens_cum.mean()
+        self._g_moe_imb.set(
+            float(self._moe_tokens_cum.max() / mean) if mean > 0
+            else 0.0)
 
     def _run_chunk(self, chunk: int):
         """Engine-call hook: DISPATCH one chunk of the slot scan (paged
