@@ -938,6 +938,98 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- sequence-parallel long-context rows (ISSUE 14 / ROADMAP
+    # long-context item): (a) the SAME fixed-context paged serving
+    # burst with the pool's page-id space sharded over an sp axis
+    # (split-KV partial walk + cross-chip LSE combine per tick) vs
+    # sp-off — per-chip tok/s is the number sp trades for capacity;
+    # (b) the capacity multiplier: the longest admissible context at a
+    # FIXED per-chip pool, sp=S vs sp=1, probed through the exact
+    # host-side admission gate (validate_admission — rejects are
+    # host-only, so the probe is cheap and honest). On the CPU smoke
+    # the throughput ratio is noise by construction (chips timeshare
+    # the host; real chips via tools/onchip_regen.sh are the
+    # measurement) but the capacity multiplier is exact everywhere.
+    sp_n = min(4, ndev)
+    if sp_n > 1:
+        from triton_dist_tpu.models import Request as _Req
+        mesh_sp = jax.make_mesh((1, sp_n), ("tp", "sp"))
+        model_sp = AutoLLM.from_config(cfg, mesh_sp, sp_axis="sp")
+        model_sp1 = AutoLLM.from_config(cfg, jax.make_mesh((1,), ("tp",)))
+        sp_len, sp_gen2, sp_batch2 = (64, 96, 4) if on_tpu else (8, 8, 2)
+        seq_cap = sp_len + sp_gen2 + 16
+
+        def sp_reqs():
+            r = np.random.RandomState(13)
+            return [_Req(rid=i,
+                         ids=r.randint(0, cfg.vocab_size,
+                                       size=(sp_len,)).astype(np.int32),
+                         gen_len=sp_gen2, seed=i)
+                    for i in range(2 * sp_batch2)]
+
+        def sp_run(eng_x, nchips):
+            mk = lambda: ContinuousScheduler(eng_x, batch=sp_batch2,
+                                             chunk=2, paged=True)
+            mk().run(sp_reqs()[:1])        # warm the slot programs
+            sched = mk()
+            t0 = time.perf_counter()
+            out = sched.run(sp_reqs())
+            dt = time.perf_counter() - t0
+            return sum(len(t) for t in out.values()) / dt / nchips
+
+        eng_sp = Engine(model_sp, max_seq=seq_cap, backend="flash")
+        eng_sp1 = Engine(model_sp1, max_seq=seq_cap, backend="flash")
+        sp_on = sp_run(eng_sp, sp_n)
+        sp_off = sp_run(eng_sp1, 1)
+
+        # capacity probe: fixed per-chip pool, longest admissible
+        # context through the real admission gate
+        page_b = 16
+        chip_pages = 8 * cfg.num_kv_heads + cfg.num_kv_heads
+
+        def max_ctx(eng_x, pages):
+            sched = ContinuousScheduler(eng_x, batch=1, paged=True,
+                                        chunk=2, page=page_b,
+                                        num_pages=pages)
+            lo = 0
+            for n in range(page_b, sched.slots.capacity, page_b):
+                req = _Req(rid="probe",
+                           ids=np.zeros((n,), np.int32), gen_len=1)
+                try:
+                    sched.slots.validate_admission(
+                        req, np.zeros((n,), np.int32))
+                    lo = n
+                except ValueError:
+                    break
+            return lo
+
+        cap_hint = page_b * (chip_pages * sp_n) // cfg.num_kv_heads
+        eng_probe_sp = Engine(model_sp, max_seq=cap_hint,
+                              backend="flash")
+        eng_probe_1 = Engine(model_sp1, max_seq=cap_hint,
+                             backend="flash")
+        ctx_sp = max_ctx(eng_probe_sp, chip_pages * sp_n)
+        ctx_1 = max_ctx(eng_probe_1, chip_pages)
+        _emit_json({
+            "metric": "sp_decode_tok_per_s_per_chip",
+            "value": round(sp_on, 2),
+            "unit": "tok/s",
+            "sp_size": sp_n,
+            "sp_off_tok_per_s_per_chip": round(sp_off, 2),
+            "context_len": sp_len,
+            "backend": jax.default_backend(),
+        })
+        _emit_json({
+            "metric": "long_context_capacity_multiplier",
+            "value": round(ctx_sp / max(ctx_1, 1), 2),
+            "unit": "x",
+            "sp_size": sp_n,
+            "max_context_sp": ctx_sp,
+            "max_context_sp1": ctx_1,
+            "pages_per_chip": chip_pages,
+            "backend": jax.default_backend(),
+        })
+
     # --- megakernel paged decode tick row (ISSUE 12 / ROADMAP item
     # 5): the SAME greedy paged serving burst through backend="mega"
     # (one fused Pallas kernel per layer per tick) vs the per-op

@@ -85,6 +85,14 @@ def test_moe_serving_example_runs():
 
 
 @pytest.mark.slow
+def test_long_context_example_runs():
+    # slow: same budget note — the sp capacity + bitwise differential
+    # the example demos already runs in-suite
+    # (tests/test_sp_serving.py); tools/sp_smoke.sh covers the example
+    _run_example("20_long_context.py")
+
+
+@pytest.mark.slow
 def test_disaggregation_example_runs():
     # slow: same budget note — the disagg-vs-fused differential the
     # example demos already runs in-suite (tests/test_disagg.py);

@@ -311,6 +311,30 @@ def test_bench_compare_moe_row_directions():
         == "higher-is-better"
 
 
+def test_bench_compare_sp_row_directions():
+    """ISSUE 14 satellite: the two sequence-parallel bench rows
+    resolve to the right regression direction —
+    `sp_decode_tok_per_s_per_chip` (tok/s, a rate: DOWN = regressed)
+    and `long_context_capacity_multiplier` (unit "x", a capacity
+    multiplier: DOWN = regressed)."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "sp_decode_tok_per_s_per_chip", "value": 200.0,
+          "unit": "tok/s", "backend": "tpu"},
+         {"metric": "long_context_capacity_multiplier", "value": 4.0,
+          "unit": "x", "backend": "tpu"}]
+    b = [{"metric": "sp_decode_tok_per_s_per_chip", "value": 90.0,
+          "unit": "tok/s", "backend": "tpu"},
+         {"metric": "long_context_capacity_multiplier", "value": 1.0,
+          "unit": "x", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["sp_decode_tok_per_s_per_chip"]["flag"] == "regressed"
+    assert res["sp_decode_tok_per_s_per_chip"]["direction"] \
+        == "higher-is-better"
+    assert res["long_context_capacity_multiplier"]["flag"] == "regressed"
+    assert res["long_context_capacity_multiplier"]["direction"] \
+        == "higher-is-better"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

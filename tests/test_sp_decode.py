@@ -70,6 +70,110 @@ def test_kv_cache_scatter():
     np.testing.assert_array_equal(got[:, :, S:], np.asarray(old)[:, :, S:])
 
 
+def test_sp_ref_per_slot_kv_lens():
+    """Serving-oracle satellite (ISSUE 14): sp_flash_decode_ref covers
+    per-slot kv_lens batches — slot b attends exactly kv_lens[b]
+    positions of its own streams, independent of its neighbours. The
+    paged sp serving attend lands against THIS pinned oracle."""
+    B, S, Hq, Hkv, T, d = 3, 1, 4, 2, 256, 64
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(B, S, Hq, d), jnp.float32) * 0.5
+    k = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    kv_lens = jnp.asarray([7, 200, 33], jnp.int32)
+    out = sp_flash_decode_ref(q, k, v, kv_lens)
+    # row b must equal a batch-1 oracle at ITS OWN scalar length
+    for b in range(B):
+        one = sp_flash_decode_ref(q[b:b + 1], k[b:b + 1], v[b:b + 1],
+                                  int(kv_lens[b]))
+        np.testing.assert_allclose(np.asarray(out[b]),
+                                   np.asarray(one[0]),
+                                   atol=1e-6, rtol=1e-6,
+                                   err_msg=f"slot {b}")
+
+
+def test_sp_ref_q_lens_padded_row_drop():
+    """Serving-oracle satellite: the verify/chunk-window contract —
+    slot b's first q_lens[b] rows are a window ending at kv_lens[b]-1,
+    causal within; PADDED rows (s >= q_lens[b]) clamp to the last
+    valid row (their outputs are discarded by the caller — the same
+    drop the paged kernel implements by scattering their KV out of
+    bounds). Pinned so the sp serving path's masks land against it."""
+    B, S, Hq, Hkv, T, d = 2, 4, 4, 2, 128, 32
+    rng = np.random.RandomState(12)
+    q = rng.randn(B, S, Hq, d).astype(np.float32) * 0.5
+    # padded rows of slot 0 repeat its last valid row's QUERY, so the
+    # clamp is observable as value equality (the mask is what clamps;
+    # the caller discards padded outputs either way)
+    q[0, 2:] = q[0, 1]
+    q = jnp.asarray(q)
+    k = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(B, Hkv, T, d), jnp.float32) * 0.5
+    kv_lens = jnp.asarray([30, 77], jnp.int32)
+    q_lens = jnp.asarray([2, 4], jnp.int32)
+    out = sp_flash_decode_ref(q, k, v, kv_lens, q_lens=q_lens)
+    # valid rows: row s of slot b == a 1-row window at kv position
+    # kv_lens[b] - q_lens[b] + s + 1
+    for b in range(B):
+        for s in range(int(q_lens[b])):
+            L = int(kv_lens[b]) - int(q_lens[b]) + s + 1
+            one = sp_flash_decode_ref(q[b:b + 1, s:s + 1],
+                                      k[b:b + 1], v[b:b + 1], L)
+            np.testing.assert_allclose(
+                np.asarray(out[b, s]), np.asarray(one[0, 0]),
+                atol=1e-6, rtol=1e-6, err_msg=f"slot {b} row {s}")
+    # padded rows CLAMP to the last valid row — a defined value (the
+    # caller discards them), never NaN/garbage
+    padded = np.asarray(out[0, int(q_lens[0]):])
+    assert np.isfinite(padded).all()
+    np.testing.assert_allclose(
+        padded, np.broadcast_to(np.asarray(out[0, int(q_lens[0]) - 1]),
+                                padded.shape),
+        atol=1e-6, rtol=1e-6)
+
+
+def test_paged_partial_combine_vs_oracle():
+    """The paged-partial kernel satellite (ISSUE 14): split a paged
+    pool's logical tiles into disjoint ownership sets (the sp shard
+    pattern), run flash_decode_paged_partial per 'chip', LSE-combine
+    (kernels/flash_attn.lse_combine — the existing combine the sp
+    serving attend feeds), and match the full-walk flash_decode_paged
+    AND the extended sp_flash_decode_ref oracle."""
+    from triton_dist_tpu.kernels.flash_attn import lse_combine
+    from triton_dist_tpu.kernels.paged_kv import (
+        flash_decode_paged, flash_decode_paged_partial)
+    B, Hq, Hkv, d, page, maxp, NP = 2, 4, 2, 32, 8, 4, 33
+    X = B * Hkv
+    rng = np.random.RandomState(7)
+    pk = jnp.asarray(rng.randn(NP, page, d), jnp.float32) * 0.5
+    pv = jnp.asarray(rng.randn(NP, page, d), jnp.float32) * 0.5
+    tbl = jnp.asarray(
+        rng.permutation(NP - 1)[:X * maxp].reshape(X, maxp) + 1,
+        jnp.int32)
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32) * 0.5
+    kv_lens = jnp.asarray([13, 27], jnp.int32)
+    full = flash_decode_paged(q, pk, pv, tbl, jnp.max(kv_lens),
+                              kv_lens=kv_lens)
+    accs, ms, ls = [], [], []
+    for s in range(2):          # 2 fake chips, tiles split by parity
+        own = np.broadcast_to(
+            (np.arange(maxp)[None, :] % 2 == s), (X, maxp))
+        acc, m, l = flash_decode_paged_partial(
+            q, pk, pv, tbl, kv_lens=kv_lens,
+            tile_owned=jnp.asarray(own.astype(np.int32)))
+        accs.append(acc), ms.append(m), ls.append(l)
+    out = lse_combine(jnp.stack(accs), jnp.stack(ms), jnp.stack(ls),
+                      dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               atol=2e-5, rtol=2e-5)
+    # and against the extended oracle on the gathered cache
+    kfull = pk[tbl].reshape(B, Hkv, maxp * page, d)
+    vfull = pv[tbl].reshape(B, Hkv, maxp * page, d)
+    ref = sp_flash_decode_ref(q, kfull, vfull, kv_lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=1e-5)
+
+
 def test_sp_flash_decode_kv_len_traced():
     """kv_len must be jit-traceable (it advances every decode step)."""
     B, S, Hq, Hkv, T, d = 1, 1, 4, 2, 256, 64

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Sequence-parallel serving smoke — the sp=4-vs-sp=1 bitwise
+# differential suite (tests/test_sp_serving.py: sampled/spec,
+# chunked+overlap, preemption+host-tier+chaos arms that tier-1's
+# 870 s budget pushes behind the slow mark, plus the tier-1 core and
+# the sp kernel oracles in tests/test_sp_decode.py) on the forced
+# multi-device CPU mesh — the focused loop for iterating on the
+# long-context layer alone (tp_smoke.sh pattern). Archives the pass
+# count next to the log and reports the delta vs the previous run.
+# Run from the repo root: bash tools/sp_smoke.sh
+set -o pipefail
+rm -f /tmp/_sp_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the FULL sp
+# differential matrix.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_sp_serving.py tests/test_sp_decode.py \
+    "tests/test_examples.py::test_long_context_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_sp_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_sp_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_sp_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "SP_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "SP_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

@@ -43,7 +43,8 @@ from triton_dist_tpu.runtime import interpret_mode
 
 
 def _paged_kernel(scale: float, rep: int, page: int, W: int,
-                  per_stream: bool, quant: bool, len_ref, *refs):
+                  per_stream: bool, quant: bool, partial: bool,
+                  len_ref, *refs):
     """Grid (X // W, max_pages); W (batch, kv-head) streams per grid
     step (refs = q, k_0..k_{W-1}, v_0..v_{W-1}, [ks_0..ks_{W-1},
     vs_0..vs_{W-1}], [lens], o, m/l/acc scratch). Same online softmax
@@ -74,7 +75,18 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
     in VMEM, so KV HBM traffic is halved. Scale rows of never-written
     positions are finite (pool-init zeros or stale real scales, never
     NaN), so the length mask that zeroes their p entries needs no
-    extra guard."""
+    extra guard.
+
+    partial=True (the SEQUENCE-PARALLEL serving walk — the split-KV
+    partial of the inter-chip LSE combine, kernels/sp_flash_decode.py):
+    an extra [W, maxp] int32 ownership block rides after the lens —
+    stream j's logical tile t contributes ONLY when own[j, t] != 0
+    (this chip holds the physical page; the table handed in is the
+    LOCAL redirected one) — and the epilogue emits the UNNORMALIZED
+    accumulator plus the (m, l) softmax stats instead of the
+    normalized output. Tiles a chip does not own mask to a bitwise
+    no-op of its accumulator, so the n per-chip partials LSE-combine
+    to exactly the full softmax."""
     q_ref = refs[0]
     k_refs = refs[1:1 + W]
     v_refs = refs[1 + W:1 + 2 * W]
@@ -86,9 +98,15 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
     else:
         ks_refs = vs_refs = None
     if per_stream:
-        lens_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        lens_ref = rest[0]
+        rest = rest[1:]
     else:
         lens_ref = None
+    own_ref = None
+    if partial:
+        own_ref = rest[0]
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = rest[1:]
+    else:
         o_ref, m_scr, l_scr, acc_scr = rest
     t = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -109,6 +127,16 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
         col = jax.lax.broadcasted_iota(jnp.int32, (rows, page), 1) + start
         if not per_stream:
             mask = (col <= (row + q_off)) & (col < kv_len)
+        if partial:
+            # this grid step's ownership column of the [W, maxp] block
+            # (iota-compare-select instead of a dynamic scalar index —
+            # the same generic-interpreter constraint the lens operand
+            # documents)
+            own_all = own_ref[...]                       # [W, maxp]
+            tcol = jax.lax.broadcasted_iota(
+                jnp.int32, own_all.shape, 1)
+            own_t = jnp.sum(
+                jnp.where(tcol == t, own_all, 0), axis=1)  # [W]
         for j in range(W):
             if per_stream:
                 # row s's causal frontier within stream j's draft
@@ -116,6 +144,10 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
                 kvl = lens_ref[j, 0]
                 ql = lens_ref[j, 1]
                 mask = col <= (kvl - ql + jnp.minimum(row, ql - 1))
+            if partial:
+                # non-owned tile: bitwise no-op of stream j's
+                # accumulator (the combine supplies the other chips')
+                mask = mask & (own_t[j] != 0)
             q = q_ref[pl.ds(j, 1)]                       # [1, rows, d]
             kj = k_refs[j][...]
             if quant:
@@ -151,9 +183,17 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
 
     @pl.when(t == nt - 1)
     def _done():
-        o_ref[...] = (acc_scr[...]
-                      / jnp.maximum(l_scr[...], 1e-30)[..., None]
-                      ).astype(o_ref.dtype)
+        if partial:
+            # the SP partial contract: unnormalized accumulator +
+            # softmax stats, combined across chips by lse_combine
+            # (kernels/flash_attn.py) / sp_combine_partials
+            o_ref[...] = acc_scr[...].astype(o_ref.dtype)
+            m_ref[...] = m_scr[...]
+            l_ref[...] = l_scr[...]
+        else:
+            o_ref[...] = (acc_scr[...]
+                          / jnp.maximum(l_scr[...], 1e-30)[..., None]
+                          ).astype(o_ref.dtype)
 
 
 def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
@@ -194,11 +234,59 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     step_mixed) both ride this mask; padded rows (and whole q_len == 0
     budget-starved rows) are discarded by the caller.
     """
+    return _flash_decode_paged_call(
+        q, pages_k, pages_v, page_table, kv_len, scale=scale,
+        kv_lens=kv_lens, q_lens=q_lens, k_scale=k_scale,
+        v_scale=v_scale, tile_owned=None)
+
+
+def flash_decode_paged_partial(q, pages_k, pages_v, page_table, *,
+                               kv_lens, tile_owned,
+                               scale: Optional[float] = None,
+                               q_lens=None, k_scale=None, v_scale=None):
+    """Split-KV PARTIAL of the paged walk — the sequence-parallel
+    serving kernel (ROADMAP long-context item; the per-rank split-KV
+    partial of the reference's inter-rank combine, flash_decode.py:130
+    -> :482, over a PAGED pool instead of a contiguous shard).
+
+    Same per-stream contract as flash_decode_paged(kv_lens=..,
+    q_lens=..), with two changes for the sp-sharded pool
+    (kv_cache.PagedSlotCache SP SHARDING):
+
+    - pages_k/v are THIS CHIP'S local pool shard and page_table is the
+      LOCAL redirected table (non-owned tiles point at some in-range
+      local page — layers/tp_attn.py redirects them to the last owned
+      page so the surplus DMAs elide);
+    - tile_owned [B*Hkv, maxp] int32 marks which logical tiles this
+      chip owns: non-owned tiles are a bitwise no-op of the stream's
+      accumulator, so the returned (acc [B, S, Hq, d] f32 unnormalized,
+      m [B, S, Hq], l [B, S, Hq]) LSE-combine across chips
+      (sp_flash_decode.sp_combine_partials / flash_attn.lse_combine)
+      to exactly the full-pool softmax. A stream none of whose tiles
+      are owned returns (0, -1e30, 0) — the combine's neutral element.
+    """
+    assert kv_lens is not None
+    return _flash_decode_paged_call(
+        q, pages_k, pages_v, page_table, None, scale=scale,
+        kv_lens=kv_lens, q_lens=q_lens, k_scale=k_scale,
+        v_scale=v_scale, tile_owned=tile_owned)
+
+
+def _flash_decode_paged_call(q, pages_k, pages_v, page_table, kv_len, *,
+                             scale, kv_lens, q_lens, k_scale, v_scale,
+                             tile_owned):
     B, S, Hq, d = q.shape
+    partial = tile_owned is not None
     if q_lens is not None:
         assert kv_lens is not None, "q_lens rides on per-slot kv_lens"
-    else:
+    elif not partial:
         assert S == 1, "paged walk without q_lens is decode (S == 1)"
+    # the partial (sp) walk is per-stream by construction: the kernel
+    # rebinds the mask per stream only on the per_stream path, so a
+    # partial call without kv_lens would compound ownership bits
+    # across the W streams of a grid step
+    assert not partial or kv_lens is not None, \
+        "flash_decode_paged_partial requires per-slot kv_lens"
     quant = k_scale is not None
     assert (k_scale is None) == (v_scale is None), \
         "int8 pool carries BOTH scale planes"
@@ -259,38 +347,61 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     def lens_map(x, t, s_ref):
         return (x, 0)
 
+    def own_map(x, t, s_ref):
+        return (x, 0)
+
     kv_specs = [pl.BlockSpec((1, page, d), kv_map_j(j)) for j in range(W)]
     sc_specs = ([pl.BlockSpec((1, page), sc_map_j(j)) for j in range(W)]
                 if quant else [])
     in_specs = ([pl.BlockSpec((W, rows, d), q_map)] + kv_specs + kv_specs
                 + sc_specs + sc_specs
-                + ([pl.BlockSpec((W, 2), lens_map)] if per_stream else []))
+                + ([pl.BlockSpec((W, 2), lens_map)] if per_stream else [])
+                + ([pl.BlockSpec((W, maxp), own_map)] if partial else []))
     args = ([qx] + [pages_k] * W + [pages_v] * W
             + ([k_scale] * W + [v_scale] * W if quant else [])
             + ([jnp.stack([lens_x, qlens_x], axis=1)]
-               if per_stream else []))
+               if per_stream else [])
+            + ([jnp.asarray(tile_owned, jnp.int32)] if partial else []))
+    if partial:
+        out_specs = (pl.BlockSpec((W, rows, d), q_map),
+                     pl.BlockSpec((W, rows), lens_map),
+                     pl.BlockSpec((W, rows), lens_map))
+        out_shape = (jax.ShapeDtypeStruct((X, rows, d), jnp.float32),
+                     jax.ShapeDtypeStruct((X, rows), jnp.float32),
+                     jax.ShapeDtypeStruct((X, rows), jnp.float32))
+    else:
+        out_specs = pl.BlockSpec((W, rows, d), q_map)
+        out_shape = jax.ShapeDtypeStruct((X, rows, d), q.dtype)
     out = pl.pallas_call(
         functools.partial(_paged_kernel, float(scale), rep, page, W,
-                          per_stream, quant),
+                          per_stream, quant, partial),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(X // W, maxp),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((W, rows, d), q_map),
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((W, rows), jnp.float32),
                 pltpu.VMEM((W, rows), jnp.float32),
                 pltpu.VMEM((W, rows, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((X, rows, d), q.dtype),
+        out_shape=out_shape,
         interpret=interpret_mode(),
         # the W k (v) operands are the SAME pool array — one buffer,
         # W per-stream index maps
     )(scalars, *args)
-    return (out.reshape(B, Hkv, S, rep, d)
-               .transpose(0, 2, 1, 3, 4)
-               .reshape(B, S, Hq, d))
+
+    def unfold(a):
+        tail = a.shape[2:]
+        return (a.reshape((B, Hkv, S, rep) + tail)
+                 .transpose(0, 2, 1, 3, *range(4, 4 + len(tail)))
+                 .reshape((B, S, Hq) + tail))
+
+    if partial:
+        acc, m, l = out
+        return unfold(acc), unfold(m), unfold(l)
+    return unfold(out)
 
 
 @jax.tree_util.register_dataclass
@@ -425,46 +536,101 @@ class PageAllocator:
     paged_kv_cache.py's block allocator). Slots of very different
     lengths draw from one pool; retiring a slot returns its pages for
     the next admission. Pure host bookkeeping: allocation changes the
-    page TABLE (data), never the kernel (program)."""
+    page TABLE (data), never the kernel (program).
 
-    def __init__(self, num_pages: int):
+    shards > 1 (sequence-parallel serving — kv_cache.PagedSlotCache SP
+    SHARDING): the page-id space is partitioned in contiguous blocks —
+    shard s owns ids [s*pps, (s+1)*pps), the exact mirror of the
+    device-side split of the pool's leading axis — and allocation
+    ROTATES across shards so a slot's consecutive logical tiles land
+    on different chips (each chip then walks ~1/S of any stream's
+    pages). Frees return a page to ITS OWN shard's list by id, so the
+    conservation invariant holds PER SHARD:
+    ``available_by_shard[s] + outstanding_by_shard[s] == pps`` after
+    any sequence of operations — the per-shard zero-leak the chaos
+    suite asserts. shards == 1 keeps the historical single-list
+    semantics bit for bit (page 0 handed out first)."""
+
+    def __init__(self, num_pages: int, shards: int = 1):
+        if shards < 1 or num_pages % shards:
+            raise ValueError(
+                f"page pool of {num_pages} pages cannot split over "
+                f"{shards} shards: the sp mesh size must divide the "
+                f"page count (pass num_pages as a multiple of the sp "
+                f"axis, or shrink the axis)")
         self.num_pages = num_pages
-        self._free = list(range(num_pages - 1, -1, -1))  # pop() -> page 0 first
+        self.shards = shards
+        self.pages_per_shard = num_pages // shards
+        pps = self.pages_per_shard
+        # per-shard descending lists: pop() hands out each shard's
+        # lowest id first (shard 0's first page is the reserved trash)
+        self._free_by_shard = [
+            list(range((s + 1) * pps - 1, s * pps - 1, -1))
+            for s in range(shards)]
+        self._rr = 0
         self._in_use = set()
+
+    def shard_of(self, page: int) -> int:
+        return int(page) // self.pages_per_shard
 
     @property
     def available(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free_by_shard)
+
+    @property
+    def available_by_shard(self):
+        return [len(f) for f in self._free_by_shard]
 
     @property
     def outstanding(self) -> int:
         return len(self._in_use)
 
+    @property
+    def outstanding_by_shard(self):
+        out = [0] * self.shards
+        for p in self._in_use:
+            out[p // self.pages_per_shard] += 1
+        return out
+
     def _check(self) -> None:
         """Pool conservation invariant: every page is on the free list
-        XOR outstanding. A violation means the bookkeeping corrupted
-        the pool (the failure mode a double-free used to cause
-        silently: one physical page handed to two slots)."""
-        assert len(self._free) + len(self._in_use) == self.num_pages, (
-            f"page pool corrupted: {len(self._free)} free + "
+        XOR outstanding — PER SHARD (a violation means the bookkeeping
+        corrupted the pool; the failure mode a double-free used to
+        cause silently: one physical page handed to two slots)."""
+        assert self.available + len(self._in_use) == self.num_pages, (
+            f"page pool corrupted: {self.available} free + "
             f"{len(self._in_use)} in use != {self.num_pages}")
 
+    def _pick_shard(self) -> int:
+        """Next shard in rotation with a free page (skip exhausted
+        shards; the rotation is what spreads a slot's tiles)."""
+        for k in range(self.shards):
+            s = (self._rr + k) % self.shards
+            if self._free_by_shard[s]:
+                self._rr = (s + 1) % self.shards
+                return s
+        raise ValueError("page pool exhausted: no shard has a free page")
+
     def alloc(self, n: int):
-        """Take n pages off the free list (raises when the pool is
-        exhausted — the scheduler's admission check)."""
-        if n > len(self._free):
+        """Take n pages off the free lists (raises when the pool is
+        exhausted — the scheduler's admission check), rotating across
+        shards (the sp round-robin install; a no-op rotation at
+        shards == 1)."""
+        if n > self.available:
             raise ValueError(
-                f"page pool exhausted: want {n}, have {len(self._free)}")
-        out = [self._free.pop() for _ in range(n)]
+                f"page pool exhausted: want {n}, "
+                f"have {self.available}")
+        out = [self._free_by_shard[self._pick_shard()].pop()
+               for _ in range(n)]
         self._in_use.update(out)
         self._check()
         return out
 
     def free(self, pages) -> None:
-        """Return pages to the free list. Rejects out-of-range ids and
-        double-frees BEFORE touching the pool — a double-freed page
-        would be handed to two slots, and the second slot's writes
-        would silently corrupt the first's KV."""
+        """Return pages to their own shard's free list. Rejects
+        out-of-range ids and double-frees BEFORE touching the pool — a
+        double-freed page would be handed to two slots, and the second
+        slot's writes would silently corrupt the first's KV."""
         pages = [int(p) for p in pages]
         seen = set()
         for p in pages:
@@ -477,7 +643,7 @@ class PageAllocator:
             seen.add(p)
         for p in pages:
             self._in_use.remove(p)
-            self._free.append(p)
+            self._free_by_shard[p // self.pages_per_shard].append(p)
         self._check()
 
     def alloc_slot(self, Hkv: int, n_positions: int, page: int):

@@ -18,6 +18,17 @@ Two combine paths:
     kernel, flash_decode.py:482, as one-sided puts instead of a
     gather-then-combine pair). Output is replicated, which is exactly
     what decode wants (the next layer's QKV projection reads it whole).
+
+SERVING (ISSUE 14 — long-context sequence-parallel paged decode):
+``sp_combine_partials`` below is the serving-path entry point — the
+sp-sharded PAGED pool's decode/verify ticks
+(layers/tp_attn.fwd_cached_slots_paged_sp) compute per-chip partials
+with kernels/paged_kv.flash_decode_paged_partial (each chip walking
+only the pages it owns) and merge them here, combine="xla" feeding
+the jnp lse_combine and combine="dist" the one-shot Pallas push
+kernel. ``sp_flash_decode_ref`` doubles as the serving oracle: it
+accepts per-slot kv_lens batches and q_lens verify windows (the
+padded-row drop contract) — tests/test_sp_decode.py pins it.
 """
 
 from __future__ import annotations
@@ -185,43 +196,87 @@ def sp_flash_decode(q, k, v, kv_len, *, mesh: Mesh, axis: str = "sp",
     rep_spec = P(*(None,) * 4)
     kv_len = jnp.asarray(kv_len, jnp.int32)
 
-    if combine == "xla":
-        @functools.partial(jax.shard_map, mesh=mesh,
-                           in_specs=(rep_spec, kv_spec, kv_spec, P()),
-                           out_specs=rep_spec, check_vma=False)
-        def _f(q_r, k_loc, v_loc, L):
-            acc, m, l = _partial(q_r, k_loc, v_loc, L)
-            accs = jax.lax.all_gather(acc, axis)
-            ms = jax.lax.all_gather(m, axis)
-            ls = jax.lax.all_gather(l, axis)
-            return lse_combine(accs, ms, ls, dtype=out_dtype)
-        return _f(q, k, v, kv_len)
-
-    assert combine == "dist", combine
+    assert combine in ("xla", "dist"), combine
 
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(rep_spec, kv_spec, kv_spec, P()),
                        out_specs=rep_spec, check_vma=False)
     def _f(q_r, k_loc, v_loc, L):
         acc, m, l = _partial(q_r, k_loc, v_loc, L)
-        R = B * S * Hq
-        acc2 = acc.reshape(R, d)
-        # stats [2, R] padded to a 128 lane multiple: Mosaic requires
-        # the minor dim of sliced remote DMAs tile-aligned
-        Rp = -(-R // 128) * 128
-        st = jnp.stack([m.reshape(R), l.reshape(R)], axis=0)
-        if Rp != R:
-            st = jnp.pad(st, ((0, 0), (0, Rp - R)))
-        out = _lse_combine_pallas(acc2, st, n=n, axis=axis,
-                                  collective_id=collective_id)
-        return out.reshape(B, S, Hq, d).astype(out_dtype)
+        return sp_combine_partials(acc, m, l, axis=axis, n=n,
+                                   combine=combine,
+                                   collective_id=collective_id,
+                                   out_dtype=out_dtype)
 
     return _f(q, k, v, kv_len)
 
 
-def sp_flash_decode_ref(q, k, v, kv_len, *, scale: Optional[float] = None):
-    """Full-KV oracle: identical math on the unsharded cache."""
-    return attention_cached_ref(q, k, v, kv_len, scale=scale)
+def sp_flash_decode_ref(q, k, v, kv_len, *, scale: Optional[float] = None,
+                        q_lens=None):
+    """Full-KV oracle: identical math on the unsharded cache.
+
+    The SERVING contract (the paged sp decode tick,
+    layers/tp_attn.py fwd_cached_slots_paged_sp) extends the original
+    uniform-batch oracle two ways, both inherited from
+    attention_cached_ref:
+
+    - kv_len may be a [B] VECTOR of per-slot lengths (continuous
+      batching: every slot is a different request at a different
+      position) — slot b attends exactly kv_len[b] positions of its
+      own streams;
+    - q_lens [B] (requires vector kv_len) marks per-slot verify/chunk
+      windows: slot b's first q_lens[b] query rows sit at positions
+      kv_len[b] - q_lens[b] .. kv_len[b] - 1 and attend causally
+      within the window. PADDED rows (s >= q_lens[b]) clamp to the
+      last valid row and their outputs are DISCARDED by the caller —
+      the same drop contract the paged kernel implements by
+      scattering padded rows' KV out of bounds, pinned by
+      tests/test_sp_decode.py so the serving path lands against this
+      oracle."""
+    return attention_cached_ref(q, k, v, kv_len, scale=scale,
+                                q_lens=q_lens)
+
+
+def sp_combine_partials(acc, m, l, *, axis: str, n: int,
+                        combine: str = "xla",
+                        collective_id: Optional[int] = None,
+                        out_dtype=None):
+    """Cross-chip LSE merge of split-KV partials, called INSIDE a
+    shard_map over `axis` (the serving-path half of sp_flash_decode:
+    the paged sp decode tick computes per-chip partials with
+    flash_decode_paged_partial and merges them here — reference:
+    the inter-rank combine, flash_decode.py:482).
+
+    acc: [B, S, Hq, d] f32 unnormalized; m, l: [B, S, Hq] — this
+    chip's partial. Returns the normalized [B, S, Hq, d], replicated
+    over `axis` (exactly what the next layer's QKV projection wants).
+
+    combine="xla": all_gather + the jnp lse_combine — the n-partial
+    merge as one XLA collective (runs everywhere, including hosts
+    whose interpret mode cannot run the comm kernels).
+    combine="dist": the one-shot Pallas push+reduce kernel
+    (_lse_combine_pallas — one-sided puts over ICI, the paper's
+    inter-rank combine kernel)."""
+    B, S, Hq, d = acc.shape
+    if out_dtype is None:
+        out_dtype = acc.dtype
+    if combine == "xla":
+        accs = jax.lax.all_gather(acc, axis)
+        ms = jax.lax.all_gather(m, axis)
+        ls = jax.lax.all_gather(l, axis)
+        return lse_combine(accs, ms, ls, dtype=out_dtype)
+    assert combine == "dist", combine
+    if collective_id is None:
+        collective_id = next_collective_id()
+    R = B * S * Hq
+    acc2 = acc.reshape(R, d)
+    Rp = -(-R // 128) * 128
+    st = jnp.stack([m.reshape(R), l.reshape(R)], axis=0)
+    if Rp != R:
+        st = jnp.pad(st, ((0, 0), (0, Rp - R)))
+    out = _lse_combine_pallas(acc2, st, n=n, axis=axis,
+                              collective_id=collective_id)
+    return out.reshape(B, S, Hq, d).astype(out_dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,18 @@ Two layers:
     as the MXU finishes it), attention runs over the full sequence on
     1/n of the heads, and the inverse a2a restores sequence sharding
     before the local O projection — no collective in the O path at all.
+
+SERVING (ISSUE 14): the paged long-context serving path does NOT go
+through these layers — it lives on TP_Attn (the weight-holding layer
+the scheduler's slot forwards already drive):
+``layers/tp_attn.fwd_cached_slots_paged_sp`` runs the same
+split-KV-partial + inter-chip-LSE-combine math over the SP-SHARDED
+PAGED pool (kv_cache.PagedSlotCache SP SHARDING, page-id space
+partitioned per chip) using ``kernels/paged_kv.
+flash_decode_paged_partial`` + ``kernels/sp_flash_decode.
+sp_combine_partials``. These SPAttn layers remain the contiguous
+whole-sequence SP reference (prefill ring attention, Ulysses) and the
+kernels' first consumer.
 """
 
 from __future__ import annotations

@@ -867,6 +867,188 @@ class TP_Attn:
                 jnp.asarray(q_lens, jnp.int32))
         return out[0], tuple(out[1:])
 
+    def _attend_paged_slots_sp(self, qkv, cos, sin, batch: int, kv,
+                               table, pos, q_lens, sp_axis: str,
+                               combine: str = "xla"):
+        """SEQUENCE-PARALLEL paged slot attention (long-context
+        serving — the serving promotion of kernels/sp_flash_decode.py;
+        Ring Attention arXiv:2310.01889 sets the blockwise cross-chip
+        pattern, Infinite-LLM/DistAttention arXiv:2401.02669 the
+        cluster-wide paged-KV deployment): the pool's PAGE-ID space is
+        sharded over the `sp_axis` mesh axis (kv_cache.PagedSlotCache
+        SP SHARDING — chip s holds physical pages [s*pps, (s+1)*pps)),
+        so under jax.shard_map each chip
+
+        - scatters the new K/V rows of the pages IT owns (other
+          chips' scatters redirect out of bounds and drop — the same
+          OOB-drop contract padded verify rows use; a trash-mapped
+          retired row's write lands only in shard 0's local trash
+          sink),
+        - walks ONLY its local pages through the split-KV partial
+          kernel (flash_decode_paged_partial: the replicated table is
+          redirected per chip — non-owned tiles point at the last
+          owned local page so their surplus DMAs elide — and a
+          per-tile ownership mask makes them accumulator no-ops), and
+        - merges partials via the cross-chip LSE combine
+          (sp_combine_partials -> lse_combine or the one-sided Pallas
+          push kernel), yielding the bitwise-softmax output replicated
+          over sp.
+
+        Per-chip KV reads and attention FLOPs drop to ~1/S and a
+        slot's max context is bounded by the MESH's pooled HBM, not
+        one chip's. q_lens None = the decode tick (S == 1); a [B]
+        vector = the verify/chunked-prefill window (per-slot kv_lens
+        AND q_lens masks, padded rows dropped) — chunked prefill over
+        this attend IS the blockwise ring-style prefill: each chunk's
+        window attends the distributed pages through the same
+        partial+combine. Single TP group only (sp + head-group hybrid
+        is refused at construction)."""
+        from triton_dist_tpu.kernels.paged_kv import \
+            flash_decode_paged_partial
+        from triton_dist_tpu.kernels.quant import quantize_kv_int8
+        from triton_dist_tpu.kernels.sp_flash_decode import \
+            sp_combine_partials
+        from triton_dist_tpu.runtime import next_collective_id
+        hq, hkv, hd = self._hq_loc, self._hkv_loc, self.head_dim
+        Hkv = self.n_kv_heads
+        scale = hd ** -0.5
+        quant = len(kv) == 4
+        B = batch
+        M = qkv.shape[0]
+        S = M // B
+        verify = q_lens is not None
+        NP = kv[0].shape[0]
+        maxp = table.shape[1]
+        nsp = self.mesh.shape[sp_axis]
+        pps = NP // nsp
+        cid = (next_collective_id() if combine == "dist" else None)
+        pool_spec = P(sp_axis, None, None, None)
+        sc_spec = P(sp_axis, None, None)
+        kv_specs = ((pool_spec, pool_spec, sc_spec, sc_spec) if quant
+                    else (pool_spec, pool_spec))
+        rep2 = P(None, None)
+        in_specs = ((rep2,) + kv_specs
+                    + (P(None, None), P(None))
+                    + ((P(None),) if verify else ()))
+        out_specs = ((rep2,) + kv_specs)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=False)
+        def f(qkv_loc, ck4, cv4, *rest):
+            if verify:
+                *scales4, tbl, pos_, ql = rest
+            else:
+                *scales4, tbl, pos_ = rest
+                ql = None
+            me = jax.lax.axis_index(sp_axis)
+            ck, cv = ck4[:, 0], cv4[:, 0]       # local shard, plane 0
+            NP_loc = ck.shape[0]
+            page = ck.shape[1]
+            X = B * hkv
+            q = qkv_loc[:, :hq * hd].reshape(B, S, hq, hd)
+            k = qkv_loc[:, hq * hd:(hq + hkv) * hd].reshape(B, S, hkv, hd)
+            v = qkv_loc[:, (hq + hkv) * hd:].reshape(B, S, hkv, hd)
+            if self.q_norm is not None:
+                q = rms_norm(q, self.q_norm)
+            if self.k_norm is not None:
+                k = rms_norm(k, self.k_norm)
+            q = apply_rope_slots(q, cos, sin, pos_)
+            k = apply_rope_slots(k, cos, sin, pos_)
+            # --- new-row scatter: only the owning chip writes ---
+            if verify:
+                p = pos_[:, None] + jnp.arange(S)[None]        # [B, S]
+                valid = ((jnp.arange(S)[None] < ql[:, None])
+                         & (p < maxp * page))
+                streams = (jnp.arange(B) * hkv)[:, None, None] \
+                    + jnp.arange(hkv)[None, None, :]
+                pidx_g = tbl[streams,
+                             jnp.minimum(p // page, maxp - 1)[:, :, None]]
+                owned_w = valid[:, :, None] & ((pidx_g // pps) == me)
+                dest = jnp.where(owned_w, pidx_g - me * pps, NP_loc)
+                r = (p % page)[:, :, None]
+                k_rows, v_rows = k, v
+            else:
+                pos_x = jnp.repeat(pos_, hkv)                  # [X]
+                pidx_g = tbl[jnp.arange(X), pos_x // page]
+                owned_w = (pidx_g // pps) == me
+                dest = jnp.where(owned_w, pidx_g - me * pps, NP_loc)
+                r = pos_x % page
+                k_rows = k.reshape(X, hd)
+                v_rows = v.reshape(X, hd)
+            if quant:
+                sk, sv = scales4[0][:, 0], scales4[1][:, 0]
+                k8, k_s = quantize_kv_int8(k_rows)
+                v8, v_s = quantize_kv_int8(v_rows)
+                ck = ck.at[dest, r].set(k8)
+                cv = cv.at[dest, r].set(v8)
+                sk = sk.at[dest, r].set(k_s)
+                sv = sv.at[dest, r].set(v_s)
+            else:
+                ck = ck.at[dest, r].set(k_rows.astype(ck.dtype))
+                cv = cv.at[dest, r].set(v_rows.astype(cv.dtype))
+                sk = sv = None
+            lens = pos_ + (ql if verify else 1)
+            # --- local redirected table + per-tile ownership mask:
+            # non-owned tiles repeat the last owned local page (their
+            # surplus DMAs elide) and mask to accumulator no-ops ---
+            owned_t = (tbl // pps) == me                   # [X, maxp]
+            ti = jax.lax.broadcasted_iota(jnp.int32, (X, maxp), 1)
+            lastown = jax.lax.cummax(jnp.where(owned_t, ti, -1), axis=1)
+            tbl_loc = jnp.take_along_axis(
+                jnp.where(owned_t, tbl - me * pps, 0),
+                jnp.maximum(lastown, 0), axis=1)
+            qd = jnp.bfloat16 if quant else ck.dtype
+            acc, m, l = flash_decode_paged_partial(
+                q.astype(qd), ck, cv, tbl_loc, kv_lens=lens,
+                q_lens=ql, scale=scale,
+                tile_owned=owned_t.astype(jnp.int32),
+                k_scale=sk, v_scale=sv)
+            o = sp_combine_partials(acc, m, l, axis=sp_axis, n=nsp,
+                                    combine=combine, collective_id=cid,
+                                    out_dtype=jnp.float32)
+            o = o.reshape(M, hq * hd).astype(qkv_loc.dtype)
+            if quant:
+                return (o, ck[:, None], cv[:, None],
+                        sk[:, None], sv[:, None])
+            return o, ck[:, None], cv[:, None]
+
+        args = (qkv,) + tuple(kv) + (table, jnp.asarray(pos, jnp.int32))
+        if verify:
+            args = args + (jnp.asarray(q_lens, jnp.int32),)
+        out = f(*args)
+        return out[0], tuple(out[1:])
+
+    def fwd_cached_slots_paged_sp(self, x, cos, sin, batch: int, kv,
+                                  table, pos, sp_axis: str,
+                                  mode: str = "flash",
+                                  combine: str = "xla"):
+        """Slot-masked decode attention block over the SP-sharded
+        paged pool (sequence-parallel long-context serving): same
+        contract as fwd_cached_slots_paged, with each chip walking
+        only its local pages and the partial-softmax LSE combine
+        merging across the sp axis (_attend_paged_slots_sp)."""
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_paged_slots_sp(qkv, cos, sin, batch, kv,
+                                            table, pos, None, sp_axis,
+                                            combine)
+        return self._o_proj(o, mode), kv
+
+    def fwd_cached_slots_paged_verify_sp(self, x, cos, sin, batch: int,
+                                         kv, table, pos, q_lens,
+                                         sp_axis: str,
+                                         mode: str = "flash",
+                                         combine: str = "xla"):
+        """Speculative-verify / chunked-prefill window attention over
+        the SP-sharded paged pool: fwd_cached_slots_paged_verify's
+        contract through the split-KV partial + cross-chip LSE merge
+        (_attend_paged_slots_sp with per-slot q_lens)."""
+        qkv = self._qkv_proj(x, mode)
+        o, kv = self._attend_paged_slots_sp(qkv, cos, sin, batch, kv,
+                                            table, pos, q_lens, sp_axis,
+                                            combine)
+        return self._o_proj(o, mode), kv
+
     def fwd_cached_slots_paged_verify(self, x, cos, sin, batch: int, kv,
                                       table, pos, q_lens,
                                       mode: str = "flash"):

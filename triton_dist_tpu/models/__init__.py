@@ -34,8 +34,8 @@ class AutoLLM:
         if cfg.is_moe:
             from triton_dist_tpu.models.qwen_moe import Qwen3MoE
             return Qwen3MoE.from_hf(path, mesh, axis, **kw)
-        assert not kw, f"MoE-only kwargs {kw} on a dense config"
-        return DenseLLM.from_hf(path, mesh, axis)
+        _dense_kw_check(kw)
+        return DenseLLM.from_hf(path, mesh, axis, **kw)
 
     @staticmethod
     def from_config(cfg: ModelConfig, mesh, axis: str = "tp", seed: int = 0,
@@ -43,5 +43,12 @@ class AutoLLM:
         if cfg.is_moe:
             from triton_dist_tpu.models.qwen_moe import Qwen3MoE
             return Qwen3MoE.random_init(cfg, mesh, axis, seed, **kw)
-        assert not kw, f"MoE-only kwargs {kw} on a dense config"
-        return DenseLLM.random_init(cfg, mesh, axis, seed)
+        _dense_kw_check(kw)
+        return DenseLLM.random_init(cfg, mesh, axis, seed, **kw)
+
+
+def _dense_kw_check(kw) -> None:
+    """Dense models take the sequence-parallel kwargs only (the sp
+    serving layout — models/dense.py); everything else is MoE-only."""
+    extra = set(kw) - {"sp_axis", "sp_combine"}
+    assert not extra, f"MoE-only kwargs {sorted(extra)} on a dense config"

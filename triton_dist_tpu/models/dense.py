@@ -44,6 +44,23 @@ class DenseLLM:
     config: ModelConfig = dataclasses.field(metadata=dict(static=True))
     mesh: Mesh = dataclasses.field(metadata=dict(static=True))
     axis: str = dataclasses.field(metadata=dict(static=True))
+    # SEQUENCE-PARALLEL serving (long-context — kv_cache.PagedSlotCache
+    # SP SHARDING): the mesh axis the paged pool's page-id space
+    # shards over (None = single-chip pools). The paged slot forwards
+    # then attend through the split-KV partial + cross-chip LSE
+    # combine (layers/tp_attn.py fwd_cached_slots_paged_sp);
+    # sp_combine picks the merge ("xla" = all_gather + lse_combine,
+    # "dist" = the one-sided Pallas push kernel of
+    # kernels/sp_flash_decode.py).
+    sp_axis: Optional[str] = dataclasses.field(
+        default=None, metadata=dict(static=True))
+    sp_combine: str = dataclasses.field(
+        default="xla", metadata=dict(static=True))
+
+    @property
+    def sp_size(self) -> int:
+        """Sequence-parallel mesh size (1 = no page sharding)."""
+        return self.mesh.shape[self.sp_axis] if self.sp_axis else 1
 
     # ------------------------------------------------------------------
     # construction
@@ -51,10 +68,16 @@ class DenseLLM:
 
     @staticmethod
     def random_init(cfg: ModelConfig, mesh: Mesh, axis: str = "tp",
-                    seed: int = 0) -> "DenseLLM":
+                    seed: int = 0, sp_axis: Optional[str] = None,
+                    sp_combine: str = "xla") -> "DenseLLM":
         """Random weights with Qwen3 shapes — the harness/test model.
         Generated device-side (jax.random): host-numpy generation of
-        billion-parameter models takes minutes on one core."""
+        billion-parameter models takes minutes on one core.
+
+        sp_axis: mesh axis for SEQUENCE-PARALLEL paged serving (the
+        long-context layout — weights replicate over it, only the
+        paged pool shards; build the mesh as e.g.
+        jax.make_mesh((1, 4), ("tp", "sp")) and pass sp_axis="sp")."""
         key = jax.random.key(seed)
         D, I = cfg.hidden_size, cfg.intermediate_size
         Hq, Hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -87,10 +110,13 @@ class DenseLLM:
             final_norm=jnp.ones((D,), dt),
             lm_head=(embed.T if cfg.tie_word_embeddings
                      else w(D, cfg.vocab_size, scale=0.02)),
-            cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis)
+            cos=cos, sin=sin, config=cfg, mesh=mesh, axis=axis,
+            sp_axis=sp_axis, sp_combine=sp_combine)
 
     @staticmethod
-    def from_hf(path: str, mesh: Mesh, axis: str = "tp") -> "DenseLLM":
+    def from_hf(path: str, mesh: Mesh, axis: str = "tp",
+                sp_axis: Optional[str] = None,
+                sp_combine: str = "xla") -> "DenseLLM":
         """Load HF Qwen3 safetensors and shard at load (reference:
         models/dense.py:150-168). Requires a local checkpoint dir."""
         from safetensors import safe_open
@@ -138,7 +164,8 @@ class DenseLLM:
         return DenseLLM(embed=embed, layers=tuple(layers),
                         final_norm=t("model.norm.weight"),
                         lm_head=lm_head, cos=cos, sin=sin, config=cfg,
-                        mesh=mesh, axis=axis)
+                        mesh=mesh, axis=axis, sp_axis=sp_axis,
+                        sp_combine=sp_combine)
 
     def quantize_int8(self) -> "DenseLLM":
         """Weight-only int8 copy for the bandwidth-bound decode regime
@@ -282,9 +309,15 @@ class DenseLLM:
         x = self.embed[ids].reshape(B * S, self.config.hidden_size)
         for li, layer in enumerate(self.layers):
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, kv = layer.attn.fwd_cached_slots_paged_verify(
-                h, self.cos, self.sin, B, pcache.layer(li),
-                pcache.table, pos, q_lens, mode)
+            if self.sp_axis is not None:
+                a, kv = layer.attn.fwd_cached_slots_paged_verify_sp(
+                    h, self.cos, self.sin, B, pcache.layer(li),
+                    pcache.table, pos, q_lens, self.sp_axis, mode,
+                    self.sp_combine)
+            else:
+                a, kv = layer.attn.fwd_cached_slots_paged_verify(
+                    h, self.cos, self.sin, B, pcache.layer(li),
+                    pcache.table, pos, q_lens, mode)
             pcache = pcache.set_layer(li, *kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)
@@ -312,9 +345,17 @@ class DenseLLM:
         x = self.embed[ids].reshape(B, self.config.hidden_size)
         for li, layer in enumerate(self.layers):
             h = rms_norm(x, layer.ln_attn, self.config.rms_norm_eps)
-            a, kv = layer.attn.fwd_cached_slots_paged(
-                h, self.cos, self.sin, B, pcache.layer(li),
-                pcache.table, pos, mode)
+            if self.sp_axis is not None:
+                # sequence-parallel paged decode: each chip walks its
+                # own page shard, partials LSE-merge across sp
+                a, kv = layer.attn.fwd_cached_slots_paged_sp(
+                    h, self.cos, self.sin, B, pcache.layer(li),
+                    pcache.table, pos, self.sp_axis, mode,
+                    self.sp_combine)
+            else:
+                a, kv = layer.attn.fwd_cached_slots_paged(
+                    h, self.cos, self.sin, B, pcache.layer(li),
+                    pcache.table, pos, mode)
             pcache = pcache.set_layer(li, *kv)
             x = x + a
             h = rms_norm(x, layer.ln_mlp, self.config.rms_norm_eps)

@@ -73,6 +73,36 @@ class Engine:
             raise ValueError(f"unknown backend {backend!r}; this engine "
                              f"serves {known}")
         self.moe_family = bool(getattr(model.config, "is_moe", False))
+        # SEQUENCE-PARALLEL serving (long-context — the sp-sharded
+        # paged pool, kv_cache.PagedSlotCache SP SHARDING): capability
+        # gates live HERE, at construction, naming what is missing —
+        # the PR-13 pattern — instead of shape errors deep in jit.
+        sp_ax = getattr(model, "sp_axis", None)
+        self.sp_size = int(model.mesh.shape[sp_ax]) if sp_ax else 1
+        if self.sp_size > 1:
+            tp = model.mesh.shape[model.axis]
+            if tp > 1:
+                raise ValueError(
+                    f"sequence-parallel serving (sp_axis={sp_ax!r}, "
+                    f"size {self.sp_size}) cannot combine with a TP "
+                    f"head-group split (axis {model.axis!r}, size "
+                    f"{tp}) yet (missing capability: sp + TP hybrid "
+                    f"paged pool) — size one of the axes to 1")
+            if backend == "mega":
+                raise ValueError(
+                    "backend='mega' fuses the paged tick single-chip "
+                    "only; the sp-sharded pool's split-KV partial + "
+                    "cross-chip LSE combine stay on the per-op "
+                    "shard_map path (missing capability: megakernel "
+                    "sp combine) — serve sp meshes with "
+                    "backend='flash'")
+            if backend not in ("flash",):
+                raise ValueError(
+                    f"backend={backend!r} routes projections through "
+                    f"the TP comm kernels; sequence-parallel serving "
+                    f"replicates weights over the sp axis and serves "
+                    f"on backend='flash' (missing capability: sp + "
+                    f"comm-kernel hybrid projections)")
         if backend in ("ep", "ep_flash"):
             if not self.moe_family:
                 raise ValueError(
@@ -340,6 +370,13 @@ class Engine:
 
     def make_slot_cache(self, batch: int):
         """Fresh cache whose batch rows are independent decode SLOTS."""
+        if self.sp_size > 1:
+            raise ValueError(
+                "sequence-parallel serving shards the PAGE-ID space — "
+                "contiguous slot caches have no pages to shard "
+                "(missing capability: sp contiguous slots); construct "
+                "ContinuousScheduler(paged=True) so the sp pool "
+                "serves through the partial+LSE-combine attends")
         self._moe_batch_check(batch)
         return self.model.make_cache(batch, self.max_seq,
                                      dtype=self.kv_dtype)
@@ -621,8 +658,8 @@ class Engine:
         their KV scatter and attention through the table just
         installed). Same rows/cow contract as admit_slot_paged."""
         return self._paged_install(
-            pcache, jnp.asarray(rows, jnp.int32), jnp.int32(slot),
-            jnp.asarray(cow_src, jnp.int32),
+            self.model, pcache, jnp.asarray(rows, jnp.int32),
+            jnp.int32(slot), jnp.asarray(cow_src, jnp.int32),
             jnp.asarray(cow_dst, jnp.int32), jnp.int32(cow_rows))
 
     # ------------------------------------------------------------------
@@ -694,13 +731,26 @@ class Engine:
                 f"size divides {cfg.num_kv_heads}, or replicate kv "
                 f"heads in the checkpoint")
         maxp = -(-self.max_seq // page)
+        sp_ax = getattr(self.model, "sp_axis", None)
         if num_pages is None:
             num_pages = batch * cfg.num_kv_heads * maxp + 1
+            if self.sp_size > 1:
+                # the default rounds UP to the sp partition (each chip
+                # owns a whole contiguous id block)
+                num_pages = -(-num_pages // self.sp_size) * self.sp_size
+        elif self.sp_size > 1 and num_pages % self.sp_size:
+            raise ValueError(
+                f"sequence-parallel pool needs num_pages ({num_pages}) "
+                f"divisible by the sp mesh size ({self.sp_size}): the "
+                f"page-id space partitions into equal per-chip blocks "
+                f"— round num_pages up to a multiple of {self.sp_size} "
+                f"or shrink the sp axis")
         return PagedSlotCache.create(
             cfg.num_layers, batch, self.max_seq, cfg.num_kv_heads,
             cfg.head_dim, page=page, num_pages=num_pages,
             mesh=self.model.mesh, axis=self.model.axis,
-            dtype=self.kv_dtype or cfg.jax_dtype)
+            dtype=self.kv_dtype or cfg.jax_dtype,
+            sp_axis=sp_ax if self.sp_size > 1 else None)
 
     def admit_slot_paged(self, pcache, slot: int, ids, rows,
                          kv_start: int, cow_src, cow_dst, cow_rows: int,
@@ -839,7 +889,7 @@ class Engine:
         if heads is not None and G > 1:
             hkv_loc = self.model.config.num_kv_heads // G
             owners[:n] = np.asarray(heads, np.int32) // hkv_loc
-        out = self._gather_pages(pcache, jnp.asarray(padded),
+        out = self._gather_pages(self.model, pcache, jnp.asarray(padded),
                                  jnp.asarray(owners))
         # one device_get over every array: the K/V (and scale) d2h
         # transfers overlap instead of serializing on the eviction
@@ -883,7 +933,8 @@ class Engine:
             hsk[:, :n] = host_ks
             hsv[:, :n] = host_vs
             hsk, hsv = jnp.asarray(hsk), jnp.asarray(hsv)
-        return self._restore_pages(pcache, jnp.asarray(padded),
+        return self._restore_pages(self.model, pcache,
+                                   jnp.asarray(padded),
                                    jnp.asarray(hk), jnp.asarray(hv),
                                    hsk, hsv)
 
@@ -1014,9 +1065,9 @@ def _jit_programs(backend: str, sampling: str, pkey: tuple,
         functools.partial(_mixed_verify_fn, fb, samp, params,
                           True),
         donate_argnums=(1,))
-    P["paged_install"] = jax.jit(_paged_install_fn, donate_argnums=(0,))
+    P["paged_install"] = jax.jit(_paged_install_fn, donate_argnums=(1,))
     P["gather_pages"] = jax.jit(_gather_pages_fn)
-    P["restore_pages"] = jax.jit(_restore_pages_fn, donate_argnums=(0,))
+    P["restore_pages"] = jax.jit(_restore_pages_fn, donate_argnums=(1,))
     return P
 
 
@@ -1406,7 +1457,100 @@ def _pool_scatter_heads(mesh, axis, pool, dest, ri, u):
     return f(pool, dest, ri, u)
 
 
-def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
+def _sp_owned_local(ids, pps, me, *, oob=None):
+    """THE sp page-id partition rule, one copy (mirrored device-side
+    by layers/tp_attn._attend_paged_slots_sp): global page id p lives
+    on shard p // pps in contiguous blocks. Returns (owned mask,
+    local ids) — for GATHERS (oob=None) non-owned ids clamp in range
+    (their values are masked to zero before the psum); for SCATTERS
+    (oob=<local pool size>) they redirect out of range so the write
+    drops."""
+    owned = (ids // pps) == me
+    if oob is None:
+        loc = jnp.clip(ids - me * pps, 0, pps - 1)
+    else:
+        loc = jnp.where(owned, ids - me * pps, oob)
+    return owned, loc
+
+
+def _pool_gather_sp(mesh, sp_axis, pool, rows):
+    """Page gather on the SP-sharded pool (the admit program's prefix
+    read — kv_cache.PagedSlotCache SP SHARDING): rows [Hkv, maxp]
+    GLOBAL page ids -> the mapped pages' bytes [Hkv, maxp*page(, d)]
+    REPLICATED over sp. Each chip reads the pages it owns (others
+    contribute zeros) and one psum assembles the full span — traffic
+    is exactly the gathered bytes, never the pool (floats sum x+0+...
+    exactly, so the assembly is bitwise)."""
+    from jax.sharding import PartitionSpec as P
+    if pool.ndim == 4:
+        in_p, out_p = P(sp_axis, None, None, None), P(None, None, None)
+    else:
+        in_p, out_p = P(sp_axis, None, None), P(None, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(in_p, P(None, None)), out_specs=out_p,
+                       check_vma=False)
+    def f(p_loc, rows_loc):
+        pps = p_loc.shape[0]
+        me = jax.lax.axis_index(sp_axis)
+        owned, loc = _sp_owned_local(rows_loc, pps, me)
+        g = p_loc[:, 0][loc]             # [Hkv, maxp, page(, d)]
+        mask = owned.reshape(owned.shape + (1,) * (g.ndim - 2))
+        g = jnp.where(mask, g, 0).astype(p_loc.dtype)
+        g = jax.lax.psum(g, sp_axis)
+        return g.reshape((g.shape[0], -1) + g.shape[3:])
+
+    return f(pool, rows)
+
+
+def _pool_scatter_sp(mesh, sp_axis, pool, dest, ri, u):
+    """Page-row scatter on the SP-sharded pool (the admit program's
+    suffix write-back): u [Hkv, S(, d)] replicated rows land at
+    (dest [Hkv, S] GLOBAL page ids, ri [S] in-page rows). Each chip
+    writes ONLY the pages it owns — non-owned (and deliberately
+    out-of-range) destinations redirect past the local shard and the
+    scatter drops them, so the write is comm-free. Global trash ids
+    land in shard 0's local trash page, the sanctioned sink."""
+    from jax.sharding import PartitionSpec as P
+    if pool.ndim == 4:
+        in_p, u_p = P(sp_axis, None, None, None), P(None, None, None)
+    else:
+        in_p, u_p = P(sp_axis, None, None), P(None, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(in_p, P(None, None), P(None), u_p),
+                       out_specs=in_p, check_vma=False)
+    def f(p_loc, dest_loc, ri_, u_loc):
+        pps = p_loc.shape[0]
+        me = jax.lax.axis_index(sp_axis)
+        _, loc = _sp_owned_local(dest_loc, pps, me, oob=pps)
+        p2 = p_loc[:, 0].at[loc, ri_[None]].set(
+            u_loc.astype(p_loc.dtype))
+        return p2[:, None]
+
+    return f(pool, dest, ri, u)
+
+
+def _cow_pages_sp(mesh, sp_axis, pool, cow_src, cow_dst, cow_r, page):
+    """Boundary-page copy-on-write on the SP-sharded pool: the source
+    group's valid rows [0, cow_r) copy into the destination group —
+    src and dst may live on DIFFERENT shards (the allocator rotates
+    fresh groups), so the copy is one owned-page gather (+psum) and
+    one owned-page scatter. cow_r == 0 (page-aligned match) writes
+    nothing: every destination redirects out of range."""
+    NP = pool.shape[0]
+    src = _pool_gather_sp(mesh, sp_axis, pool, cow_src[:, None])
+    # [Hkv, page(, d)] — the boundary page's bytes, replicated
+    if pool.ndim == 4:
+        src = src.reshape(cow_src.shape[0], page, -1)
+    dest = jnp.where(jnp.arange(page)[None, :] < cow_r,
+                     cow_dst[:, None], NP)        # global OOB = no-op
+    return _pool_scatter_sp(mesh, sp_axis, pool, dest,
+                            jnp.arange(page), src)
+
+
+def _paged_install_fn(model, pcache, rows, slot, cow_src, cow_dst,
+                      cow_r):
     """Table install + boundary-page copy-on-write for a CHUNKED paged
     admission (chunk 0): exactly the pre-forward half of
     _paged_admit_fn. The CoW must happen before ANY chunk forward reads
@@ -1418,10 +1562,22 @@ def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
     TP pool ([NP, G, page, d]): the CoW copies ALL G planes of the
     boundary page — only the owning head's plane holds real bytes, but
     copying the others' garbage is harmless (never read) and keeps the
-    copy a plain plane-aligned gather/scatter GSPMD keeps local."""
+    copy a plain plane-aligned gather/scatter GSPMD keeps local.
+
+    SP pool (model.sp_axis set — the page-id space sharded over sp):
+    src and dst groups may live on different chips, so the CoW runs as
+    one owned-page gather + one owned-page scatter (_cow_pages_sp).
+
+    `model` rides in ONLY for the mesh/sp_axis statics (its weights
+    are dead arguments XLA prunes): a Mesh cannot live on the cache as
+    static aux — the AOT exporter JSON-encodes pytree auxdata
+    (tools/aot.py), and Mesh has no JSON form — so the three
+    cache-movement programs (install/gather/restore) take the model
+    like every other serving program does."""
     import dataclasses
     page = pcache.page
     Hkv = rows.shape[0]
+    sp_ax = getattr(model, "sp_axis", None) if pcache.sp > 1 else None
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
     rowmask = (jnp.arange(page) < cow_r)[None, None, :, None]
@@ -1429,16 +1585,28 @@ def _paged_install_fn(pcache, rows, slot, cow_src, cow_dst, cow_r):
     pk, pv, psk, psv = [], [], [], []
     for li in range(len(pcache.pages_k)):
         k, v = pcache.pages_k[li], pcache.pages_v[li]
-        pk.append(k.at[cow_dst].set(
-            jnp.where(rowmask, k[cow_src], k[cow_dst])))
-        pv.append(v.at[cow_dst].set(
-            jnp.where(rowmask, v[cow_src], v[cow_dst])))
+        if sp_ax is not None:
+            pk.append(_cow_pages_sp(model.mesh, sp_ax, k, cow_src,
+                                    cow_dst, cow_r, page))
+            pv.append(_cow_pages_sp(model.mesh, sp_ax, v, cow_src,
+                                    cow_dst, cow_r, page))
+        else:
+            pk.append(k.at[cow_dst].set(
+                jnp.where(rowmask, k[cow_src], k[cow_dst])))
+            pv.append(v.at[cow_dst].set(
+                jnp.where(rowmask, v[cow_src], v[cow_dst])))
         if pcache.scales_k:
             s_k, s_v = pcache.scales_k[li], pcache.scales_v[li]
-            psk.append(s_k.at[cow_dst].set(
-                jnp.where(rowmask2, s_k[cow_src], s_k[cow_dst])))
-            psv.append(s_v.at[cow_dst].set(
-                jnp.where(rowmask2, s_v[cow_src], s_v[cow_dst])))
+            if sp_ax is not None:
+                psk.append(_cow_pages_sp(model.mesh, sp_ax, s_k,
+                                         cow_src, cow_dst, cow_r, page))
+                psv.append(_cow_pages_sp(model.mesh, sp_ax, s_v,
+                                         cow_src, cow_dst, cow_r, page))
+            else:
+                psk.append(s_k.at[cow_dst].set(
+                    jnp.where(rowmask2, s_k[cow_src], s_k[cow_dst])))
+                psv.append(s_v.at[cow_dst].set(
+                    jnp.where(rowmask2, s_v[cow_src], s_v[cow_dst])))
     return dataclasses.replace(pcache, pages_k=tuple(pk),
                                pages_v=tuple(pv), scales_k=tuple(psk),
                                scales_v=tuple(psv), table=table)
@@ -1469,13 +1637,24 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
     own kv heads' page bytes between its pool plane and its shard of
     the (head-sharded) contiguous scratch, so the whole admission
     stays ONE sharded program with zero cross-chip page traffic; the
-    CoW copies all planes (garbage planes are never read)."""
+    CoW copies all planes (garbage planes are never read).
+
+    SP pool (model.sp_axis — the page-id space sharded over sp,
+    kv_cache.PagedSlotCache SP SHARDING): the prefix gather assembles
+    each chip's owned pages with one psum (_pool_gather_sp — traffic
+    is the gathered span, never the pool), the suffix forward runs on
+    the replicated contiguous scratch, and the suffix scatter is
+    comm-free (each chip keeps only the rows of pages it owns,
+    _pool_scatter_sp); the boundary CoW crosses shards as a gather +
+    scatter (the allocator rotates groups, so src and dst need not be
+    co-resident)."""
     import dataclasses
     page = pcache.page
     Hkv, maxp = rows.shape
     T_pool = maxp * page
     d = pcache.pages_k[0].shape[3]
     mesh, axis = model.mesh, model.axis
+    sp_ax = getattr(model, "sp_axis", None) if pcache.sp > 1 else None
     quant = bool(pcache.scales_k)
     table = jax.lax.dynamic_update_slice(pcache.table, rows,
                                          (slot * Hkv, 0))
@@ -1487,28 +1666,42 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
     pi = jnp.minimum(p // page, maxp - 1)
     ri = p % page
     dest = jnp.where(valid[None], rows[:, pi], pcache.trash)  # [Hkv, S_pad]
+
+    def cow(pool, mask):
+        if sp_ax is not None:
+            return _cow_pages_sp(mesh, sp_ax, pool, cow_src, cow_dst,
+                                 cow_r, page)
+        return pool.at[cow_dst].set(
+            jnp.where(mask, pool[cow_src], pool[cow_dst]))
+
+    def gather(pool):
+        if sp_ax is not None:
+            return _pool_gather_sp(mesh, sp_ax, pool, rows)
+        return _pool_gather_heads(mesh, axis, pool, rows)
+
+    def scatter(pool, u):
+        if sp_ax is not None:
+            return _pool_scatter_sp(mesh, sp_ax, pool, dest, ri, u)
+        return _pool_scatter_heads(mesh, axis, pool, dest, ri, u)
+
     pk, pv = list(pcache.pages_k), list(pcache.pages_v)
     psk, psv = list(pcache.scales_k), list(pcache.scales_v)
     sk, sv = list(scratch.k), list(scratch.v)
     ssk, ssv = list(scratch.ks), list(scratch.vs)
     for li in range(len(pk)):
-        pk[li] = pk[li].at[cow_dst].set(
-            jnp.where(rowmask, pk[li][cow_src], pk[li][cow_dst]))
-        pv[li] = pv[li].at[cow_dst].set(
-            jnp.where(rowmask, pv[li][cow_src], pv[li][cow_dst]))
-        kf = _pool_gather_heads(mesh, axis, pk[li], rows)[None]
-        vf = _pool_gather_heads(mesh, axis, pv[li], rows)[None]
+        pk[li] = cow(pk[li], rowmask)
+        pv[li] = cow(pv[li], rowmask)
+        kf = gather(pk[li])[None]
+        vf = gather(pv[li])[None]
         sk[li] = jax.lax.dynamic_update_slice(
             sk[li], kf.astype(sk[li].dtype), (0, 0, 0, 0))
         sv[li] = jax.lax.dynamic_update_slice(
             sv[li], vf.astype(sv[li].dtype), (0, 0, 0, 0))
         if quant:
-            psk[li] = psk[li].at[cow_dst].set(
-                jnp.where(rowmask2, psk[li][cow_src], psk[li][cow_dst]))
-            psv[li] = psv[li].at[cow_dst].set(
-                jnp.where(rowmask2, psv[li][cow_src], psv[li][cow_dst]))
-            ksf = _pool_gather_heads(mesh, axis, psk[li], rows)[None]
-            vsf = _pool_gather_heads(mesh, axis, psv[li], rows)[None]
+            psk[li] = cow(psk[li], rowmask2)
+            psv[li] = cow(psv[li], rowmask2)
+            ksf = gather(psk[li])[None]
+            vsf = gather(psv[li])[None]
             ssk[li] = jax.lax.dynamic_update_slice(ssk[li], ksf,
                                                    (0, 0, 0))
             ssv[li] = jax.lax.dynamic_update_slice(ssv[li], vsf,
@@ -1524,17 +1717,15 @@ def _paged_admit_fn(model, ids, scratch, pcache, rows, slot, m, n,
                                    (1, Hkv, S_pad, d))[0]
         vs = jax.lax.dynamic_slice(scratch.v[li], (0, 0, m, 0),
                                    (1, Hkv, S_pad, d))[0]
-        pk2.append(_pool_scatter_heads(mesh, axis, pk[li], dest, ri, ks))
-        pv2.append(_pool_scatter_heads(mesh, axis, pv[li], dest, ri, vs))
+        pk2.append(scatter(pk[li], ks))
+        pv2.append(scatter(pv[li], vs))
         if quant:
             kss = jax.lax.dynamic_slice(scratch.ks[li], (0, 0, m),
                                         (1, Hkv, S_pad))[0]
             vss = jax.lax.dynamic_slice(scratch.vs[li], (0, 0, m),
                                         (1, Hkv, S_pad))[0]
-            psk2.append(_pool_scatter_heads(mesh, axis, psk[li], dest,
-                                            ri, kss))
-            psv2.append(_pool_scatter_heads(mesh, axis, psv[li], dest,
-                                            ri, vss))
+            psk2.append(scatter(psk[li], kss))
+            psv2.append(scatter(psv[li], vss))
     pcache = dataclasses.replace(pcache, pages_k=tuple(pk2),
                                  pages_v=tuple(pv2),
                                  scales_k=tuple(psk2),
@@ -1550,7 +1741,7 @@ def _paged_set_table_fn(pcache, rows, slot):
     return dataclasses.replace(pcache, table=table)
 
 
-def _gather_pages_fn(pcache, ids, owners):
+def _gather_pages_fn(model, pcache, ids, owners):
     """Host-tier demotion gather: the listed pages of every layer's
     pool, stacked [L, N, page, d] (one program per id-bucket shape).
     An int8 pool also gathers the scale planes [L, N, page] — a
@@ -1561,11 +1752,24 @@ def _gather_pages_fn(pcache, ids, owners):
     head-ordered); the gather selects that plane, so the returned
     bytes are the TRUE payload whatever the mesh (take_along_axis
     moves bytes — no arithmetic — so the d2h/h2d round trip stays
-    bitwise on sharded pools)."""
-    def pick(p):
-        g = p[ids]                            # [N, G, page(, d)]
-        idx = owners.reshape((-1, 1) + (1,) * (g.ndim - 2))
-        return jnp.take_along_axis(g, idx, axis=1)[:, 0]
+    bitwise on sharded pools).
+
+    SP pool: a demoted span's pages live on S different chips (the
+    allocator rotates groups), so ONE span is assembled from S
+    per-chip contributions — each chip supplies the pages it owns and
+    a psum puts the span together (_pool_gather_sp's rule: x + 0 + ..
+    is exact, the round trip stays bitwise)."""
+    if pcache.sp > 1:
+        # the SAME owned-gather + psum program the admit path uses
+        # (_pool_gather_sp — a flat id list is a [N, 1] rows block)
+        def pick(p):
+            return _pool_gather_sp(model.mesh, model.sp_axis, p,
+                                   ids[:, None])
+    else:
+        def pick(p):
+            g = p[ids]                        # [N, G, page(, d)]
+            idx = owners.reshape((-1, 1) + (1,) * (g.ndim - 2))
+            return jnp.take_along_axis(g, idx, axis=1)[:, 0]
 
     k = jnp.stack([pick(p) for p in pcache.pages_k])
     v = jnp.stack([pick(p) for p in pcache.pages_v])
@@ -1576,7 +1780,7 @@ def _gather_pages_fn(pcache, ids, owners):
     return k, v
 
 
-def _restore_pages_fn(pcache, ids, hk, hv, hsk=None, hsv=None):
+def _restore_pages_fn(model, pcache, ids, hk, hv, hsk=None, hsv=None):
     """Host-tier promotion scatter: write hk/hv [L, N, page, d] into
     the listed pages of every layer's pool (donated). Padded tail ids
     all point at the trash page — duplicate scatter targets there are
@@ -1587,13 +1791,29 @@ def _restore_pages_fn(pcache, ids, hk, hv, hsk=None, hsv=None):
     each restored page — the owning plane gets the true bytes and the
     others hold copies nothing ever reads (freshly allocated pages are
     garbage until written anyway), which keeps the scatter plane-
-    aligned and comm-free instead of needing per-rank owner masks."""
-    import dataclasses
+    aligned and comm-free instead of needing per-rank owner masks.
 
-    def put(p, h):
-        u = jnp.broadcast_to(h[:, None],
-                             (h.shape[0], p.shape[1]) + h.shape[1:])
-        return p.at[ids].set(u.astype(p.dtype))
+    SP pool: each chip keeps only the pages it owns (non-owned ids
+    redirect out of local range and drop) — a restored span scatters
+    back onto its S chips comm-free, the inverse of the gather."""
+    import dataclasses
+    sp_ax = model.sp_axis if pcache.sp > 1 else None
+
+    if sp_ax is not None:
+        # the SAME owned-scatter program the admit path uses
+        # (_pool_scatter_sp): a whole-page install is the row scatter
+        # with every in-page row addressed
+        def put(p, h):
+            page = p.shape[2]
+            dest = jnp.broadcast_to(ids[:, None],
+                                    (ids.shape[0], page))
+            return _pool_scatter_sp(model.mesh, sp_ax, p, dest,
+                                    jnp.arange(page), h)
+    else:
+        def put(p, h):
+            u = jnp.broadcast_to(h[:, None],
+                                 (h.shape[0], p.shape[1]) + h.shape[1:])
+            return p.at[ids].set(u.astype(p.dtype))
 
     pk = tuple(put(p, hk[li]) for li, p in enumerate(pcache.pages_k))
     pv = tuple(put(p, hv[li]) for li, p in enumerate(pcache.pages_v))

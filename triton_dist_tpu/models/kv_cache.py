@@ -28,7 +28,7 @@ KV (per-row attention lengths mask the rest).
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -165,7 +165,30 @@ class PagedSlotCache:
     preemption, host tier) is oblivious to WHICH program walks the
     pool; the engine swaps the tick per poll
     (engine.paged_slot_chunk). The fused tick is single-plane by
-    contract: TP pools (G > 1) stay on the per-op shard_map path."""
+    contract: TP pools (G > 1) stay on the per-op shard_map path.
+
+    SP SHARDING (sequence-parallel long-context serving — ROADMAP
+    long-context item; the promotion of kernels/sp_flash_decode.py
+    into the serving path, Ring Attention arXiv:2310.01889 /
+    Infinite-LLM arXiv:2401.02669 being the deployment story): with
+    `sp` > 1 the PAGE-ID SPACE is partitioned — the pools' leading
+    [NP] axis shards over the sp mesh axis in contiguous blocks, chip
+    s holding physical pages [s*NP/S, (s+1)*NP/S) of EVERY layer, so
+    a slot's max context is bounded by the WHOLE mesh's paged HBM
+    instead of one chip's. The page table, allocator free lists,
+    refcounts, radix tree, CoW and host-tier bookkeeping stay
+    host-side and layout-blind exactly as under the TP head-group
+    split — the allocator (kernels/paged_kv.PageAllocator shards=)
+    merely rotates fresh groups across shards so consecutive logical
+    tiles interleave chips. A decode tick runs under shard_map with
+    each chip walking ONLY its local pages through the split-KV
+    partial kernel (kernels/paged_kv.flash_decode_paged_partial) and
+    the partials merging via the cross-chip LSE combine
+    (kernels/sp_flash_decode.sp_combine_partials): per-chip KV reads
+    and attention FLOPs drop to ~1/S. sp composes with int8 scale
+    planes (they shard alongside the payload) but not (yet) with the
+    TP head-group split or the fused megakernel tick — both refused
+    capability-named at Engine construction."""
 
     pages_k: Tuple[jax.Array, ...]   # L x [NP, G, page, d]
     pages_v: Tuple[jax.Array, ...]
@@ -175,12 +198,17 @@ class PagedSlotCache:
     scales_k: Tuple[jax.Array, ...] = ()
     scales_v: Tuple[jax.Array, ...] = ()
     trash: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # sp mesh size the pool's page-id space is partitioned over (1 =
+    # the historical single-shard pool; static so programs branch on
+    # it at trace time)
+    sp: int = dataclasses.field(default=1, metadata=dict(static=True))
 
     @staticmethod
     def create(num_layers: int, batch: int, max_seq: int, n_kv_heads: int,
                head_dim: int, *, page: int, num_pages: int, mesh: Mesh,
                axis: str = "tp", dtype=jnp.bfloat16,
-               trash: int = 0) -> "PagedSlotCache":
+               trash: int = 0,
+               sp_axis: Optional[str] = None) -> "PagedSlotCache":
         maxp = -(-max_seq // page)
         X = batch * n_kv_heads
         G = mesh.shape[axis]
@@ -192,14 +220,34 @@ class PagedSlotCache:
                 f"(Hq > Hkv) lives on the QUERY side and does not "
                 f"relax this — replicate KV heads in the checkpoint "
                 f"or shrink the mesh.")
-        shd = NamedSharding(mesh, P(None, axis, None, None))
+        sp = 1
+        if sp_axis is not None:
+            sp = mesh.shape[sp_axis]
+            if sp > 1 and G > 1:
+                raise ValueError(
+                    "paged pool cannot shard pages over "
+                    f"{sp_axis!r} AND kv-head groups over {axis!r} in "
+                    "one pool (missing capability: sp + TP hybrid "
+                    "serving) — size one of the axes to 1")
+            if num_pages % sp:
+                raise ValueError(
+                    f"sequence-parallel pool needs num_pages "
+                    f"({num_pages}) divisible by the sp mesh size "
+                    f"({sp}): each chip owns a contiguous block of the "
+                    f"page-id space — round num_pages up or shrink "
+                    f"the axis")
+        page_spec = (P(None, axis, None, None) if sp == 1
+                     else P(sp_axis, axis, None, None))
+        sc_spec = (P(None, axis, None) if sp == 1
+                   else P(sp_axis, axis, None))
+        shd = NamedSharding(mesh, page_spec)
         mk = lambda: tuple(
             jax.device_put(
                 jnp.zeros((num_pages, G, page, head_dim), dtype), shd)
             for _ in range(num_layers))
         sk = sv = ()
         if jnp.dtype(dtype) == jnp.int8:
-            s_shd = NamedSharding(mesh, P(None, axis, None))
+            s_shd = NamedSharding(mesh, sc_spec)
             mks = lambda: tuple(
                 jax.device_put(
                     jnp.zeros((num_pages, G, page), jnp.float32), s_shd)
@@ -209,7 +257,8 @@ class PagedSlotCache:
             jnp.full((X, maxp), trash, jnp.int32),
             NamedSharding(mesh, P(None, None)))
         return PagedSlotCache(pages_k=mk(), pages_v=mk(), table=table,
-                              scales_k=sk, scales_v=sv, trash=trash)
+                              scales_k=sk, scales_v=sv, trash=trash,
+                              sp=sp)
 
     @property
     def quantized(self) -> bool:
@@ -228,6 +277,13 @@ class PagedSlotCache:
         """The TP head-group axis G (mesh size at creation): payload
         plane g holds the bytes of kv-head group g's pages."""
         return self.pages_k[0].shape[1]
+
+    @property
+    def pages_per_shard(self) -> int:
+        """Physical pages per sp shard (== num_pages at sp == 1):
+        chip s owns ids [s*pps, (s+1)*pps) — the id partition the
+        allocator, the sp attends and the admit programs all share."""
+        return self.pages_k[0].shape[0] // self.sp
 
     @property
     def capacity(self) -> int:

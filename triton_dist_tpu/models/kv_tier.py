@@ -17,7 +17,14 @@ suffix prefill runs. Only the host tier's own LRU (bounded by
 `HostKVPool` is the host half: a bounded store of demoted page-group
 payloads (per-layer K/V extracted from the device pools, kept in the
 pool dtype so the d2h -> h2d round trip is BITWISE exact) with
-second-level LRU ordering and page-denominated accounting. It is
+second-level LRU ordering and page-denominated accounting. On a
+SEQUENCE-PARALLEL pool (ISSUE 14 — kv_cache.PagedSlotCache SP
+SHARDING) a demoted span is really S per-chip page sets: the d2h
+gather assembles each page from its owning sp shard (one psum of
+owned-or-zero contributions — exact) and the h2d restore scatters
+owned pages back comm-free (engine._gather_pages_fn /
+_restore_pages_fn sp branches), so the tier stays bitwise and
+layout-blind whatever the mesh. It is
 policy-free about tree structure — the residency state machine lives in
 `models/prefix_cache.py` (`_Node.host`, demote-on-evict,
 promote-on-match), which owns the handle -> node map and drives drops

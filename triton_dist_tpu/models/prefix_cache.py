@@ -101,12 +101,21 @@ class RefcountedPages:
     """Refcounting layer over the PageAllocator free list (the
     "physical page backs many tables" half of the design). The trash
     page is reserved at construction and never refcounted — it is the
-    write sink for retired slots, not storage."""
+    write sink for retired slots, not storage.
 
-    def __init__(self, num_pages: int, n_kv_heads: int):
-        self._alloc = PageAllocator(num_pages)
+    shards > 1 (sequence-parallel serving): the allocator partitions
+    the id space per sp shard and rotates fresh groups across shards
+    (kernels/paged_kv.PageAllocator) — this layer stays id-blind, it
+    only surfaces the per-shard accounting the telemetry and the
+    per-shard zero-leak invariant read."""
+
+    def __init__(self, num_pages: int, n_kv_heads: int,
+                 shards: int = 1):
+        self._alloc = PageAllocator(num_pages, shards=shards)
         self.n_kv_heads = n_kv_heads
         self._ref: Dict[int, int] = {}
+        # shard 0 allocates first, so the trash is page 0 of shard 0
+        # whatever the shard count
         self.trash = self._alloc.alloc(1)[0]
 
     @property
@@ -114,12 +123,38 @@ class RefcountedPages:
         return self._alloc.num_pages
 
     @property
+    def shards(self) -> int:
+        return self._alloc.shards
+
+    @property
+    def pages_per_shard(self) -> int:
+        return self._alloc.pages_per_shard
+
+    @property
     def available(self) -> int:
         return self._alloc.available
 
     @property
+    def available_by_shard(self):
+        return self._alloc.available_by_shard
+
+    @property
+    def outstanding_by_shard(self):
+        return self._alloc.outstanding_by_shard
+
+    @property
     def pages_in_use(self) -> int:
         return len(self._ref)
+
+    @property
+    def pages_in_use_by_shard(self):
+        """Refcounted (slot- or tree-referenced) pages per sp shard —
+        the `sp_pages_resident{shard=}` gauge; 0 on every shard at
+        idle IS the per-shard zero-leak invariant."""
+        out = [0] * self._alloc.shards
+        for p in self._ref:
+            out[self._alloc.shard_of(p)] += 1
+        return out
 
     @property
     def outstanding(self) -> int:
@@ -586,7 +621,7 @@ class PrefixCache:
 
     def __init__(self, num_pages: int, n_kv_heads: int, page: int, *,
                  enabled: bool = True, host_pool_pages: int = 0,
-                 fault=None, telemetry=None):
+                 fault=None, telemetry=None, shards: int = 1):
         """host_pool_pages > 0 attaches the host-RAM capacity tier
         (models/kv_tier.py): eviction demotes spans to a host pool of
         that many (device-page-sized) buffers instead of dropping, and
@@ -599,9 +634,17 @@ class PrefixCache:
         telemetry (runtime/telemetry.py): the hit/skip counters below
         live in its metrics registry — PagedDecodeSlots passes the
         scheduler's bundle so one stats() registry snapshot covers
-        the cache; a bare PrefixCache gets a private registry."""
+        the cache; a bare PrefixCache gets a private registry.
+
+        shards: the sp mesh size of a SEQUENCE-PARALLEL pool
+        (kv_cache.PagedSlotCache SP SHARDING) — the allocator then
+        partitions the page-id space per shard and rotates fresh
+        groups across shards, and stats() grows per-shard
+        `sp_pages_resident{shard=}` gauges (resident 0 on every shard
+        at idle is the per-shard zero-leak invariant)."""
         from triton_dist_tpu.runtime.telemetry import Telemetry
-        self.pool = RefcountedPages(num_pages, n_kv_heads)
+        self.pool = RefcountedPages(num_pages, n_kv_heads,
+                                    shards=shards)
         self.page = page
         self.enabled = enabled
         self.tele = telemetry if telemetry is not None else Telemetry()
@@ -713,6 +756,15 @@ class PrefixCache:
                     else HostKVPool.empty_stats())
             for k, v in host.items():
                 reg.gauge(k).set(v)
+            if self.pool.shards > 1:
+                # per-shard residency (sp pools): refcounted pages on
+                # each sp shard — resident 0 everywhere at idle IS the
+                # per-shard zero-leak invariant
+                for s, npg in enumerate(self.pool.pages_in_use_by_shard):
+                    reg.gauge(
+                        "sp_pages_resident",
+                        "refcounted pages per sp shard",
+                        labels={"shard": str(s)}).set(npg)
         out = {
             "enabled": self.enabled,
             "admissions": self.admissions.value,
@@ -737,6 +789,9 @@ class PrefixCache:
             "host_drops": self.tree.host_drops,
             "restore_latency_ms": round(self._g_restore.value, 3),
         }
+        if self.pool.shards > 1:
+            out["sp_pages_resident"] = self.pool.pages_in_use_by_shard
+            out["sp_pages_free_by_shard"] = self.pool.available_by_shard
         # NB the pool defines __len__, so this must test `is not None`
         # (an EMPTY pool is falsy)
         if self.host is not None:

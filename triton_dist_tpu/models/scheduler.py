@@ -303,12 +303,13 @@ class _InFlight:
 # mixed verify is still a mixed tick — the operator-facing question is
 # "which program CLASS am I waiting on", not which jit entry point
 _DISPATCH_KIND = {"chunk": "decode", "mixed_verify": "mixed",
-                  "mega": "mega"}
+                  "mega": "mega", "sp": "sp_combine"}
 
 # _InFlight.kind -> the same buckets, for the overlap land (which must
 # charge the LANDED tick's kind, not whatever dispatched since)
 _INFLIGHT_KIND = {"chunk": "decode", "mega": "mega", "mixed": "mixed",
-                  "spec": "verify", "mixed_spec": "mixed"}
+                  "spec": "verify", "mixed_spec": "mixed",
+                  "sp": "sp_combine"}
 
 
 def _merge_out(acc: Dict[object, np.ndarray], rid, toks) -> None:
@@ -397,7 +398,7 @@ class DecodeSlots:
         self.device_wait_by_kind: Dict[str, float] = {
             "prefill": 0.0, "decode": 0.0, "verify": 0.0,
             "mixed": 0.0, "admit": 0.0, "transfer": 0.0,
-            "mega": 0.0, "other": 0.0}
+            "mega": 0.0, "sp_combine": 0.0, "other": 0.0}
         # MoE-family serving telemetry (ISSUE 13): every tick program
         # of a Qwen3MoE engine appends its routing-load vector
         # [expert_tokens[0..E-1], capacity_dropped]; _fetch pops ONE
@@ -1134,7 +1135,7 @@ class DecodeSlots:
             return {}, []
         out: Dict[int, np.ndarray] = {}
         finished: List[Tuple[int, object]] = []
-        if inf.kind in ("chunk", "mega", "mixed"):
+        if inf.kind in ("chunk", "mega", "sp", "mixed"):
             (toks,) = self._fetch(inf.arrs,
                                   kind=_INFLIGHT_KIND[inf.kind])
             toks = np.asarray(toks)
@@ -1210,10 +1211,15 @@ class PagedDecodeSlots(DecodeSlots):
         Hkv = engine.model.config.num_kv_heads
         # the prefix cache publishes its counters into the SAME
         # registry, so the scheduler's stats() snapshot covers it
+        # a SEQUENCE-PARALLEL pool partitions the page-id space per sp
+        # shard (kv_cache.PagedSlotCache SP SHARDING): the allocator
+        # mirrors that split host-side and rotates fresh groups across
+        # shards so a slot's logical tiles interleave chips
         self.prefix = PrefixCache(self.cache.num_pages, Hkv, page,
                                   enabled=prefix_cache,
                                   host_pool_pages=host_pool_pages,
-                                  fault=fault, telemetry=self.tele)
+                                  fault=fault, telemetry=self.tele,
+                                  shards=self.cache.sp)
         if host_pool_pages:
             self.prefix.attach_host_tier(self._tier_extract,
                                          self._tier_restore)
@@ -1236,8 +1242,17 @@ class PagedDecodeSlots(DecodeSlots):
     def _tick_kind(self) -> str:
         # backend='mega' routes the pure-decode paged tick through the
         # fused megakernel program (engine.paged_slot_chunk) — mixed
-        # ticks still dispatch per-op and keep their "mixed" kind
-        return "mega" if self.engine.backend == "mega" else "chunk"
+        # ticks still dispatch per-op and keep their "mixed" kind.
+        # A SEQUENCE-PARALLEL pool's decode tick runs the split-KV
+        # partial + cross-chip LSE combine (layers/tp_attn.py
+        # fwd_cached_slots_paged_sp) — attributed as "sp_combine" in
+        # device_wait_kind_s so an operator sees what the long-context
+        # path actually waits on.
+        if self.engine.backend == "mega":
+            return "mega"
+        if getattr(self.engine, "sp_size", 1) > 1:
+            return "sp"
+        return "chunk"
 
     # host KV tier copy callbacks (prefix_cache.attach_host_tier):
     # the residency machine calls these from inside evict_until /
@@ -1715,6 +1730,14 @@ class ContinuousScheduler:
             engine.model.mesh.shape[engine.model.axis])
         reg.gauge("tp_size",
                   "TP mesh size this scheduler drives").set(self.tp_size)
+        # sequence-parallel topology (long-context serving): the sp
+        # mesh size the paged pool's page-id space shards over —
+        # per-chip KV reads and attention FLOPs drop to ~1/sp_size and
+        # max context scales with it (1 = no sp)
+        self.sp_size = int(getattr(engine, "sp_size", 1))
+        reg.gauge("sp_size",
+                  "sp mesh size the paged pool shards over").set(
+            self.sp_size)
         # megakernel serving gauge (ISSUE 12 satellite): 1 when the
         # pure-decode paged tick runs the fused program — paired with
         # device_wait_kind_s{kind="mega"} it tells an operator the
@@ -1854,7 +1877,7 @@ class ContinuousScheduler:
             by_kind = {k: round(v, 4) for k, v in
                        self.slots.device_wait_by_kind.items()}
             for k in ("prefill", "decode", "verify", "mixed",
-                      "mega", "admit", "transfer"):
+                      "mega", "sp_combine", "admit", "transfer"):
                 reg.gauge("device_wait_kind_s",
                           labels={"kind": k}).set(by_kind.get(k, 0.0))
             # live throughput, aggregate AND per-chip (one scheduler
@@ -1863,21 +1886,23 @@ class ContinuousScheduler:
             reg.gauge("tp_size").set(self.tp_size)
             agg = (self._c_tokens.value / self._busy_s
                    if self._busy_s > 0 else 0.0)
+            nchips = self.tp_size * self.sp_size
             reg.gauge("serving_tok_per_s_aggregate",
                       "tokens/s across the whole mesh while "
                       "serving").set(round(agg, 3))
             reg.gauge("serving_tok_per_s_per_chip",
-                      "aggregate tok/s / tp_size").set(
-                round(agg / self.tp_size, 3))
+                      "aggregate tok/s / mesh size").set(
+                round(agg / nchips, 3))
             slots_stats = dict(getattr(self.slots, "stats", {}) or {})
             out = reg.snapshot()
             out.update(slots_stats)
             out.update({
                 "tp_size": self.tp_size,
+                "sp_size": self.sp_size,
                 "tokens_emitted": self._c_tokens.value,
                 "serving_tok_per_s_aggregate": round(agg, 3),
                 "serving_tok_per_s_per_chip":
-                    round(agg / self.tp_size, 3),
+                    round(agg / nchips, 3),
                 "queue_depth": len(self._queue),
                 "preemptions": self._c_preemptions.value,
                 "deadline_expired": self._c_deadline_expired.value,
