@@ -203,9 +203,14 @@ def _bench():
     on_tpu = jax.default_backend() == "tpu"
     from triton_dist_tpu.models import AutoLLM, Engine
     from triton_dist_tpu.models.config import qwen3_1p7b, tiny_qwen3
+    # the central kernel enumeration (ISSUE 15): stamp captures with
+    # the registry size so a bench row's kernel surface is dated —
+    # tdcheck, kprof and perf_report read the same table
+    from triton_dist_tpu.kernels import kernel_registry
 
     ndev = len(jax.devices())
     mesh = jax.make_mesh((ndev,), ("tp",))
+    rows_extra = {"kernels_registered": len(kernel_registry())}
 
     if on_tpu:
         cfg = qwen3_1p7b()
@@ -273,6 +278,7 @@ def _bench():
         "unit": "tok/s/chip",
         "vs_baseline": round(vs_baseline, 4),
         "backend": jax.default_backend(),
+        **rows_extra,
     })
 
     # --- continuous-batching serving row: N DISTINCT prompts of mixed

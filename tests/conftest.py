@@ -111,6 +111,35 @@ def cpu_mesh_env(extra=None):
     return env
 
 
+# --- per-module timing table (tools/tier1.sh budget audits) -----------
+# TDTPU_TIMING_TSV=path aggregates setup+call+teardown wall per test
+# module and writes a sorted TSV at session end, so re-assigning `slow`
+# marks against the 870s gate is mechanical instead of scrollback
+# archaeology.
+_MODULE_TIMES = {}
+
+
+def pytest_runtest_logreport(report):
+    if not os.environ.get("TDTPU_TIMING_TSV"):
+        return
+    mod = report.nodeid.split("::")[0]
+    _MODULE_TIMES[mod] = _MODULE_TIMES.get(mod, 0.0) + report.duration
+
+
+def pytest_sessionfinish(session, exitstatus):
+    tsv = os.environ.get("TDTPU_TIMING_TSV")
+    if not tsv or not _MODULE_TIMES:
+        return
+    try:
+        with open(tsv, "w") as f:
+            f.write("module\tseconds\n")
+            for mod, s in sorted(_MODULE_TIMES.items(),
+                                 key=lambda kv: -kv[1]):
+                f.write(f"{mod}\t{s:.1f}\n")
+    except OSError:
+        pass
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _reset_interpreter_state():
     """Reset the Pallas TPU interpreter's global shared-memory state

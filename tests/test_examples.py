@@ -28,19 +28,32 @@ def _run_example(name):
     assert "OK" in out.stdout, out.stdout
 
 
+@pytest.mark.slow
 def test_kernels_example_runs():
+    # slow: tier-1's 870 s budget (ISSUE 15 relief) — runs the comm
+    # kernels end-to-end, which the kernel suites already gate; on the
+    # CPU substrate this arm is also interpret-limited.
     _run_example("05_kernels.py")
 
 
+@pytest.mark.slow
 def test_serving_example_runs():
+    # slow: same budget note — the serving differential lives in
+    # test_serving.py; the example is a doc artifact.
     _run_example("07_serving.py")
 
 
+@pytest.mark.slow
 def test_continuous_batching_example_runs():
+    # slow: same budget note — test_scheduler.py gates the slot
+    # scheduler; the example is a doc artifact.
     _run_example("09_continuous_batching.py")
 
 
+@pytest.mark.slow
 def test_prefix_cache_example_runs():
+    # slow: same budget note — test_prefix_cache.py gates the radix
+    # cache bitwise matrix.
     _run_example("10_prefix_cache.py")
 
 
@@ -48,7 +61,10 @@ def test_speculative_decoding_example_runs():
     _run_example("11_speculative_decoding.py")
 
 
+@pytest.mark.slow
 def test_resilient_serving_example_runs():
+    # slow: same budget note — test_resilience.py gates preemption
+    # and chaos.
     _run_example("12_resilient_serving.py")
 
 
@@ -56,11 +72,16 @@ def test_chunked_prefill_example_runs():
     _run_example("13_chunked_prefill.py")
 
 
+@pytest.mark.slow
 def test_kv_tiering_example_runs():
+    # slow: same budget note — test_kv_tier.py gates the host tier.
     _run_example("14_kv_tiering.py")
 
 
+@pytest.mark.slow
 def test_overlap_scheduler_example_runs():
+    # slow: same budget note — test_overlap.py gates the dispatch-
+    # ahead loop bitwise.
     _run_example("15_overlap_scheduler.py")
 
 
@@ -100,7 +121,10 @@ def test_disaggregation_example_runs():
     _run_example("18_disaggregation.py")
 
 
+@pytest.mark.slow
 def test_socket_serving_two_process():
+    # slow: same budget note — the two-process socket matrix is
+    # test_serving.py's; this is the doc artifact run.
     """The streaming socket pair (VERDICT r4 missing #5): a REAL server
     process accepts the prompt over TCP and the client receives sampled
     tokens incrementally (3 chunk messages for gen_len=12 at chunk=4 —

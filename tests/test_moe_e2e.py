@@ -67,6 +67,7 @@ def test_moe_logits_close_across_impls(ctx8):
 
 
 @pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_fused_vs_xla(ctx8, k):
     """The ONE-kernel EP path (dispatch puts -> per-arrival expert MLPs
     -> combine puts from the epilogue, kernels/ep_fused.py) must match
@@ -91,6 +92,7 @@ def test_ep_moe_fused_vs_xla(ctx8, k):
                                atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_fused_tiled_weights(ctx8):
     """Shapes whose expert panels exceed VMEM now stream I-tiles inside
     the fused kernel (gate/up column tiles + down-proj row tiles with
@@ -149,6 +151,7 @@ def test_ep_fused_tiling_picker():
 
 
 @pytest.mark.parametrize("block_i", [None, 128])
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_fused_int8_weights(ctx8, block_i):
     """QuantW expert panels through the fused one-kernel EP path
     (VERDICT r4 missing #3): int8 gate/up/down panels stream (resident

@@ -263,6 +263,75 @@ def test_page_allocator_in_use_invariant():
     assert alloc.available == 32 and alloc.outstanding == 0
 
 
+def test_page_allocator_error_message_texts():
+    """The error strings ARE the operator interface (ISSUE 15
+    satellite): exhaustion names want/have, shard misfit names the
+    divisibility fix, and the conservation assert names the corrupted
+    ledger — pin them so a refactor cannot silently blunt them."""
+    alloc = PageAllocator(4)
+    alloc.alloc(3)
+    try:
+        alloc.alloc(2)
+    except ValueError as e:
+        assert "page pool exhausted" in str(e)
+        assert "want 2" in str(e) and "have 1" in str(e)
+    else:
+        raise AssertionError("must raise")
+    try:
+        PageAllocator(10, shards=4)
+    except ValueError as e:
+        assert "cannot split over" in str(e)
+        assert "multiple of the sp axis" in str(e)
+    else:
+        raise AssertionError("must raise")
+    # the conservation invariant's own message (simulate corruption)
+    alloc2 = PageAllocator(4)
+    alloc2._in_use.add(99)
+    try:
+        alloc2._check()
+    except AssertionError as e:
+        assert "page pool corrupted" in str(e)
+    else:
+        raise AssertionError("must raise")
+
+
+def test_refcounted_pages_error_paths():
+    """RefcountedPages (models/prefix_cache.py): refcount underflow
+    and retain-of-unreferenced must raise with actionable messages
+    BEFORE the pool is touched, and the conservation invariant must
+    hold after every refused call."""
+    from triton_dist_tpu.models.prefix_cache import RefcountedPages
+    pool = RefcountedPages(8, n_kv_heads=2)
+    g = pool.alloc_group()
+    pool.retain(g)
+    pool.release(g)
+    pool.release(g)            # refcount 2 -> 0: pages freed
+    for op, msg in ((pool.release, "refcount underflow"),
+                    (pool.retain, "retain of unreferenced page")):
+        try:
+            op(g)
+        except ValueError as e:
+            assert msg in str(e), (msg, str(e))
+        else:
+            raise AssertionError(f"{msg} must raise")
+        assert pool.available + pool.outstanding == pool.num_pages
+    # double-release within one live group: first release frees, the
+    # second underflows without corrupting the ledger
+    g2 = pool.alloc_group()
+    pool.release(g2)
+    try:
+        pool.release(g2)
+    except ValueError as e:
+        assert "refcount underflow" in str(e)
+        assert "released a group twice" in str(e)
+    else:
+        raise AssertionError("double release must raise")
+    assert pool.available + pool.outstanding == pool.num_pages
+    # the trash page is reserved and never refcounted
+    assert pool.refcount(pool.trash) == 0
+    assert pool.outstanding >= 1       # trash held out of the free list
+
+
 def test_paged_decode_int8_scales_vs_dequant_oracle():
     """INT8 pool (kv_cache.PagedSlotCache layout): per-position scale
     planes ride the same table indirection as the payload, and the

@@ -123,6 +123,7 @@ def test_o_a2a_gemm_vs_xla():
                                np.asarray(ref), atol=1e-4, rtol=1e-5)
 
 
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ring_train_shmem_data_plane_matches_xla():
     """data_plane='shmem' (one-sided p2p rotations) must produce the
     same value and gradients as the XLA-permute oracle data plane.

@@ -69,6 +69,7 @@ def test_reduce_scatter_2d():
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4,
                                rtol=1e-5)
 
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_2d_vs_dense_oracle():
     """Two-tier EP MoE (mode='ep_2d'): DCN all_to_all across slices +
     one-sided ICI a2a within the slice (reference: the inter-node EP
@@ -111,6 +112,7 @@ def test_ep_moe_2d_vs_dense_oracle():
                                rtol=2e-4)
 
 
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_2d_counts_drops():
     """Tight capacities on the two-tier path still count drops loudly
     (dropless-or-loud holds across BOTH tiers)."""
@@ -134,6 +136,7 @@ def test_ep_moe_2d_counts_drops():
     assert int(stats["dropped"]) > 0
 
 
+@pytest.mark.slow  # slow: tier-1's 870 s budget (ISSUE 15 relief) — heavy interpreted comm arm; the full suite (no -m filter) and the on-chip scripts still run it
 def test_ep_moe_2d_payload_int8():
     """Two-tier EP with the int8 wire (payload_int8=True): tokens pack
     once at the source and cross DCN AND ICI packed (no intermediate

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# tdcheck smoke — the static-analysis gate (ISSUE 15), the tp_smoke.sh
+# pattern: full registry scan (kernel contracts + comm protocol), the
+# paged-KV symbolic race proof, the hot-loop lint over the engine's
+# decode-tick program set, and the dead-code lint — all TRACE-ONLY
+# (nothing compiles or executes on device), so the whole gate is well
+# under a minute and runs as a fast pre-pass in tools/tier1.sh.
+# Run from the repo root: bash tools/tdcheck.sh
+set -o pipefail
+t0=$SECONDS
+timeout -k 10 300 env JAX_PLATFORMS=cpu TDTPU_NO_FAKECPUS=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m triton_dist_tpu.analysis "$@" 2>&1 | tail -40
+rc=${PIPESTATUS[0]}
+echo "TDCHECK_RC=$rc (wall $((SECONDS - t0))s)"
+exit $rc

@@ -10,7 +10,18 @@
 # across runs instead of living in lost scrollback.
 # Run from the repo root: bash tools/tier1.sh
 set -o pipefail
-rm -f /tmp/_t1.log /tmp/_t1.trace.json
+rm -f /tmp/_t1.log /tmp/_t1.trace.json /tmp/_t1_modules.tsv
+# tdcheck pre-pass (ISSUE 15): the static-analysis gate — kernel
+# contracts, comm protocol graph, paged-KV symbolic race proof,
+# hot-loop lint, dead-code lint — is trace-only and runs in ~20s, so
+# it fronts the 870s suite: a protocol or contract regression fails
+# here in seconds instead of deep in a bitwise differential.
+bash "$(dirname "$0")/tdcheck.sh" > /tmp/_tdcheck.log 2>&1
+tdrc=$?
+tail -3 /tmp/_tdcheck.log
+if [ "$tdrc" -ne 0 ]; then
+    echo "TDCHECK FAILED (rc=$tdrc) — full log: /tmp/_tdcheck.log; suite continues"
+fi
 # TDTPU_TRACE: poll-loop tracing ON for every serving test (telemetry
 # is stream-exact by contract, so this doubles as a suite-wide
 # integration check); the last TokenServer to exit leaves its
@@ -18,6 +29,7 @@ rm -f /tmp/_t1.log /tmp/_t1.trace.json
 # python tools/trace_view.py /tmp/_t1.trace.json  (--json for CI)
 t0=$SECONDS
 timeout -k 10 870 env JAX_PLATFORMS=cpu TDTPU_TRACE=/tmp/_t1.trace.json \
+    TDTPU_TIMING_TSV=/tmp/_t1_modules.tsv \
     python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly --durations=20 2>&1 | tee /tmp/_t1.log
@@ -41,4 +53,9 @@ echo "TIER1_HISTORY=$hist ($(($(wc -l < "$hist") - 1)) runs; wall ${wall}s of th
 if [ -s /tmp/_t1.trace.json ]; then
     echo "TRACE_ARTIFACT=/tmp/_t1.trace.json ($(wc -c < /tmp/_t1.trace.json) bytes; summarize: python tools/trace_view.py /tmp/_t1.trace.json)"
 fi
+if [ -s /tmp/_t1_modules.tsv ]; then
+    echo "--- per-module wall (top 15; full table /tmp/_t1_modules.tsv) ---"
+    head -16 /tmp/_t1_modules.tsv | awk -F'\t' '{printf "%-40s %8s\n", $1, $2}'
+fi
+[ $rc -eq 0 ] && rc=$tdrc
 exit $rc

@@ -1,0 +1,58 @@
+"""tdcheck CLI: ``python -m triton_dist_tpu.analysis [checker ...]``.
+
+Runs the requested checkers (default: all) and exits non-zero when any
+ERROR finding survives — the tools/tdcheck.sh gate. Checkers:
+contracts, protocol, races, hotloop, deadcode. To add one: write a
+module with a ``run() -> Report`` and register it in _CHECKERS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _load(name):
+    import importlib
+    return importlib.import_module(f"triton_dist_tpu.analysis.{name}")
+
+
+_CHECKERS = ("contracts", "protocol", "races", "hotloop", "deadcode")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_dist_tpu.analysis",
+        description="tdcheck: static analysis for Pallas kernels and "
+                    "the serving hot loop")
+    ap.add_argument("checkers", nargs="*", default=None,
+                    metavar="checker",
+                    help=f"subset of {', '.join(_CHECKERS)} "
+                         f"(default: all)")
+    ap.add_argument("--warnings-as-errors", action="store_true",
+                    help="exit non-zero on warnings too")
+    args = ap.parse_args(argv)
+    picked = args.checkers or list(_CHECKERS)
+    unknown = [c for c in picked if c not in _CHECKERS]
+    if unknown:
+        ap.error(f"unknown checker(s) {unknown}; choose from "
+                 f"{list(_CHECKERS)}")
+    rc = 0
+    t_all = time.time()
+    for name in picked:
+        t0 = time.time()
+        report = _load(name).run()
+        print(report.format())
+        print(f"[{name}] {time.time() - t0:.1f}s")
+        if report.errors or (args.warnings_as_errors
+                             and report.findings):
+            rc = 1
+    print(f"tdcheck: {len(picked)} checker(s) in "
+          f"{time.time() - t_all:.1f}s -> "
+          f"{'FAIL' if rc else 'OK'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+"""Dead-code / import lint (tdcheck satellite checker).
+
+Pure-AST, zero-dependency lint over the package, tuned for this
+repo's idioms (re-export blocks carry `# noqa: F401`; kernels import
+lazily inside builders). Three precise checks — each one a class of
+rot that a growing kernel library accumulates:
+
+- **unused import**: an imported name never referenced in the module
+  (and not re-exported via `# noqa` or __all__). Dead imports are not
+  free here: most modules import jax eagerly, and the serving CLI's
+  cold start pays every one.
+- **unreachable code**: statements after an unconditional
+  return/raise/break/continue in the same block — a refactor fossil
+  that silently stops running (the "unreachable fallback branch"
+  failure mode: the fallback still reads as if it protects the call
+  site).
+- **shadowed name**: a module-level def/class/assignment that rebinds
+  an earlier import, or a duplicate top-level def/class — the first
+  binding is dead code and the reader is looking at the wrong body.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from triton_dist_tpu.analysis import Report
+
+
+def _noqa_lines(src: str) -> set:
+    return {i + 1 for i, line in enumerate(src.splitlines())
+            if "# noqa" in line}
+
+
+def _imported_names(node):
+    """(local_name, lineno) pairs bound by an import statement."""
+    if getattr(node, "module", None) == "__future__":
+        return
+    for alias in node.names:
+        if alias.name == "*":
+            continue
+        local = alias.asname or alias.name.split(".")[0]
+        yield local, node.lineno
+
+
+class _Usage(ast.NodeVisitor):
+    def __init__(self):
+        self.loads = set()
+        self.string_refs = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Load, ast.Del)):
+            self.loads.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        # __all__ entries / getattr strings count as usage
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.string_refs.add(node.value)
+
+
+_TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+def _walk_blocks(node):
+    """Yield every statement list in the tree (bodies of modules,
+    functions, ifs, loops, withs, trys)."""
+    for child in ast.walk(node):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(child, field, None)
+            if isinstance(block, list) and block and \
+                    isinstance(block[0], ast.stmt):
+                yield block
+        for handler in getattr(child, "handlers", []) or []:
+            yield handler.body
+
+
+def check_source(src: str, path: str,
+                 report: Optional[Report] = None) -> Report:
+    if report is None:
+        report = Report("deadcode")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        report.add("error", f"{path}:{e.lineno}", os.path.basename(path),
+                   f"syntax error: {e.msg}")
+        return report
+    noqa = _noqa_lines(src)
+    mod = os.path.basename(path)
+
+    usage = _Usage()
+    usage.visit(tree)
+    used = usage.loads | usage.string_refs
+
+    # --- unused imports + import shadowing (module level) -------------
+    imports = {}          # name -> lineno
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if node.lineno in noqa:
+                continue
+            for name, lineno in _imported_names(node):
+                imports[name] = lineno
+    for name, lineno in sorted(imports.items(), key=lambda kv: kv[1]):
+        if name not in used and name != "_":
+            report.add(
+                "warning", f"{path}:{lineno}", mod,
+                f"unused import '{name}' (re-exports want "
+                f"'# noqa: F401' on the import line)")
+
+    # --- shadowed / duplicate top-level bindings ----------------------
+    defs = {}
+    for node in tree.body:
+        names = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names = [(node.name, node.lineno)]
+        elif isinstance(node, ast.Assign):
+            names = [(t.id, node.lineno) for t in node.targets
+                     if isinstance(t, ast.Name)]
+        for name, lineno in names:
+            if lineno in noqa:
+                continue
+            if name in imports and imports[name] < lineno:
+                report.add(
+                    "warning", f"{path}:{lineno}", mod,
+                    f"'{name}' shadows the import at line "
+                    f"{imports[name]} — the import is dead")
+            elif name in defs:
+                report.add(
+                    "warning", f"{path}:{lineno}", mod,
+                    f"duplicate top-level definition of '{name}' "
+                    f"(first at line {defs[name]}): the first body is "
+                    f"dead code")
+            defs[name] = lineno
+
+    # --- unreachable statements ---------------------------------------
+    for block in _walk_blocks(tree):
+        for i, stmt in enumerate(block[:-1]):
+            if isinstance(stmt, _TERMINAL):
+                nxt = block[i + 1]
+                if nxt.lineno in noqa:
+                    break
+                report.add(
+                    "warning", f"{path}:{nxt.lineno}", mod,
+                    f"unreachable code after "
+                    f"{type(stmt).__name__.lower()} at line "
+                    f"{stmt.lineno}")
+                break
+    report.covered.append(path)
+    return report
+
+
+def check_tree(root: str, report: Optional[Report] = None,
+               exclude: Iterable[str] = ("__pycache__",)) -> Report:
+    if report is None:
+        report = Report("deadcode")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in exclude]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r") as f:
+                check_source(f.read(), path, report)
+    return report
+
+
+def run(report: Optional[Report] = None) -> Report:
+    import triton_dist_tpu
+    root = os.path.dirname(os.path.abspath(triton_dist_tpu.__file__))
+    return check_tree(root, report)

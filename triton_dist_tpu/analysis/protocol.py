@@ -1,0 +1,240 @@
+"""Comm protocol verifier (tdcheck checker 3).
+
+Builds the per-device signal graph of every one-sided kernel from the
+facade's trace-time recorder (language.comm_trace — the kernels are
+TRACED via jax.make_jaxpr, never executed, so this runs on any
+substrate including ones whose interpreter cannot simulate remote
+DMA). The per-device SPMD program is symmetric: each device runs the
+same event sequence, so per-program balance is exactly the global
+protocol contract:
+
+- **unmatched set/wait**: every one-sided put signals its send
+  semaphore (locally) and its recv semaphore (on the peer); the
+  program must drain exactly the bytes it sent (quiet) and await
+  exactly the bytes its peers' symmetric puts land on it. A missing
+  wait is a data race on the landing buffer; a missing drain lets the
+  kernel retire with DMAs in flight reading reclaimed memory. A
+  surplus wait deadlocks on hardware (the interpreter's synchronous
+  DMAs can mask it).
+- **wait-before-set**: a wait on a semaphore positioned before ANY
+  event that could signal it — symmetric peers run the same program,
+  so every device blocks before any device signals: guaranteed
+  deadlock.
+- **barrier elision**: remote puts with no barrier_all anywhere
+  before the first put. The entry barrier is what guarantees the
+  peer's landing buffer (a fresh pallas output) exists and its
+  previous consumer is done — eliding it is the symmetric-buffer
+  reuse hazard the reference documents around nvshmem_barrier_all.
+- **regular-semaphore credits**: signal_op increments must equal
+  signal_wait_until consumed values (flow-control credits leak
+  otherwise, skewing the NEXT kernel on the same collective id).
+
+Kernels registered protocol="dynamic" use data-dependent arrival
+counts (dl.dma_wait_dyn); exact balance is unknowable statically, so
+only ordering/barrier checks apply to the dynamic semaphore.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from triton_dist_tpu.analysis import Report
+
+
+def trace_kernel_events(spec, mesh) -> List[dict]:
+    """Trace one registered comm kernel under dl.comm_trace (pure
+    trace: make_jaxpr, nothing executes)."""
+    import jax
+    from triton_dist_tpu import language as dl
+    fn, args = spec.build(mesh)
+    with dl.comm_trace() as events:
+        jax.make_jaxpr(fn)(*args)
+    return list(events)
+
+
+def verify_events(events: List[dict], subject: str,
+                  report: Optional[Report] = None,
+                  strict: bool = True) -> Report:
+    """Signal-graph checks over one kernel's per-device event stream."""
+    if report is None:
+        report = Report("protocol")
+    puts = [(i, e) for i, e in enumerate(events) if e["op"] == "put"]
+    waits = [(i, e) for i, e in enumerate(events)
+             if e["op"] == "dma_wait"]
+    dyn_waits = [(i, e) for i, e in enumerate(events)
+                 if e["op"] == "dma_wait_dyn"]
+    sem_waits = [(i, e) for i, e in enumerate(events)
+                 if e["op"] == "sem_wait"]
+    signals = [(i, e) for i, e in enumerate(events)
+               if e["op"] == "signal"]
+    local = [(i, e) for i, e in enumerate(events)
+             if e["op"] in ("local_copy", "local_copy_nbi")]
+    barriers = [i for i, e in enumerate(events)
+                if e["op"] == "barrier_all" and (e.get("n") or 2) > 1]
+    src_of = {i: e.get("src", "<unknown>") for i, e in enumerate(events)}
+
+    # --- barrier elision ------------------------------------------------
+    if puts:
+        first_put = puts[0][0]
+        if not any(b < first_put for b in barriers):
+            report.add(
+                "error", src_of[first_put], subject,
+                "one-sided put with no barrier_all before it: the "
+                "peer's landing buffer may still be owned by its "
+                "previous consumer (symmetric-buffer reuse hazard) — "
+                "open the kernel with dl.barrier_all(axis)")
+
+    # --- per-semaphore DMA byte ledgers --------------------------------
+    sent = {}      # send_sem -> bytes signalled locally by puts
+    landed = {}    # recv_sem -> bytes peers' symmetric puts land here
+    first_set = {}
+    for i, e in puts:
+        b = e.get("bytes") or 0
+        for role in ("send_sem", "recv_sem"):
+            s = e.get(role)
+            if s is None:
+                continue
+            (sent if role == "send_sem" else landed)[s] = \
+                (sent if role == "send_sem" else landed).get(s, 0) + b
+            first_set.setdefault(s, i)
+    for i, e in local:
+        s = e.get("sem")
+        if s is not None:
+            sent[s] = sent.get(s, 0)  # known sem; bytes self-balanced
+            first_set.setdefault(s, i)
+
+    awaited = {}
+    dynamic = set()
+    for i, e in waits:
+        s = e.get("sem")
+        awaited[s] = awaited.get(s, 0) + (e.get("bytes") or 0) * \
+            e.get("count", 1)
+        if s not in first_set and s is not None:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "dma_wait on a semaphore no put or local copy in this "
+                "program ever signals: every device blocks here "
+                "forever (wait-before-set across the whole program)")
+        elif s is not None and i < first_set[s]:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "wait-before-set: this dma_wait precedes every "
+                "event that signals its semaphore in program order — "
+                "symmetric peers all block before any signals "
+                "(guaranteed deadlock on hardware)")
+    for i, e in dyn_waits:
+        s = e.get("sem")
+        dynamic.add(s)
+        if s is not None and s not in first_set:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "dma_wait_dyn on a semaphore no put or local copy in "
+                "this program ever signals: any rank whose runtime "
+                "count is nonzero blocks forever")
+        elif s in first_set and i < first_set[s]:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "wait-before-set: dynamic arrival wait precedes every "
+                "signalling event of its semaphore")
+
+    if strict:
+        for s, b in sent.items():
+            if s in dynamic or b == 0:
+                continue
+            got = awaited.get(s, 0)
+            if got != b:
+                report.add(
+                    "error", src_of[first_set[s]], subject,
+                    f"unmatched set/wait on a SEND semaphore: puts "
+                    f"signalled {b} bytes but the program drains "
+                    f"{got} — "
+                    + ("in-flight DMAs outlive the kernel (quiet is "
+                       "missing or short)" if got < b else
+                       "surplus drain deadlocks on hardware"))
+        for s, b in landed.items():
+            if s in dynamic:
+                continue
+            got = awaited.get(s, 0)
+            if got != b:
+                report.add(
+                    "error", src_of[first_set[s]], subject,
+                    f"unmatched set/wait on a RECV semaphore: "
+                    f"symmetric peers land {b} bytes here but the "
+                    f"program awaits {got} — "
+                    + ("the landing buffer is read before the DMA "
+                       "completes (data race)" if got < b else
+                       "surplus wait deadlocks on hardware"))
+
+    # --- regular-semaphore credit ledger -------------------------------
+    cred = {}
+    first_sig = {}
+    for i, e in signals:
+        s = e.get("sem")
+        cred[s] = cred.get(s, 0) + e.get("inc", 1)
+        first_sig.setdefault(s, i)
+    consumed = {}
+    for i, e in sem_waits:
+        s = e.get("sem")
+        consumed[s] = consumed.get(s, 0) + e.get("value", 1)
+        if s not in first_sig:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "signal_wait_until on a semaphore this program never "
+                "signals (no symmetric peer will either): guaranteed "
+                "deadlock")
+        elif i < first_sig[s]:
+            report.add(
+                "error", e.get("src", "<unknown>"), subject,
+                "wait-before-set on a REGULAR semaphore: the wait "
+                "precedes every signal_op in program order")
+    if strict:
+        for s, c in cred.items():
+            got = consumed.get(s, 0)
+            if got != c:
+                report.add(
+                    "error", src_of[first_sig[s]], subject,
+                    f"credit imbalance: signal_op grants {c} but "
+                    f"signal_wait_until consumes {got} — leftover "
+                    f"credits skew the next kernel on this "
+                    f"collective id" if got < c else
+                    f"credit imbalance: consumes {got} of {c} "
+                    f"granted — the surplus wait deadlocks")
+    return report
+
+
+def check_kernel(spec, mesh, report: Optional[Report] = None) -> Report:
+    if report is None:
+        report = Report("protocol")
+    events = trace_kernel_events(spec, mesh)
+    if not any(e["op"] == "put" for e in events):
+        report.add(
+            "warning", f"triton_dist_tpu/{spec.module}", spec.name,
+            "registered comm kernel traced zero one-sided puts "
+            "(degenerate shape or XLA fallback — fix the registry "
+            "sample)")
+    verify_events(events, spec.name, report,
+                  strict=spec.protocol == "strict")
+    report.covered.append(spec.name)
+    return report
+
+
+def run(mesh=None, names=None) -> Report:
+    """Protocol-verify every registered comm kernel (CLI entry)."""
+    import jax
+    from triton_dist_tpu.kernels import kernel_registry
+    if mesh is None:
+        n = len(jax.devices())
+        mesh = jax.make_mesh((n,), ("tp",))
+    ndev = mesh.shape["tp"]
+    report = Report("protocol")
+    for name, spec in kernel_registry().items():
+        if names and name not in names:
+            continue
+        if spec.protocol is None or spec.min_devices > ndev:
+            continue
+        try:
+            check_kernel(spec, mesh, report)
+        except Exception as e:
+            report.add("error", f"triton_dist_tpu/{spec.module}", name,
+                       f"comm kernel failed to trace: {e!r}")
+    return report

@@ -184,7 +184,7 @@ def _ag_group_gemm_kernel(n: int, axis: str, E: int, block_n: int,
                                           o_sems.at[g % wb_depth]).start()
         if s < n - 1:
             nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
-            pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
+            dl.dma_wait(recv_sems.at[nxt], x_ref)
             if "a_stream" not in ablate:
                 # next step's first chunk: start now, wait at its dot
                 pltpu.make_async_copy(a_src(s + 1, 0),

@@ -44,9 +44,7 @@ def _a2a_kernel(n: int, axis: str, x_ref, o_ref, send_sem, recv_sem):
                       send_sem, recv_sem, jnp.int32(p), axis)
     # n chunk arrivals (order irrelevant: each lands in its own slot and
     # nothing is forwarded, so a single byte-counting semaphore is sound)
-    for _ in range(n):
-        pltpu.make_async_copy(x_ref.at[pl.ds(0, C)],
-                              x_ref.at[pl.ds(0, C)], recv_sem).wait()
+    dl.dma_wait(recv_sem, x_ref.at[pl.ds(0, C)], n)
     dl.quiet(send_sem, x_ref.at[pl.ds(0, C)], n)
 
 

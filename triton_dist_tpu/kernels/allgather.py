@@ -70,8 +70,7 @@ def _one_shot_kernel(n: int, axis: str, x_ref, o_ref, send_sem, recv_sem):
         dl.putmem_signal(o_ref.at[pl.ds(me * rows, rows)], x_ref,
                          send_sem, recv_sem, jnp.int32(p), axis)
     # n DMAs of our shard landed here (one from each peer, incl. self)
-    for _ in range(n):
-        pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+    dl.dma_wait(recv_sem, x_ref, n)
     dl.quiet(send_sem, x_ref, n)
 
 
@@ -101,7 +100,7 @@ def _ring_kernel(n: int, axis: str, x_ref, o_ref, copy_sem, send_sem,
                       send_sem, recv_sems.at[src], right, axis)
         # wait arrival of chunk (me-s-1)%n from the left neighbor
         nxt = jax.lax.rem(me - s - 1 + jnp.int32(n), jnp.int32(n))
-        pltpu.make_async_copy(x_ref, x_ref, recv_sems.at[nxt]).wait()
+        dl.dma_wait(recv_sems.at[nxt], x_ref)
     dl.quiet(send_sem, x_ref, n - 1)
 
 

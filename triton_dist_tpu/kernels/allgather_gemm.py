@@ -281,7 +281,7 @@ def _ag_gemm_kernel(n: int, axis: str, block_n: int, quant: bool,
                 else:
                     trace_ref[s, 0] = jnp.int32(-2)
                     trace_ref[s, 1] = jnp.int32(-2)
-            pltpu.make_async_copy(a_ref, a_ref, recv_sems.at[nxt_src]).wait()
+            dl.dma_wait(recv_sems.at[nxt_src], a_ref)
             pltpu.make_async_copy(
                 ag_ref.at[pl.ds(nxt_src * m_loc, m_loc)], a_vmem.at[nxt],
                 a_sem).start()

@@ -62,8 +62,7 @@ def _one_shot_ar_kernel(n: int, axis: str, x_ref, o_ref, land_ref,
     for p in range(n):
         dl.putmem_nbi(land_ref.at[me], x_ref, send_sem, recv_sem,
                       jnp.int32(p), axis)
-    for _ in range(n):
-        pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+    dl.dma_wait(recv_sem, x_ref, n)
     cp = pltpu.make_async_copy(land_ref.at[0], tmp_vmem, copy_sem)
     cp.start()
     cp.wait()
@@ -106,8 +105,7 @@ def _two_shot_ar_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
                           send_sems.at[slot], rs_recv_sems.at[slot], right,
                           axis)
         else:
-            pltpu.make_async_copy(land_ref.at[0], land_ref.at[0],
-                                  rs_recv_sems.at[(s - 1) % 2]).wait()
+            dl.dma_wait(rs_recv_sems.at[(s - 1) % 2], land_ref.at[0])
             cp = pltpu.make_async_copy(land_ref.at[(s - 1) % 2], tmp_vmem,
                                        copy_sem)
             cp.start()
@@ -127,12 +125,11 @@ def _two_shot_ar_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
             cp.start()
             cp.wait()
             if s >= 2:
-                pltpu.semaphore_wait(credit_sem, 1)
+                dl.signal_wait_until(credit_sem, 1)
             dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
                           send_sems.at[slot], rs_recv_sems.at[slot], right,
                           axis)
-    pltpu.make_async_copy(land_ref.at[0], land_ref.at[0],
-                          rs_recv_sems.at[(n - 2) % 2]).wait()
+    dl.dma_wait(rs_recv_sems.at[(n - 2) % 2], land_ref.at[0])
     cp = pltpu.make_async_copy(land_ref.at[(n - 2) % 2], tmp_vmem, copy_sem)
     cp.start()
     cp.wait()
@@ -152,7 +149,7 @@ def _two_shot_ar_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
     dl.quiet(send_sems.at[(n - 2) % 2], land_ref.at[0], 1)
     if n > 2:
         dl.quiet(send_sems.at[(n - 3) % 2], land_ref.at[0], 1)
-    pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+    dl.signal_wait_until(credit_sem, 2 if n > 2 else 1)
     # ---- Phase 2: ring all-gather of reduced chunks through o_ref ----
     dl.barrier_all(axis)
     for s in range(n - 1):
@@ -161,8 +158,7 @@ def _two_shot_ar_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
                       o_ref.at[pl.ds(src * m_loc, m_loc)],
                       send_sems.at[0], ag_recv_sems.at[src], right, axis)
         nxt = jax.lax.rem(me - s - 1 + jnp.int32(2 * n), jnp.int32(n))
-        pltpu.make_async_copy(land_ref.at[0], land_ref.at[0],
-                              ag_recv_sems.at[nxt]).wait()
+        dl.dma_wait(ag_recv_sems.at[nxt], land_ref.at[0])
     dl.quiet(send_sems.at[0], land_ref.at[0], n - 1)
 
 

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -378,11 +378,8 @@ def _a2a_payload_kernel(n: int, axis: str, x_ref, m_ref, ox_ref, om_ref,
         dl.putmem_nbi(om_ref.at[pl.ds(me * Cm, Cm)],
                       m_ref.at[pl.ds(p * Cm, Cm)],
                       send_sem, recv_m_sem, jnp.int32(p), axis)
-    for _ in range(n):
-        pltpu.make_async_copy(x_ref.at[pl.ds(0, C)],
-                              x_ref.at[pl.ds(0, C)], recv_x_sem).wait()
-        pltpu.make_async_copy(m_ref.at[pl.ds(0, Cm)],
-                              m_ref.at[pl.ds(0, Cm)], recv_m_sem).wait()
+    dl.dma_wait(recv_x_sem, x_ref.at[pl.ds(0, C)], n)
+    dl.dma_wait(recv_m_sem, m_ref.at[pl.ds(0, Cm)], n)
     dl.quiet(send_sem, x_ref.at[pl.ds(0, C)], n)
     dl.quiet(send_sem, m_ref.at[pl.ds(0, Cm)], n)
 

@@ -59,10 +59,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu import language as dl
-from triton_dist_tpu.runtime import (interpret_mode, next_collective_id,
+from triton_dist_tpu.runtime import (interpret_mode,
                                      shmem_compiler_params)
 
 
@@ -193,9 +192,7 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
         if step > 0:
             # per-slab arrival signal (the consumer-side dl.wait of the
             # reference's dispatch/consume handshake)
-            pltpu.make_async_copy(recv_ref.at[:, pl.ds(0, cap_e), :],
-                                  recv_ref.at[:, pl.ds(0, cap_e), :],
-                                  recv_sems.at[q]).wait()
+            dl.dma_wait(recv_sems.at[q], recv_ref.at[:, pl.ds(0, cap_e), :])
         if bi is not None:
             # tiled weights: split each expert MLP over I-tiles with an
             # accumulated down-proj — the fused-kernel analog of the
@@ -361,8 +358,7 @@ def _ep_fused_kernel(n: int, axis: str, E: int, cap_e: int,
     # n-1 combine slabs land here (peer r signals my ydone_sems[r])
     for step in range(1, n):
         r = jax.lax.rem(me + jnp.int32(step), jnp.int32(n))
-        pltpu.make_async_copy(yback_ref.at[0], yback_ref.at[0],
-                              ydone_sems.at[r]).wait()
+        dl.dma_wait(ydone_sems.at[r], yback_ref.at[0])
     dl.quiet(send_sem, x_ref.at[0], 2 * (n - 1))
 
 

@@ -161,9 +161,7 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int, quant: bool,
     push(nt - 1)
 
     # n peers x nt tiles land here
-    for _ in range(n * nt):
-        pltpu.make_async_copy(tile(send_buf, 0), tile(send_buf, 0),
-                              recv_sem).wait()
+    dl.dma_wait(recv_sem, tile(send_buf, 0), n * nt)
     # pipelined reduce over the flattened (tile, peer) iteration space
     pltpu.make_async_copy(tile(land_ref.at[0], 0), l_vmem.at[0],
                           l_sems.at[0]).start()
@@ -191,9 +189,7 @@ def _gemm_ar_kernel(n: int, axis: str, block_n: int, quant: bool,
     for j in range(max(nt - 2, 0), nt):
         pltpu.make_async_copy(t_vmem.at[j % 2], tile(o_ref, j),
                               t_sems.at[j % 2]).wait()
-    for _ in range(n * nt):
-        pltpu.make_async_copy(tile(send_buf, 0), tile(send_buf, 0),
-                              send_sem).wait()
+    dl.quiet(send_sem, tile(send_buf, 0), n * nt)
 
 
 def _gemm_ar_call(a_shard, b_shard, ctx: GemmARContext, s_shard=None):

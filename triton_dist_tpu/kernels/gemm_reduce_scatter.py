@@ -214,8 +214,7 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
         if s >= 1:
             # consumer: add the accumulated chunk from the left (per-slot
             # recv semaphore against out-of-order arrival)
-            pltpu.make_async_copy(o_ref, o_ref,
-                                  recv_sems.at[(s - 1) % 2]).wait()
+            dl.dma_wait(recv_sems.at[(s - 1) % 2], o_ref)
             prev_slot = (s - 1) % 2
 
             def land_src(j):
@@ -261,7 +260,7 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
         if not last:
             if s >= 2:
                 # right neighbor must have consumed this slot's previous load
-                pltpu.semaphore_wait(credit_sem, 1)
+                dl.signal_wait_until(credit_sem, 1)
             dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
                           send_sems.at[slot], recv_sems.at[slot], right, axis)
     # drain the last outstanding send on each slot (n=1 sends nothing)
@@ -269,7 +268,7 @@ def _gemm_rs_kernel(n: int, axis: str, block_n: int, quant: bool,
         dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
         if n > 2:
             dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
-        pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+        dl.signal_wait_until(credit_sem, 2 if n > 2 else 1)
 
 
 def _gemm_rs_call(a_shard, b_shard,

@@ -22,8 +22,6 @@ against jax.grad of the pure-XLA oracle path (tests/test_grad.py).
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

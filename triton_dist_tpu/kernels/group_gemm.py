@@ -16,7 +16,6 @@ are computed-then-masked, the standard TPU MoE trade.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

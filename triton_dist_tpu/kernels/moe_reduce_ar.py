@@ -116,9 +116,7 @@ def _moe_ar_kernel(n: int, axis: str, E: int, resident_b: bool,
     push(E - 1)
 
     # n peers x E slabs land here
-    for _ in range(n * E):
-        pltpu.make_async_copy(send_buf.at[0], send_buf.at[0],
-                              recv_sem).wait()
+    dl.dma_wait(recv_sem, send_buf.at[0], n * E)
     # pipelined reduce over the flattened (expert, peer) space
     pltpu.make_async_copy(land_ref.at[0, 0], l_vmem.at[0],
                           l_sems.at[0]).start()
@@ -146,9 +144,7 @@ def _moe_ar_kernel(n: int, axis: str, E: int, resident_b: bool,
     for e in range(max(E - 2, 0), E):
         pltpu.make_async_copy(t_vmem.at[e % 2], o_ref.at[e],
                               t_sems.at[e % 2]).wait()
-    for _ in range(n * E):
-        pltpu.make_async_copy(send_buf.at[0], send_buf.at[0],
-                              send_sem).wait()
+    dl.quiet(send_sem, send_buf.at[0], n * E)
 
 
 def moe_reduce_ar(h, w2, *, mesh: Mesh, axis: str = "tp",

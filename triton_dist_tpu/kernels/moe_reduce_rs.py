@@ -164,8 +164,7 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
             # recv wait and the credit signal are PROTOCOL (always run);
             # the data movement + VPU add between them are the "fold"
             # ablation phase.
-            pltpu.make_async_copy(o_ref, o_ref,
-                                  recv_sems.at[(s - 1) % 2]).wait()
+            dl.dma_wait(recv_sems.at[(s - 1) % 2], o_ref)
             prev = (s - 1) % 2
             if "fold" not in ablate:
                 pltpu.make_async_copy(dest.at[0], d_vmem.at[0],
@@ -201,7 +200,7 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
             dl.signal_op(credit_sem, 1, left, axis)
         if not last:
             if s >= 2:
-                pltpu.semaphore_wait(credit_sem, 1)
+                dl.signal_wait_until(credit_sem, 1)
             dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
                           send_sems.at[slot], recv_sems.at[slot], right,
                           axis)
@@ -209,7 +208,7 @@ def _moe_rs_kernel(n: int, axis: str, E: int, resident_b: bool,
         dl.quiet(send_sems.at[(n - 2) % 2], o_ref, 1)
         if n > 2:
             dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
-        pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+        dl.signal_wait_until(credit_sem, 2 if n > 2 else 1)
 
 
 def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",

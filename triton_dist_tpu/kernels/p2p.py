@@ -36,7 +36,7 @@ def _p2p_shift_kernel(n: int, axis: str, reverse: bool,
     dst = left if reverse else right
     dl.barrier_all(axis)
     dl.putmem_nbi(o_ref, x_ref, send_sem, recv_sem, dst, axis)
-    pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+    dl.dma_wait(recv_sem, x_ref)
     dl.quiet(send_sem, x_ref, 1)
 
 

@@ -62,8 +62,7 @@ def _one_shot_rs_kernel(n: int, axis: str, x_ref, o_ref, land_ref,
                       x_ref.at[pl.ds(p * m_loc, m_loc)],
                       send_sem, recv_sem, jnp.int32(p), axis)
     # n contributions of one chunk each have landed
-    for _ in range(n):
-        pltpu.make_async_copy(o_ref, o_ref, recv_sem).wait()
+    dl.dma_wait(recv_sem, o_ref, n)
     cp = pltpu.make_async_copy(land_ref.at[0], tmp_vmem, copy_sem)
     cp.start()
     cp.wait()
@@ -109,8 +108,7 @@ def _ring_rs_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
                           x_ref.at[pl.ds(chunk * m_loc, m_loc)],
                           send_sems.at[slot], recv_sems.at[slot], right, axis)
         else:
-            pltpu.make_async_copy(o_ref, o_ref,
-                                  recv_sems.at[(s - 1) % 2]).wait()
+            dl.dma_wait(recv_sems.at[(s - 1) % 2], o_ref)
             cp = pltpu.make_async_copy(land_ref.at[(s - 1) % 2], tmp_vmem,
                                        copy_sem)
             cp.start()
@@ -133,11 +131,11 @@ def _ring_rs_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
             if s >= 2:
                 # right neighbor must have consumed this slot's previous
                 # payload before we overwrite its landing buffer
-                pltpu.semaphore_wait(credit_sem, 1)
+                dl.signal_wait_until(credit_sem, 1)
             dl.putmem_nbi(land_ref.at[slot], send_buf.at[slot],
                           send_sems.at[slot], recv_sems.at[slot], right, axis)
     # final arrival: fully-accumulated chunk `me` minus our own partial
-    pltpu.make_async_copy(o_ref, o_ref, recv_sems.at[(n - 2) % 2]).wait()
+    dl.dma_wait(recv_sems.at[(n - 2) % 2], o_ref)
     cp = pltpu.make_async_copy(land_ref.at[(n - 2) % 2], tmp_vmem, copy_sem)
     cp.start()
     cp.wait()
@@ -158,7 +156,7 @@ def _ring_rs_kernel(n: int, axis: str, x_ref, o_ref, land_ref, send_buf,
         dl.quiet(send_sems.at[(n - 3) % 2], o_ref, 1)
     # Drain remaining credits so the semaphore ends at zero: (n-1) granted
     # (one per consumed slot), max(0, n-3) consumed before sends.
-    pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+    dl.signal_wait_until(credit_sem, 2 if n > 2 else 1)
 
 
 def _rs_pallas(x_shard, *, n: int, axis: str, method: ReduceScatterMethod,

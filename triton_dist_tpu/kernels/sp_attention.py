@@ -260,7 +260,7 @@ def _ring_attn_kernel(n: int, axis: str, bx: int, br: int, bt: int,
                 # r-1: wait its credit so a causal-skip-fast ring cannot
                 # overwrite a slot still being consumed (same protocol
                 # as gemm_rs's credit_sem)
-                pltpu.semaphore_wait(credit_sem, 1)
+                dl.signal_wait_until(credit_sem, 1)
             # forward the block we are about to consume; the DMA rides
             # under this step's tiles (the overlap). Per-step recv
             # semaphores: a fast neighbor's r+1 put must not satisfy
@@ -349,9 +349,8 @@ def _ring_attn_kernel(n: int, axis: str, bx: int, br: int, bt: int,
             dl.signal_op(credit_sem, 1, left, axis)
         if r < n - 1:
             # the per-step signal: next block landed from the left
-            pltpu.make_async_copy(k_ref, k_ref, recv_sems.at[2 * r]).wait()
-            pltpu.make_async_copy(k_ref, k_ref,
-                                  recv_sems.at[2 * r + 1]).wait()
+            dl.dma_wait(recv_sems.at[2 * r], k_ref)
+            dl.dma_wait(recv_sems.at[2 * r + 1], k_ref)
     if n > 1:
         dl.quiet(send_sem, k_ref, 2)
 

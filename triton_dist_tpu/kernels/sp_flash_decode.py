@@ -84,10 +84,8 @@ def _lse_combine_kernel(n: int, axis: str, block_r: int,
         dl.putmem_nbi(land_st.at[me], st_ref, send_sem, recv_sem,
                       jnp.int32(p), axis)
     # n acc-sized + n stats-sized arrivals (own slots; order irrelevant)
-    for _ in range(n):
-        pltpu.make_async_copy(acc_ref, acc_ref, recv_sem).wait()
-    for _ in range(n):
-        pltpu.make_async_copy(st_ref, st_ref, recv_sem).wait()
+    dl.dma_wait(recv_sem, acc_ref, n)
+    dl.dma_wait(recv_sem, st_ref, n)
     # stats are tiny: load all n slots and compute the global m*, and the
     # per-slot rescale exp(m_p - m*) and combined l* on the VPU once.
     cp = pltpu.make_async_copy(land_st, vst, copy_sem)
@@ -301,12 +299,7 @@ def _kv_scatter_kernel(n: int, axis: str, s_loc: int, t_loc: int, S: int,
     lo = me * t_loc
     cnt = jnp.clip((jnp.int32(S) - lo + s_loc - 1) // s_loc, 0,
                    t_loc // s_loc)
-
-    def body(i, c):
-        pltpu.make_async_copy(src_ref, src_ref, recv_sem).wait()
-        return c
-
-    jax.lax.fori_loop(0, cnt, body, 0)
+    dl.dma_wait_dyn(recv_sem, src_ref, cnt)
     dl.quiet(send_sem, src_ref, 1)
 
 

@@ -10,7 +10,6 @@ kernel between DMAs (and as the single-device unit test target).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

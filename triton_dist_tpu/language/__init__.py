@@ -20,6 +20,7 @@ from triton_dist_tpu.language.shmem_device import (  # noqa: F401
     signal_op,
     signal_wait_until,
     dma_wait,
+    dma_wait_dyn,
     wait,
     consume_token,
     quiet,

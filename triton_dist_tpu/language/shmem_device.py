@@ -26,7 +26,7 @@ resources, not addressable flag memory.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 # --------------------------------------------------------------------------
 
 _COMM_TRACE = None
+# Strong refs to every semaphore object seen during an active trace:
+# event sem keys are id()s, and a collected ref's id can be REUSED by
+# a later kernel's semaphore in the same block (observed as spurious
+# cross-kernel ledger merges in multi-kernel ops like all_reduce_2d).
+# Pinning the objects for the block's duration makes keys unique.
+_COMM_TRACE_PINS = None
 
 
 class comm_trace:
@@ -55,14 +61,17 @@ class comm_trace:
     """
 
     def __enter__(self):
-        global _COMM_TRACE
+        global _COMM_TRACE, _COMM_TRACE_PINS
         self._prev = _COMM_TRACE
+        self._prev_pins = _COMM_TRACE_PINS
         _COMM_TRACE = []
+        _COMM_TRACE_PINS = []
         return _COMM_TRACE
 
     def __exit__(self, *exc):
-        global _COMM_TRACE
+        global _COMM_TRACE, _COMM_TRACE_PINS
         _COMM_TRACE = self._prev
+        _COMM_TRACE_PINS = self._prev_pins
         return False
 
 
@@ -75,13 +84,45 @@ def _ref_bytes(ref):
         return None
 
 
+def _sem_key(sem):
+    """Within-one-trace identity of a semaphore operand, so
+    analysis/protocol.py can match set/wait pairs. `.at[...]` views
+    (TransformedRef) unwrap to their base ref — the signal graph cares
+    about the hardware semaphore, not the slice addressing it. The id
+    is only meaningful inside a single `comm_trace` block (the same
+    scratch ref object flows through one kernel trace)."""
+    for _ in range(8):
+        if type(sem).__name__ == "TransformedRef":
+            sem = sem.ref
+        else:
+            break
+    if _COMM_TRACE_PINS is not None:
+        _COMM_TRACE_PINS.append(sem)
+    return id(sem)
+
+
+def _caller_src() -> str:
+    """file:line of the facade call site (the innermost frame outside
+    this module) — the diagnostic anchor analysis/protocol.py attaches
+    to every signal-graph finding. Only computed while a comm_trace is
+    active, so the facade stays free on ordinary traces."""
+    import traceback
+    for fr in reversed(traceback.extract_stack()):
+        if "shmem_device" not in fr.filename:
+            return f"{fr.filename}:{fr.lineno}"
+    return "<unknown>"
+
+
 def _emit(op: str, ref=None, **kw):
     if _COMM_TRACE is None:
         return
-    ev = {"op": op}
+    ev = {"op": op, "src": _caller_src()}
     if ref is not None:
         ev["bytes"] = _ref_bytes(ref)
         ev["shape"] = tuple(getattr(ref, "shape", ()) or ())
+    for k in ("send_sem", "recv_sem", "sem"):
+        if k in kw and kw[k] is not None:
+            kw[k] = _sem_key(kw[k])
     ev.update(kw)
     _COMM_TRACE.append(ev)
 
@@ -145,7 +186,7 @@ def putmem_nbi(dst_ref, src_ref, send_sem, recv_sem, pe,
     device `pe` of the same kernel instance (ref: nvshmem_putmem_nbi_block,
     libshmem_device.py). Returns the descriptor; call .wait_send()/.wait()
     or use quiet() on the send semaphore."""
-    _emit("put", src_ref, axis=axis)
+    _emit("put", src_ref, axis=axis, send_sem=send_sem, recv_sem=recv_sem)
     device_id, did_type = _device_id(pe, axis)
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref, dst_ref=dst_ref,
@@ -172,14 +213,14 @@ def local_copy(dst_ref, src_ref, sem) -> None:
     putmem from the peer's program instance. Keeping the name honest
     avoids silently-local 'gets' in ported kernels.
     """
-    _emit("local_copy", src_ref)
+    _emit("local_copy", src_ref, sem=sem)
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
     dma.start()
     dma.wait()
 
 
 def local_copy_nbi(dst_ref, src_ref, sem):
-    _emit("local_copy_nbi", src_ref)
+    _emit("local_copy_nbi", src_ref, sem=sem)
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
     dma.start()
     return dma
@@ -188,7 +229,7 @@ def local_copy_nbi(dst_ref, src_ref, sem):
 def signal_op(sem, inc: int = 1, pe=None, axis: Optional[str] = None) -> None:
     """Increment a (possibly remote) semaphore (ref: nvshmemx_signal_op
     with NVSHMEM_SIGNAL_ADD)."""
-    _emit("signal", remote=pe is not None, axis=axis)
+    _emit("signal", remote=pe is not None, axis=axis, sem=sem, inc=inc)
     if pe is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -202,6 +243,7 @@ def signal_wait_until(sem, value: int) -> None:
     it (ref: nvshmem_signal_wait_until(EQ)). Pallas semaphore_wait
     decrements by `value`, which matches the reference's reset-after-wait
     idiom. For DMA-completion semaphores use dma_wait()."""
+    _emit("sem_wait", sem=sem, value=value)
     pltpu.semaphore_wait(sem, value)
 
 
@@ -210,15 +252,31 @@ def dma_wait(sem, ref, count: int = 1) -> None:
     semaphore. TPU DMA semaphores count *bytes*, so the wait is expressed
     by a descriptor of matching shape (the canonical Pallas idiom: a
     self-copy descriptor used only for its wait)."""
-    _emit("dma_wait", ref, count=count)
+    _emit("dma_wait", ref, count=count, sem=sem)
     for _ in range(count):
         pltpu.make_async_copy(ref, ref, sem).wait()
+
+
+def dma_wait_dyn(sem, ref, count) -> None:
+    """dma_wait with a TRACED count (a fori_loop of waits): for kernels
+    whose arrival count is data-dependent (e.g. kv_cache_scatter — how
+    many blocks land in MY window depends on my rank). The comm trace
+    records the wait as dynamic; analysis/protocol.py exempts the
+    semaphore from exact set/wait balance but still checks ordering."""
+    _emit("dma_wait_dyn", ref, sem=sem)
+
+    def body(i, c):
+        pltpu.make_async_copy(ref, ref, sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, count, body, 0)
 
 
 def wait(sem, value: int = 1):
     """`dl.wait` analog (ref: language/distributed_ops.py:57): wait for a
     per-tile signal and return a token ordering subsequent loads. On TPU
     semaphore_wait already orders the DMA's data, so the token is ()."""
+    _emit("sem_wait", sem=sem, value=value)
     pltpu.semaphore_wait(sem, value)
     return ()
 

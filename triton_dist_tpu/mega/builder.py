@@ -14,7 +14,7 @@ role the runtime scoreboard plays in the reference.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Sequence, Tuple
 
 
 @dataclasses.dataclass

@@ -7,7 +7,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional
 
 import jax.numpy as jnp
 

@@ -35,7 +35,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.models.kv_cache import KVCache
 from triton_dist_tpu.runtime.telemetry import default_registry
 
 

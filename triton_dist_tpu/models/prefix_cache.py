@@ -174,14 +174,28 @@ class RefcountedPages:
 
     def retain(self, group) -> None:
         for p in group:
-            self._ref[int(p)] += 1
+            p = int(p)
+            if p not in self._ref:
+                raise ValueError(
+                    f"retain of unreferenced page {p}: only pages live "
+                    f"from alloc_group (refcount >= 1) can gain refs — "
+                    f"a retain after the last release would resurrect "
+                    f"a page the allocator may have re-issued")
+            self._ref[p] += 1
 
     def release(self, group) -> None:
         """Drop one ref per page of the group; pages at zero go back to
-        the free list (the allocator re-checks double-frees)."""
+        the free list (the allocator re-checks double-frees). A release
+        past zero raises BEFORE touching the pool — the silent failure
+        mode is a page freed while a radix-tree node still maps it."""
         freed = []
         for p in group:
             p = int(p)
+            if p not in self._ref:
+                raise ValueError(
+                    f"refcount underflow: release of page {p} at "
+                    f"refcount 0 (already fully released, or never "
+                    f"allocated) — some holder released a group twice")
             c = self._ref[p] - 1
             if c:
                 self._ref[p] = c

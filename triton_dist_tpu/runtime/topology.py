@@ -15,7 +15,6 @@ import dataclasses
 from typing import Optional, Sequence, Tuple
 
 import jax
-import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,7 +18,6 @@ timings then measure the interpreter.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 
@@ -28,13 +27,20 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-PHASES = {
-    "ag_group_gemm": ("dots", "b_stream", "a_stream", "writeback"),
-    "moe_reduce_rs": ("dots", "b_stream", "a_stream", "writeback",
-                      "fold"),
-    "ep_fused": ("dots", "w_stream", "a_stream", "stage"),
-    "gdn": ("exps", "solve", "out", "state"),
-}
+def _phases() -> dict:
+    """Ablation-phase table, derived from the central kernel registry
+    (kernels.kernel_registry — ISSUE 15: one enumeration for tdcheck,
+    bench and the profile tools). A registry entry with
+    ablation_phases IS a kprof target; the name mapping keeps this
+    CLI's historical spellings (PROFILE_gdn.json etc.)."""
+    from triton_dist_tpu.kernels import kernel_registry
+    alias = {"gdn_fwd": "gdn"}
+    return {alias.get(name, name): spec.ablation_phases
+            for name, spec in kernel_registry().items()
+            if spec.ablation_phases}
+
+
+PHASES = _phases()
 
 
 def _maker(kernel: str, mesh, on_tpu: bool):

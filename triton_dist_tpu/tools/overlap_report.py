@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu import language as dl
-from triton_dist_tpu.tools.perf_model import ChipSpec, _SPECS, gemm_sol_us
+from triton_dist_tpu.tools.perf_model import ChipSpec, _SPECS
 
 
 def _trace(fn, *args):

@@ -115,6 +115,38 @@ def chain(op):
     return step
 
 
+# registry name -> this report's row name(s); names absent here match
+# on the registry name itself. Rows measure the HOST-LEVEL op, so
+# several registry entries share one row (methods are row variants).
+_ROW_OF = {
+    "allgather_one_shot": "all_gather(one_shot)",
+    "allgather_ring": "all_gather(ring)",
+    "allreduce_one_shot": "all_reduce(one_shot)",
+    "allreduce_two_shot": "all_reduce(two_shot)",
+    "reduce_scatter_one_shot": "reduce_scatter",
+    "reduce_scatter_ring": "reduce_scatter",
+    "gemm_ar": "gemm_allreduce",
+    "gdn_fwd": "gdn_fwd(pallas)",
+}
+
+
+def registry_coverage(measured_ops):
+    """Cross-check this report's rows against the central kernel
+    registry (kernels.kernel_registry — ISSUE 15: one enumeration for
+    tdcheck, bench and the profile tools). A kernel added to the
+    registry shows in `uncovered` until it gets a measured row here
+    (named in _ROW_OF when the row spelling differs), so the catalogs
+    cannot silently drift apart."""
+    from triton_dist_tpu.kernels import kernel_registry
+    measured = set(measured_ops)
+    uncovered = []
+    for name in kernel_registry():
+        if _ROW_OF.get(name, name) not in measured:
+            uncovered.append(name)
+    return {"kernels_registered": len(kernel_registry()),
+            "uncovered": sorted(uncovered)}
+
+
 def run_report(write_json=None):
     from triton_dist_tpu.kernels import (
         AllGatherMethod, AllReduceMethod, ag_gemm, all_gather, all_reduce,
@@ -402,7 +434,8 @@ def run_report(write_json=None):
               "git": git + ("+dirty" if dirty else ""),
               "date": datetime.datetime.now(
                   datetime.timezone.utc).isoformat(timespec="seconds")}
-    out = {"env": header, "ops": rows}
+    out = {"env": header, "ops": rows,
+           "registry": registry_coverage([r["op"] for r in rows])}
     if write_json:
         with open(write_json, "w") as f:
             json.dump(out, f, indent=1)
