@@ -1248,6 +1248,28 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # roofline rows: per-kernel achieved/SOL fractions from
+    # tools/perf_report, into the same capture + history ledger so
+    # bench_compare --strict gates on same-backend roofline
+    # regressions. TDTPU_BENCH_SOLFRAC: "0" disables, "all" runs the
+    # full report, default runs the GATE_OPS subset. Best-effort — the
+    # roofline report must never fail the bench; its human-readable
+    # printout goes to stderr so stdout stays one JSON line per row.
+    solfrac_mode = os.environ.get("TDTPU_BENCH_SOLFRAC", "")
+    if solfrac_mode != "0":
+        try:
+            import contextlib
+
+            from triton_dist_tpu.tools.perf_report import (
+                GATE_OPS, run_report, sol_frac_rows)
+            with contextlib.redirect_stdout(sys.stderr):
+                rep = run_report(
+                    only=None if solfrac_mode == "all" else GATE_OPS)
+            for row in sol_frac_rows(rep):
+                _emit_json(row)
+        except Exception as e:  # pragma: no cover - outage guard
+            print(f"sol_frac report skipped: {e!r}", file=sys.stderr)
+
 
 def main():
     if os.environ.get("TDTPU_BENCH_CHILD") == "1":

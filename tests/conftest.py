@@ -70,6 +70,17 @@ if not _real:
     # hangs/aborts mid-suite). Dispatch sync costs a little wall time
     # and removes the whole failure class.
     os.environ.setdefault("JAX_CPU_ENABLE_ASYNC_DISPATCH", "false")
+    # Pin the swept-config store (tools/sweep.py) to a per-session tmp
+    # path: a populated cache on the host (~/.triton_dist_tpu/) would
+    # otherwise silently change the block sizes kernels resolve and
+    # make test behavior machine-dependent. Tests that need a populated
+    # store point TDTPU_TUNE_CACHE at their own tmp file.
+    os.environ.setdefault(
+        "TDTPU_TUNE_CACHE",
+        os.path.join("/tmp", f"tdtpu_tune_cache_test_{os.getpid()}.json"))
+    os.environ.setdefault(
+        "TDTPU_AUTOTUNE_CACHE",
+        os.path.join("/tmp", f"tdtpu_autotune_test_{os.getpid()}.json"))
 
 def _force_cpu_backend():
     import jax

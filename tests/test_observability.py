@@ -359,6 +359,81 @@ def test_bench_compare_history_mode(tmp_path):
                     str(tmp_path / "missing.jsonl")]) == 2
 
 
+def test_bench_compare_sol_frac_direction():
+    """ISSUE 16: roofline rows (`{op}_sol_frac`, unit "frac of SOL"
+    from perf_report.sol_frac_rows) are higher-is-better — an
+    achieved/SOL fraction going DOWN is the regression — and the rule
+    must fire on the metric suffix alone even when the unit string is
+    missing (hand-rolled captures)."""
+    bc = _load_tool("bench_compare")
+    assert not bc._lower_is_better({"metric": "ag_gemm_sol_frac",
+                                    "value": 0.7, "unit": "frac of SOL"})
+    assert not bc._lower_is_better({"metric": "flash_decode_sol_frac",
+                                    "value": 0.7})         # no unit
+    # a latency-suffixed op name still resolves higher-is-better
+    # through the sol_frac suffix (the suffix rule runs FIRST)
+    assert not bc._lower_is_better(
+        {"metric": "warm_start_s_sol_frac", "unit": "frac of SOL"})
+    # and plain latency rows are untouched by the new rule
+    assert bc._lower_is_better({"metric": "lat_ms", "unit": "ms"})
+    a = [{"metric": "gemm_rs_sol_frac", "value": 0.80,
+          "unit": "frac of SOL", "backend": "tpu"}]
+    b = [{"metric": "gemm_rs_sol_frac", "value": 0.40,
+          "unit": "frac of SOL", "backend": "tpu"}]
+    res = bc.compare(a, b)[0]
+    assert res["direction"] == "higher-is-better"
+    assert res["flag"] == "regressed" and not res["notes"]
+
+
+def test_bench_compare_strict_gates_roofline_regression(tmp_path):
+    """The closed perf loop's exit check: a seeded same-backend
+    roofline regression in the history tail trips --strict (exit 1); a
+    clean tail — and a cpu-smoke one — exits 0."""
+    bc = _load_tool("bench_compare")
+    hist = tmp_path / "hist.jsonl"
+    rows = [
+        {"metric": "flash_decode_sol_frac", "value": 0.60,
+         "unit": "frac of SOL", "backend": "tpu", "run": "r1"},
+        {"metric": "flash_decode_sol_frac", "value": 0.20,
+         "unit": "frac of SOL", "backend": "tpu", "run": "r2"},
+    ]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert bc.main(["--history", "--file", str(hist), "--strict"]) == 1
+    # clean tail: fraction recovered -> improvement, strict passes
+    rows.append({"metric": "flash_decode_sol_frac", "value": 0.65,
+                 "unit": "frac of SOL", "backend": "tpu", "run": "r3"})
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert bc.main(["--history", "--file", str(hist), "--strict"]) == 0
+    # the same regression on the cpu smoke substrate stays advisory
+    cpu = tmp_path / "cpu.jsonl"
+    cpu.write_text("".join(json.dumps(dict(r, backend="cpu")) + "\n"
+                           for r in rows[:2]))
+    assert bc.main(["--history", "--file", str(cpu), "--strict"]) == 0
+
+
+def test_sol_frac_rows_shape():
+    """perf_report.sol_frac_rows flattens a report dict into ledger
+    rows: one {op}_sol_frac per measured op, degenerate rows (elided
+    chain / failed op: sol_frac None) dropped, env backend stamped."""
+    from triton_dist_tpu.tools.perf_report import (GATE_OPS,
+                                                   sol_frac_rows)
+    rep = {"env": {"backend": "tpu", "ndev": 8, "interpreted": False},
+           "ops": [{"op": "ag_gemm", "achieved_us": 20.0, "sol_us": 10.0,
+                    "sol_frac": 0.5, "note": ""},
+                   {"op": "pp_gpipe_fwd", "achieved_us": None,
+                    "sol_us": 5.0, "sol_frac": None,
+                    "note": "DEGENERATE"}]}
+    rows = sol_frac_rows(rep)
+    assert [r["metric"] for r in rows] == ["ag_gemm_sol_frac"]
+    assert rows[0]["value"] == 0.5 and rows[0]["unit"] == "frac of SOL"
+    assert rows[0]["backend"] == "tpu" and rows[0]["ndev"] == 8
+    # the CI-gate subset stays inside the report's actual row names
+    assert set(GATE_OPS) <= {
+        "ag_gemm", "gemm_rs", "gemm_allreduce", "flash_decode",
+        "flash_decode_paged", "ag_group_gemm", "moe_reduce_rs",
+        "moe_reduce_ar", "ep_fused", "gdn_fwd(pallas)"}
+
+
 # ----------------------------------------------------------------------
 # slow arms: the merged cross-plane trace through a THREADED
 # disaggregated TokenServer (the acceptance-criteria run) and the

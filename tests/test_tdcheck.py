@@ -99,6 +99,23 @@ def test_contracts_flags_overbudget_vmem():
     assert any("test_tdcheck.py:" in m for m in msgs), msgs
 
 
+def test_contracts_estimate_vmem_public_api():
+    """ISSUE 16: `estimate_vmem(fn, args)` is the sweep pruner's public
+    entry into the contracts VMEM model. Exact arithmetic on a known
+    kernel: (128, 128) f32 blocks in+out, grid=(4,) so both pipelined
+    buffers double — 2 * 2 * 128*128*4 = 262144 bytes. A pallas-free
+    fn estimates 0, and the number agrees with what check_kernel's
+    walk prices (behavior unchanged by the refactor: the clean-tree
+    test above still passes on the same model)."""
+    fn, args = _pallas_ident((128, 128), (128, 128), grid=(4,))
+    assert contracts.estimate_vmem(fn, args) == 2 * 2 * 128 * 128 * 4
+    # grid=(1,): single-buffered, half the bytes
+    fn1, args1 = _pallas_ident((128, 128), (128, 128), grid=(1,))
+    assert contracts.estimate_vmem(fn1, args1) == 2 * 128 * 128 * 4
+    assert contracts.estimate_vmem(lambda x: x + 1,
+                                   (jnp.zeros((8, 8)),)) == 0
+
+
 def test_contracts_flags_nondivisible_block():
     fn, args = _pallas_ident((48, 128), (128, 128))
     spec = KernelSpec("evil_blocks", "tests", "compute",

@@ -77,6 +77,14 @@ def _lower_is_better(row) -> bool:
     previously such rows fell through to higher-is-better and a
     warm-start REGRESSION rendered as an improvement."""
     unit = str(row.get("unit", ""))
+    # roofline fractions (perf_report sol_frac rows: achieved/SOL) are
+    # throughput-like — higher is better — and must resolve FIRST:
+    # their metric names end in a latency-looking spelling for some
+    # ops, and unit strings like "frac of SOL" carry no "/" to trip
+    # the rate-unit rule below
+    if str(row.get("metric", "")).endswith("_sol_frac") \
+            or "sol" in unit.lower() or "roofline" in unit.lower():
+        return False
     head = unit.split()[0] if unit.split() else ""
     if ("ms" in unit and "tok" not in unit) \
             or head in ("s", "us", "ms") or unit == "%" \

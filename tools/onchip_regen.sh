@@ -6,6 +6,7 @@
 #   bash tools/onchip_regen.sh
 #
 # Produces (repo root):
+#   tune cache (TDTPU_TUNE_CACHE / ~/.triton_dist_tpu/tune_cache.json)
 #   PERF_OPS_tpu.json            per-op SOL report (git+date stamped)
 #   PROFILE_<kernel>.json/.trace.json   ablation profiles x4
 #   BENCH_local.json             bench line (driver writes BENCH_rNN)
@@ -17,6 +18,10 @@ if ! timeout 120 python -c "import jax; assert jax.default_backend() == 'tpu', j
     echo "no TPU backend reachable - aborting (artifacts unchanged)"
     exit 1
 fi
+
+echo "== autotune sweep (populates the tune cache the reports read) =="
+timeout 3600 python -m triton_dist_tpu.tools.sweep \
+    || echo "sweep FAILED"
 
 echo "== per-op SOL report =="
 timeout 3000 python -m triton_dist_tpu.tools.perf_report \

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Roofline CI gate (ISSUE 16, ROADMAP item 5): the perf loop's exit
+# check. Two steps, both bounded:
+#
+#   1. sweep smoke — the registry-driven autotuner
+#      (triton_dist_tpu/tools/sweep.py) over a 3-kernel subset that
+#      executes on the CPU interpreter, 1 timing iter, writing to an
+#      ephemeral store unless the caller pins TDTPU_TUNE_CACHE. Proves
+#      prune -> time -> persist stays runnable.
+#   2. bench_compare --strict over the BENCH_history.jsonl tail — fails
+#      (exit 1) on a same-backend, non-cpu regression, which now
+#      includes the per-kernel roofline rows ({op}_sol_frac) bench.py
+#      emits. CPU-smoke rows stay advisory; a ledger with fewer than
+#      two runs (rc 2) is a pass-with-warning, not a failure: the gate
+#      must be installable before the history exists.
+#
+# Run from the repo root: bash tools/perf_gate.sh
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== sweep smoke (3-kernel subset) =="
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu TDTPU_NO_FAKECPUS=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    TDTPU_TUNE_CACHE="${TDTPU_TUNE_CACHE:-/tmp/_perf_gate_tune_cache.json}" \
+    python -m triton_dist_tpu.tools.sweep \
+    --kernels flash_decode,flash_decode_paged,grouped_gemm \
+    --iters 1 --warmup 1; then
+    echo "PERF_GATE: sweep smoke FAILED"
+    exit 1
+fi
+
+echo "== roofline regression compare (history tail) =="
+python tools/bench_compare.py --history --strict
+rc=$?
+if [ "$rc" -eq 2 ]; then
+    echo "PERF_GATE: no comparable history yet (need 2 runs)" \
+         "- pass with warning"
+    exit 0
+fi
+if [ "$rc" -eq 0 ]; then
+    echo "PERF_GATE: OK"
+else
+    echo "PERF_GATE: regression gate FAILED"
+fi
+exit $rc

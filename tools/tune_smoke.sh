@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Autotuning-loop smoke — the sweep/tune suite (tests/test_sweep.py,
+# INCLUDING the arms tier-1's 870 s budget pushes behind the slow
+# mark: the full-registry dry-run test and the bitwise-identity
+# matrix), then a full-registry CLI dry-run, then the bounded
+# 3-kernel sweep + roofline gate (tools/perf_gate.sh) — all on the
+# forced multi-device CPU mesh tier-1 uses. Archives the pass count
+# next to the log and reports the delta vs the previous run,
+# tp_smoke.sh-style. Run from the repo root: bash tools/tune_smoke.sh
+set -o pipefail
+rm -f /tmp/_tune_smoke.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_sweep.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_tune_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_tune_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_tune_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "TUNE_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "TUNE_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+[ "$rc" -ne 0 ] && exit "$rc"
+
+echo "== full-registry dry run =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu TDTPU_NO_FAKECPUS=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m triton_dist_tpu.tools.sweep --dry-run \
+    || { echo "TUNE_SMOKE: dry-run FAILED"; exit 1; }
+
+echo "== bounded sweep + roofline gate =="
+bash tools/perf_gate.sh || { echo "TUNE_SMOKE: perf gate FAILED"; exit 1; }
+echo "TUNE_SMOKE: OK"
+exit 0

@@ -54,6 +54,57 @@ def _block_bytes(block_shape, dtype) -> int:
     return n * _dtype_size(dtype)
 
 
+def _io_and_scratch_vars(eqn):
+    gm = eqn.params["grid_mapping"]
+    inner = eqn.params["jaxpr"]
+    # inner invars: [scalar-prefetch] + inputs + outputs + scratch
+    n_idx = gm.num_index_operands
+    n_io = gm.num_inputs + gm.num_outputs
+    return inner.invars[n_idx:n_idx + n_io], inner.invars[n_idx + n_io:]
+
+
+def _unpipelined(var) -> bool:
+    space = str(getattr(var.aval, "memory_space", None)).lower()
+    # unpipelined HBM operand (comm kernels) / scalars: no VMEM block,
+    # no divisibility contract
+    return "any" in space or "smem" in space or "semaphore" in space
+
+
+def eqn_vmem(eqn) -> int:
+    """Per-grid-step VMEM estimate (bytes) of ONE pallas_call eqn:
+    pipelined operand blocks (double-buffered when the grid actually
+    pipelines) plus VMEM scratch — the single footprint model shared by
+    the contract checker and `estimate_vmem` (the sweep pruner)."""
+    gm = eqn.params["grid_mapping"]
+    io_vars, scratch_vars = _io_and_scratch_vars(eqn)
+    nsteps = math.prod(int(g) for g in gm.grid) if gm.grid else 1
+    vmem = 0
+    for bm, var in zip(gm.block_mappings, io_vars):
+        if _unpipelined(var):
+            continue
+        bb = _block_bytes(bm.block_shape, bm.array_shape_dtype.dtype)
+        # Pallas double-buffers pipelined blocks (grid>1): 2x per operand
+        vmem += bb * (2 if nsteps > 1 else 1)
+    for var in scratch_vars:
+        space = str(getattr(var.aval, "memory_space", None)).lower()
+        if "vmem" in space:
+            vmem += _block_bytes(var.aval.shape, var.aval.dtype)
+    return vmem
+
+
+def estimate_vmem(fn, args) -> int:
+    """Public VMEM-footprint API (ISSUE 16): trace `fn(*args)` (a pure
+    trace — nothing executes, no device memory is touched) and return
+    the MAX per-grid-step VMEM estimate in bytes over every pallas_call
+    in the trace — exactly the model the contract checker gates on.
+    Returns 0 when the trace contains no pallas_call (XLA-only fn)."""
+    import jax
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return max((eqn_vmem(e)
+                for e in iter_eqns(jaxpr.jaxpr, "pallas_call")),
+               default=0)
+
+
 def analyze_pallas_eqn(eqn, report: Report, kernel_name: str,
                        budget: int) -> dict:
     """Contract checks for ONE pallas_call eqn; returns the extracted
@@ -61,18 +112,11 @@ def analyze_pallas_eqn(eqn, report: Report, kernel_name: str,
     (aliases may live on ANY pallas_call of a kernel's trace)."""
     gm = eqn.params["grid_mapping"]
     src = eqn_src(eqn)
-    inner = eqn.params["jaxpr"]
     body_name = eqn.params["name_and_src_info"].name
     subject = f"{kernel_name}/{body_name}"
 
-    # inner invars: [scalar-prefetch] + inputs + outputs + scratch
-    n_idx = gm.num_index_operands
-    n_in = gm.num_inputs
-    n_out = gm.num_outputs
-    io_vars = inner.invars[n_idx:n_idx + n_in + n_out]
-    scratch_vars = inner.invars[n_idx + n_in + n_out:]
-
-    vmem = 0
+    io_vars, _ = _io_and_scratch_vars(eqn)
+    vmem = eqn_vmem(eqn)
     pipelined = 0
     blocks = []
     for bm, var in zip(gm.block_mappings, io_vars):
@@ -81,15 +125,9 @@ def analyze_pallas_eqn(eqn, report: Report, kernel_name: str,
         rec = dict(block=tuple(bm.block_shape), array=tuple(arr.shape),
                    dtype=str(arr.dtype), space=space)
         blocks.append(rec)
-        if "any" in space or "smem" in space or "semaphore" in space:
-            # unpipelined HBM operand (comm kernels) / scalars: no VMEM
-            # block, no divisibility contract
+        if _unpipelined(var):
             continue
         pipelined += 1
-        bb = _block_bytes(bm.block_shape, arr.dtype)
-        # Pallas double-buffers pipelined blocks (grid>1): 2x per operand
-        nsteps = math.prod(int(g) for g in gm.grid) if gm.grid else 1
-        vmem += bb * (2 if nsteps > 1 else 1)
         for bdim, adim in zip(bm.block_shape, arr.shape):
             if not isinstance(bdim, int):
                 continue
@@ -102,11 +140,6 @@ def analyze_pallas_eqn(eqn, report: Report, kernel_name: str,
                     f"trailing block and unmasked reductions read "
                     f"garbage")
                 break
-
-    for var in scratch_vars:
-        space = str(getattr(var.aval, "memory_space", None)).lower()
-        if "vmem" in space:
-            vmem += _block_bytes(var.aval.shape, var.aval.dtype)
 
     if vmem > budget:
         report.add(

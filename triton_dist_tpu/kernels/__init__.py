@@ -113,7 +113,20 @@ class KernelSpec:
     the trace MUST carry (the contract analyzer flags a registered
     in-place kernel whose donation went missing). vmem_budget overrides
     the analyzer's default per-grid-step VMEM bound (bytes).
-    ablation_phases feeds tools/kprof_run.py (the old ad-hoc PHASES)."""
+    ablation_phases feeds tools/kprof_run.py (the old ad-hoc PHASES).
+
+    tunables (ISSUE 16): the declared tunable config space — a tuple of
+    config dicts (every dict the same keys; only SCHEDULE knobs, never
+    anything that changes the math: tuned output must stay bitwise
+    equal to the default). tools/sweep.py prunes the space with the
+    contracts VMEM/divisibility checker, times survivors, and persists
+    the winner per (kernel, shape-bucket, chip); the kernel's default
+    path consumes it through sweep.resolve_config. tune_dims(*args) ->
+    dims tuple maps the builder's args to the bucketing dims — it MUST
+    compute the same dims the consuming kernel derives from its own
+    arguments (None = shape-generic, stored under the "*" bucket).
+    variants: extra builders at shape-bucket-variant shapes, swept in
+    addition to the canonical build."""
 
     name: str
     module: str
@@ -124,6 +137,32 @@ class KernelSpec:
     inplace: _Tuple[_Tuple[int, int], ...] = ()
     vmem_budget: _Optional[int] = None
     ablation_phases: _Tuple[str, ...] = ()
+    tunables: _Tuple[dict, ...] = ()
+    tune_dims: _Optional[_Callable] = None
+    variants: _Tuple[_Callable, ...] = ()
+
+    def __post_init__(self):
+        # structural validation at REGISTRATION (a typo'd space fails
+        # where it was written, not at sweep time): non-empty dicts,
+        # uniform keys — the sweep's pruner then rejects a space whose
+        # every config fails VMEM/divisibility before timing anything
+        keys = None
+        for cfg in self.tunables:
+            if not isinstance(cfg, dict) or not cfg:
+                raise ValueError(
+                    f"KernelSpec({self.name}): tunables must be "
+                    f"non-empty config dicts, got {cfg!r}")
+            if keys is None:
+                keys = set(cfg)
+            elif set(cfg) != keys:
+                raise ValueError(
+                    f"KernelSpec({self.name}): tunable configs must "
+                    f"share one key set, got {sorted(keys)} vs "
+                    f"{sorted(cfg)}")
+        if self.variants and not self.tunables:
+            raise ValueError(
+                f"KernelSpec({self.name}): shape variants without a "
+                f"tunables space have nothing to sweep")
 
 
 def _np_rng(seed=0):
@@ -334,15 +373,17 @@ def _b_two_tier(which):
     return build
 
 
-def _b_flash_decode(mesh):
-    import jax.numpy as jnp
-    rng = _np_rng(13)
-    B, Hq, Hkv, T, d = 2, 4, 2, 256, 128
-    q = _f32(rng, B, 1, Hq, d)
-    k = _f32(rng, B, Hkv, T, d)
-    v = _f32(rng, B, Hkv, T, d)
-    return (lambda q_, k_, v_: flash_decode(q_, k_, v_, jnp.int32(T)),
-            (q, k, v))
+def _b_flash_decode(B=2):
+    def build(mesh):
+        import jax.numpy as jnp
+        rng = _np_rng(13)
+        Hq, Hkv, T, d = 4, 2, 256, 128
+        q = _f32(rng, B, 1, Hq, d)
+        k = _f32(rng, B, Hkv, T, d)
+        v = _f32(rng, B, Hkv, T, d)
+        return (lambda q_, k_, v_: flash_decode(q_, k_, v_, jnp.int32(T)),
+                (q, k, v))
+    return build
 
 
 def _b_flash_decode_paged(partial):
@@ -379,11 +420,13 @@ def _b_kv_update(mesh):
     return (lambda c, n_: kv_update(c, n_, jnp.int32(0)), (cache, new))
 
 
-def _b_grouped_gemm(mesh):
-    rng = _np_rng(16)
-    x = _f32(rng, 2, 64, 128)
-    w = _f32(rng, 2, 128, 128)
-    return (grouped_gemm, (x, w))
+def _b_grouped_gemm(C=64):
+    def build(mesh):
+        rng = _np_rng(16)
+        x = _f32(rng, 2, C, 128)
+        w = _f32(rng, 2, 128, 128)
+        return (grouped_gemm, (x, w))
+    return build
 
 
 def _b_swiglu(mesh):
@@ -412,6 +455,44 @@ def _b_flash_attention(mesh):
     k = _f32(rng, B, Hkv, S, d)
     v = _f32(rng, B, Hkv, S, d)
     return (flash_attention, (q, k, v))
+
+
+# Tunable config spaces (ISSUE 16). SCHEDULE knobs only — every axis
+# here retiles a non-contraction dim, regroups streams, or changes
+# staging/residency depth, so tuned output stays bitwise equal to the
+# default (tests/test_sweep.py asserts it). Deliberately NOT tunable:
+# flash block_t (KV tile size regroups the online-softmax updates) and
+# ep_fused block_i (splits the down-proj contraction) — both change
+# float summation order.
+def _grid(key, *vals):
+    return tuple({key: v} for v in vals)
+
+
+_TUNE_FLASH_DECODE = _grid("block_x", 32, 64, 128)
+_TUNE_PAGED = _grid("block_w", 1, 2, 4, 8)
+_TUNE_GROUPED_GEMM = ({"block_c": 128, "block_f": 256},
+                      {"block_c": 256, "block_f": 512},
+                      {"block_c": 256, "block_f": 1024},
+                      {"block_c": 512, "block_f": 512})
+_TUNE_AG_GEMM = _grid("block_n", 256, 512, 1024, 2048)
+_TUNE_COMM_GEMM = _grid("block_n", 256, 512, 1024)
+_TUNE_AG_GROUP = tuple({"block_n": bn, "wb_depth": wd}
+                       for bn in (256, 512) for wd in (2, 4))
+_TUNE_MOE_RS = _grid("wb_depth", 2, 3, 4)
+_TUNE_EP_FUSED = _grid("resident_w", True, False)
+
+# bucketing dims, shared convention with the consuming kernel (see
+# KernelSpec docstring): flash_decode (X=B*Hkv, T); paged (B*Hq,
+# pool positions); grouped_gemm (C, F); ag_group_gemm (E, capT, N);
+# moe_reduce_rs (E, capT, D). Context-scoped kernels (ag_gemm/gemm_rs/
+# gemm_ar/ep_fused) have no shapes at resolution time: tune_dims=None.
+_DIMS_FLASH_DECODE = lambda q, k, v: (q.shape[0] * k.shape[1],  # noqa: E731
+                                      k.shape[2])
+_DIMS_PAGED = lambda q, pk, pv: (q.shape[0] * q.shape[2],       # noqa: E731
+                                 pk.shape[0] * pk.shape[1])
+_DIMS_GROUPED = lambda x, w: (x.shape[1], w.shape[2])           # noqa: E731
+_DIMS_EXPERT = lambda a, b: (a.shape[0], a.shape[1],            # noqa: E731
+                             b.shape[2])
 
 
 @_functools.lru_cache(maxsize=None)
@@ -454,7 +535,8 @@ def kernel_registry() -> dict:
         KernelSpec("ep_fused", "kernels.ep_fused", "comm", _b_ep_fused,
                    min_devices=2, protocol="predicated",
                    ablation_phases=("dots", "w_stream", "a_stream",
-                                    "stage")),
+                                    "stage"),
+                   tunables=_TUNE_EP_FUSED),
         KernelSpec("sp_flash_decode_dist", "kernels.sp_flash_decode",
                    "comm", _b_sp_flash_decode("dist"), min_devices=2,
                    protocol="strict"),
@@ -465,19 +547,24 @@ def kernel_registry() -> dict:
                    _b_sp_ring("ring_shmem"), min_devices=2,
                    protocol="strict"),
         KernelSpec("ag_gemm", "kernels.allgather_gemm", "comm",
-                   _b_ag_gemm, min_devices=2, protocol="strict"),
+                   _b_ag_gemm, min_devices=2, protocol="strict",
+                   tunables=_TUNE_AG_GEMM),
         KernelSpec("gemm_rs", "kernels.gemm_reduce_scatter", "comm",
-                   _b_gemm_rs, min_devices=2, protocol="strict"),
+                   _b_gemm_rs, min_devices=2, protocol="strict",
+                   tunables=_TUNE_COMM_GEMM),
         KernelSpec("gemm_ar", "kernels.gemm_allreduce", "comm",
-                   _b_gemm_ar, min_devices=2, protocol="strict"),
+                   _b_gemm_ar, min_devices=2, protocol="strict",
+                   tunables=_TUNE_COMM_GEMM),
         KernelSpec("ag_group_gemm", "kernels.ag_group_gemm", "comm",
                    _b_ag_group_gemm, min_devices=2, protocol="strict",
                    ablation_phases=("dots", "b_stream", "a_stream",
-                                    "writeback")),
+                                    "writeback"),
+                   tunables=_TUNE_AG_GROUP, tune_dims=_DIMS_EXPERT),
         KernelSpec("moe_reduce_rs", "kernels.moe_reduce_rs", "comm",
                    _b_moe_reduce("rs"), min_devices=2, protocol="strict",
                    ablation_phases=("dots", "b_stream", "a_stream",
-                                    "writeback", "fold")),
+                                    "writeback", "fold"),
+                   tunables=_TUNE_MOE_RS, tune_dims=_DIMS_EXPERT),
         KernelSpec("moe_reduce_ar", "kernels.moe_reduce_ar", "comm",
                    _b_moe_reduce("ar"), min_devices=2, protocol="strict"),
         KernelSpec("all_gather_2d", "kernels.two_tier", "comm",
@@ -488,15 +575,21 @@ def kernel_registry() -> dict:
                    _b_two_tier("ar"), min_devices=4, protocol="strict"),
         # --- single-chip compute / paged kernels ---
         KernelSpec("flash_decode", "kernels.flash_attn", "compute",
-                   _b_flash_decode),
+                   _b_flash_decode(), tunables=_TUNE_FLASH_DECODE,
+                   tune_dims=_DIMS_FLASH_DECODE,
+                   variants=(_b_flash_decode(8),)),
         KernelSpec("flash_decode_paged", "kernels.paged_kv", "paged",
-                   _b_flash_decode_paged(False)),
+                   _b_flash_decode_paged(False), tunables=_TUNE_PAGED,
+                   tune_dims=_DIMS_PAGED),
         KernelSpec("flash_decode_paged_partial", "kernels.paged_kv",
-                   "paged", _b_flash_decode_paged(True)),
+                   "paged", _b_flash_decode_paged(True),
+                   tunables=_TUNE_PAGED, tune_dims=_DIMS_PAGED),
         KernelSpec("kv_update", "kernels.flash_attn", "compute",
                    _b_kv_update, inplace=((2, 0),)),
         KernelSpec("grouped_gemm", "kernels.group_gemm", "compute",
-                   _b_grouped_gemm),
+                   _b_grouped_gemm(), tunables=_TUNE_GROUPED_GEMM,
+                   tune_dims=_DIMS_GROUPED,
+                   variants=(_b_grouped_gemm(256),)),
         KernelSpec("swiglu", "kernels.swiglu", "compute", _b_swiglu),
         KernelSpec("gdn_fwd", "kernels.gdn", "compute", _b_gdn,
                    ablation_phases=("exps", "solve", "out", "state")),

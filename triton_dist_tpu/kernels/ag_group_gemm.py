@@ -222,8 +222,10 @@ def ag_group_gemm(x_e, w, *, mesh: Mesh, axis: str = "tp",
         collective_id = next_collective_id()
     isz = jnp.dtype(x_e.dtype).itemsize
     wsz = jnp.dtype(w.dtype).itemsize
-    from triton_dist_tpu.tools.tune import contextual_choice
-    prof = contextual_choice("ag_group_gemm") or {}
+    # explicit args > contextual profile / swept tune cache
+    # (tools/sweep) > the VMEM-fit heuristics below
+    from triton_dist_tpu.tools.sweep import resolve_config
+    prof = resolve_config("ag_group_gemm", (E, capT, N))
     if resident_b is None and "resident_b" in prof:
         resident_b = prof["resident_b"]
     if wb_depth is None and "wb_depth" in prof:

@@ -85,17 +85,16 @@ def create_ag_gemm_context(mesh: Mesh, axis: str = "tp", *,
     the block space on synthetic [tune_M, K] @ [K, n*N_local] inputs,
     cached by shape+chip with cross-process consensus — the reference's
     @autotune on ag_gemm, allgather_gemm.py:563) > an installed
-    contextual profile entry ("ag_gemm") > the VMEM-fit heuristic."""
+    contextual profile entry / swept tune cache ("ag_gemm",
+    tools/sweep) > the VMEM-fit heuristic."""
     n = mesh.shape[axis]
     if block_n is None and tune:
         assert K is not None and N_local is not None, \
             "tune=True needs K and N_local"
         block_n = _tune_block_n(mesh, axis, tune_M, K, N_local, dtype)
     if block_n is None:
-        from triton_dist_tpu.tools.tune import contextual_choice
-        prof = contextual_choice("ag_gemm")
-        if prof is not None:
-            block_n = prof.get("block_n")
+        from triton_dist_tpu.tools.sweep import resolve_config
+        block_n = resolve_config("ag_gemm").get("block_n")
     if block_n is None:
         if K is not None and N_local is not None:
             block_n = _pick_block_n(K, N_local, jnp.dtype(dtype).itemsize)

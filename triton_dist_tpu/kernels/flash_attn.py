@@ -250,11 +250,11 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
         # callers may pass anything — it is recomputed here
         kv_len = jnp.max(jnp.asarray(kv_lens, jnp.int32))
     if block_x is None or block_t is None:
-        # callers that do not pin the blocks take the installed
-        # contextual profile (tools/tune.contextual_autotune) or the
-        # static defaults
-        from triton_dist_tpu.tools.tune import contextual_choice
-        prof = contextual_choice("flash_decode") or {}
+        # callers that do not pin the blocks resolve explicit arg >
+        # contextual profile (tools/tune.contextual_autotune) > tune
+        # cache (tools/sweep) > the static defaults
+        from triton_dist_tpu.tools.sweep import resolve_config
+        prof = resolve_config("flash_decode", (B * Hkv, T))
         block_x = block_x if block_x is not None else prof.get("block_x",
                                                                64)
         block_t = block_t if block_t is not None else prof.get("block_t",
@@ -285,7 +285,8 @@ def flash_decode(q, k, v, kv_len, *, scale: Optional[float] = None,
 
 def flash_decode_partial(q, k, v, kv_len, q_offset, *,
                          scale: Optional[float] = None,
-                         block_x: int = 64, block_t: int = 256):
+                         block_x: Optional[int] = None,
+                         block_t: Optional[int] = None):
     """Per-chip split-KV partial: unnormalized accumulator + LSE stats
     for the inter-chip combine (reference: the split-KV kernel's partial
     outputs, flash_decode.py:130, combined at :308/:482).
@@ -302,6 +303,15 @@ def flash_decode_partial(q, k, v, kv_len, q_offset, *,
     rep = Hq // Hkv
     if scale is None:
         scale = d ** -0.5
+    if block_x is None or block_t is None:
+        # same resolution order as flash_decode — the sp partial rides
+        # the same "flash_decode" tuning entry (same kernel body)
+        from triton_dist_tpu.tools.sweep import resolve_config
+        prof = resolve_config("flash_decode", (B * Hkv, T))
+        block_x = block_x if block_x is not None else prof.get("block_x",
+                                                               64)
+        block_t = block_t if block_t is not None else prof.get("block_t",
+                                                               256)
     X = B * Hkv
     rows = S * rep
     qx = (q.reshape(B, S, Hkv, rep, d)

@@ -67,9 +67,8 @@ def create_gemm_ar_context(mesh: Mesh, axis: str = "tp", *,
             "gemm_ar", mesh, axis, M, K, N, dtype,
             P(None, axis), P(axis, None), make_op)
     if block_n is None:
-        from triton_dist_tpu.tools.tune import contextual_choice
-        prof = contextual_choice("gemm_ar")
-        block_n = (prof or {}).get("block_n", 512)
+        from triton_dist_tpu.tools.sweep import resolve_config
+        block_n = resolve_config("gemm_ar").get("block_n", 512)
     return GemmARContext(
         mesh=mesh, axis=axis, n=n, block_n=block_n,
         collective_id=(collective_id if collective_id is not None

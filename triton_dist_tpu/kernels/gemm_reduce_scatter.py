@@ -63,7 +63,7 @@ def create_gemm_rs_context(mesh: Mesh, axis: str = "tp", *,
                            ) -> GEMMReduceScatterTensorParallelContext:
     """block_n: explicit > tune=True (AutoTuner over the block space on
     synthetic shapes, JSON-cached; the reference's @autotune on gemm_rs)
-    > contextual profile ("gemm_rs") > 512."""
+    > contextual profile / tune cache ("gemm_rs", tools/sweep) > 512."""
     n = mesh.shape[axis]
     if block_n is None and tune:
         assert None not in (M, K, N), "tune=True needs M, K, N"
@@ -79,9 +79,8 @@ def create_gemm_rs_context(mesh: Mesh, axis: str = "tp", *,
             "gemm_rs", mesh, axis, M, K, N, dtype,
             P(None, axis), P(axis, None), make_op)
     if block_n is None:
-        from triton_dist_tpu.tools.tune import contextual_choice
-        prof = contextual_choice("gemm_rs")
-        block_n = (prof or {}).get("block_n", 512)
+        from triton_dist_tpu.tools.sweep import resolve_config
+        block_n = resolve_config("gemm_rs").get("block_n", 512)
     return GEMMReduceScatterTensorParallelContext(
         mesh=mesh, axis=axis, n=n, block_n=block_n,
         collective_id=(collective_id if collective_id is not None

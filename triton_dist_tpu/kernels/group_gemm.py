@@ -38,12 +38,23 @@ def _gg_kernel(x_ref, w_ref, o_ref):
         preferred_element_type=jnp.float32).astype(o_ref.dtype)[None]
 
 
-def grouped_gemm(x, w, *, block_c: int = 256, block_f: int = 512):
+def grouped_gemm(x, w, *, block_c=None, block_f=None):
     """Pallas grouped GEMM. x: [E, C, D]; w: [E, D, F] -> [E, C, F].
     Grid (E, C/bc, F/bf); weights stream through VMEM once per (expert,
-    F-tile) and are reused across C-tiles by the pallas pipeline."""
+    F-tile) and are reused across C-tiles by the pallas pipeline.
+    Tiling resolves explicit arg > tuned config (tools/sweep,
+    the reference's `_get_tiling_size_for_gmm_kernel` role) > 256/512;
+    C and F are non-contraction dims, so any tile choice is bitwise-
+    identical."""
     E, C, D = x.shape
     F = w.shape[2]
+    if block_c is None or block_f is None:
+        from triton_dist_tpu.tools.sweep import resolve_config
+        cfg = resolve_config("grouped_gemm", (C, F))
+        block_c = block_c if block_c is not None else cfg.get("block_c",
+                                                              256)
+        block_f = block_f if block_f is not None else cfg.get("block_f",
+                                                              512)
 
     def _pick(total, want, align):
         """Largest divisor <= want that satisfies Mosaic's tiling

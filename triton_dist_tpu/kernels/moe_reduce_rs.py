@@ -248,9 +248,11 @@ def moe_reduce_rs(h, w2, *, mesh: Mesh, axis: str = "tp",
     # and the reclaimed VMEM funds staging depth.
     fold_live = n > 1
     if wb_depth is None:
-        from triton_dist_tpu.tools.tune import contextual_choice
-        wb_depth = (contextual_choice("moe_reduce_rs") or {}).get(
-            "wb_depth")
+        # explicit arg > contextual profile / swept tune cache
+        # (tools/sweep) > pick_wb_depth VMEM heuristic
+        from triton_dist_tpu.tools.sweep import resolve_config
+        wb_depth = resolve_config(
+            "moe_reduce_rs", (E, capT, D)).get("wb_depth")
     if wb_depth is None:
         from triton_dist_tpu.utils import pick_wb_depth
         a_bytes = 2 * c_loc * f_l * isz

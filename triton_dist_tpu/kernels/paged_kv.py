@@ -198,7 +198,8 @@ def _paged_kernel(scale: float, rep: int, page: int, W: int,
 
 def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
                        scale: Optional[float] = None, kv_lens=None,
-                       q_lens=None, k_scale=None, v_scale=None):
+                       q_lens=None, k_scale=None, v_scale=None,
+                       block_w: Optional[int] = None):
     """Cached GQA decode attention through a page table.
 
     q: [B, S, Hq, d] (S == 1 unless q_lens is given); pages_k/v:
@@ -237,13 +238,14 @@ def flash_decode_paged(q, pages_k, pages_v, page_table, kv_len, *,
     return _flash_decode_paged_call(
         q, pages_k, pages_v, page_table, kv_len, scale=scale,
         kv_lens=kv_lens, q_lens=q_lens, k_scale=k_scale,
-        v_scale=v_scale, tile_owned=None)
+        v_scale=v_scale, tile_owned=None, block_w=block_w)
 
 
 def flash_decode_paged_partial(q, pages_k, pages_v, page_table, *,
                                kv_lens, tile_owned,
                                scale: Optional[float] = None,
-                               q_lens=None, k_scale=None, v_scale=None):
+                               q_lens=None, k_scale=None, v_scale=None,
+                               block_w: Optional[int] = None):
     """Split-KV PARTIAL of the paged walk — the sequence-parallel
     serving kernel (ROADMAP long-context item; the per-rank split-KV
     partial of the reference's inter-rank combine, flash_decode.py:130
@@ -269,12 +271,14 @@ def flash_decode_paged_partial(q, pages_k, pages_v, page_table, *,
     return _flash_decode_paged_call(
         q, pages_k, pages_v, page_table, None, scale=scale,
         kv_lens=kv_lens, q_lens=q_lens, k_scale=k_scale,
-        v_scale=v_scale, tile_owned=tile_owned)
+        v_scale=v_scale, tile_owned=tile_owned, block_w=block_w,
+        tune_name="flash_decode_paged_partial")
 
 
 def _flash_decode_paged_call(q, pages_k, pages_v, page_table, kv_len, *,
                              scale, kv_lens, q_lens, k_scale, v_scale,
-                             tile_owned):
+                             tile_owned, block_w=None,
+                             tune_name="flash_decode_paged"):
     B, S, Hq, d = q.shape
     partial = tile_owned is not None
     if q_lens is not None:
@@ -300,9 +304,23 @@ def _flash_decode_paged_call(q, pages_k, pages_v, page_table, kv_len, *,
     qx = (q.reshape(B, S, Hkv, rep, d)
            .transpose(0, 2, 1, 3, 4)
            .reshape(X, rows, d))
-    # W streams per grid step (see module docstring): the largest
-    # divisor of X in (8, 4, 2, 1)
-    W = next(w for w in (8, 4, 2, 1) if X % w == 0)
+    # W streams per grid step (see module docstring). Resolution:
+    # explicit block_w > contextual/tuned config (tools/sweep) > the
+    # largest divisor of X in (8, 4, 2, 1). W only regroups streams
+    # across grid steps — per-stream accumulators are untouched, so any
+    # legal W is bitwise-identical.
+    if block_w is None:
+        from triton_dist_tpu.tools.sweep import resolve_config
+        block_w = resolve_config(
+            tune_name, (B * Hq, NP * page)).get("block_w")
+    if block_w is not None:
+        if X % block_w:
+            raise ValueError(
+                f"{tune_name}: block_w={block_w} does not divide the "
+                f"stream count X={X} (B*Hkv)")
+        W = int(block_w)
+    else:
+        W = next(w for w in (8, 4, 2, 1) if X % w == 0)
     per_stream = kv_lens is not None
     if per_stream:
         lens_x = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), Hkv)  # [X]

@@ -1974,9 +1974,16 @@ def _pick_mega_bn(cfg, n: int = 1) -> int:
     """Largest 128-multiple weight tile dividing the LOCAL projection
     widths the megakernel asserts on (D, ffn/n, Hq*hd/n); the qkv
     matmul down-tiles its own width independently (decode_layer.py
-    _pick_bn)."""
+    _pick_bn). A swept "mega_decode" tune-cache entry (tools/sweep)
+    overrides the ladder when it divides the widths — block_n tiles
+    output columns only, so the tick stays bitwise-identical."""
     widths = (cfg.hidden_size, cfg.intermediate_size // n,
               cfg.num_heads * cfg.head_dim // n)
+    from triton_dist_tpu.tools.sweep import resolve_config
+    tuned = resolve_config("mega_decode", widths).get("block_n")
+    if tuned and tuned % 128 == 0 and all(w % tuned == 0
+                                          for w in widths):
+        return int(tuned)
     for bn in (512, 384, 256, 128):
         if all(w % bn == 0 for w in widths):
             return bn

@@ -6,11 +6,20 @@ from triton_dist_tpu.tools.tune import (  # noqa: F401
     AutoTuner,
     autotune,
     clear_cache,
+    contextual_override,
     default_cache_path,
+    shape_bucket,
 )
 from triton_dist_tpu.tools.perf_model import (  # noqa: F401
     chip_specs,
     collective_sol_us,
     gemm_sol_us,
     sol_report,
+)
+from triton_dist_tpu.tools.sweep import (  # noqa: F401
+    default_store_path,
+    prune_space,
+    resolve_config,
+    sweep_kernel,
+    tuned_choice,
 )

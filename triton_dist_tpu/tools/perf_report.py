@@ -41,9 +41,15 @@ def _repeat(step, x0, k):
 
     def body(i, v):
         out = step(v)
+        if shd is None:
+            return out
         # restore the carry's sharding (free when unchanged; a local
-        # slice when the op replicated its output)
-        return jax.reshard(out, shd) if shd is not None else out
+        # slice when the op replicated its output); jax.reshard is the
+        # explicit-sharding spelling, absent on older jax — a sharding
+        # constraint says the same thing there
+        if hasattr(jax, "reshard"):
+            return jax.reshard(out, shd)
+        return jax.lax.with_sharding_constraint(out, shd)
 
     @jax.jit
     def prog(x):
@@ -147,12 +153,53 @@ def registry_coverage(measured_ops):
             "uncovered": sorted(uncovered)}
 
 
-def run_report(write_json=None):
+# the roofline CI gate's op subset (bench.py TDTPU_BENCH_SOLFRAC
+# default): the tuned hot-path kernels, cheap enough on the CPU
+# interpreter to ride inside the bench budget. "all" runs every row.
+GATE_OPS = ("ag_gemm", "gemm_rs", "gemm_allreduce", "flash_decode",
+            "flash_decode_paged", "ag_group_gemm", "moe_reduce_rs")
+
+
+def sol_frac_rows(report):
+    """Flatten a run_report() dict into bench-capture rows — one
+    `{op}_sol_frac` row per measured op, unit "frac of SOL" (which
+    tools/bench_compare.py treats as higher-is-better). Elided /
+    degenerate rows (sol_frac None) are dropped: a clamped slope is
+    not a roofline fraction."""
+    env = report.get("env", {})
+    rows = []
+    for r in report.get("ops", []):
+        frac = r.get("sol_frac")
+        if frac is None:
+            continue
+        rows.append({
+            "metric": f"{r['op']}_sol_frac",
+            "value": round(float(frac), 5),
+            "unit": "frac of SOL",
+            "achieved_us": round(float(r["achieved_us"]), 3),
+            "sol_us": round(float(r["sol_us"]), 3),
+            "backend": env.get("backend", "unknown"),
+            "ndev": env.get("ndev"),
+            "interpreted": env.get("interpreted"),
+        })
+    return rows
+
+
+def run_report(write_json=None, only=None):
     from triton_dist_tpu.kernels import (
         AllGatherMethod, AllReduceMethod, ag_gemm, all_gather, all_reduce,
         create_ag_gemm_context, create_gemm_ar_context,
         create_gemm_rs_context, flash_decode, gemm_allreduce, gemm_rs,
         reduce_scatter)
+
+    # `only` restricts the report to a subset of row names (GATE_OPS
+    # for the bench gate); unfiltered runs are unchanged. Sections
+    # whose every row is filtered out skip their setup entirely, so a
+    # gate run does not pay for PP/EP/ring machinery it will not time.
+    wanted = None if only is None else frozenset(only)
+
+    def want(name):
+        return wanted is None or name in wanted
 
     ndev = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
@@ -180,7 +227,21 @@ def run_report(write_json=None):
     rows = []
 
     def add(name, step, x0, sol_us, note=""):
-        t = _time(step, x0)
+        if not want(name):
+            return
+        try:
+            t = _time(step, x0)
+        except Exception as e:  # noqa: BLE001
+            # an op that cannot execute on this substrate (e.g. the comm
+            # ring kernels on a jax without the Pallas TPU interpreter)
+            # gets a degenerate row, not a dead report — the roofline
+            # gate still sees every other row, and the note names the
+            # failure so an on-chip crash cannot pass silently
+            rows.append({"op": name, "achieved_us": None,
+                         "sol_us": sol_us, "sol_frac": None,
+                         "note": f"FAILED: {type(e).__name__}: {e}"[:300]})
+            print(f"{name:24s}  FAILED ({type(e).__name__})")
+            return
         if t < _ELIDED_US:
             # a floor-clamped slope is NOT a latency; report it as a
             # degenerate row rather than a physically impossible number
@@ -225,26 +286,28 @@ def run_report(write_json=None):
     add("reduce_scatter",
         chain(lambda v: reduce_scatter(v, mesh=mesh)),
         xp, collective_sol_us("rs", n * M * N * isz, n, spec=spec))
-    a_rows = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
-    b_cols = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
-    ag_ctx = create_ag_gemm_context(mesh)
-    rs_ctx = create_gemm_rs_context(mesh)
-    ar_ctx = create_gemm_ar_context(mesh)
-
     # GEMM SOL terms use PER-CHIP dims: ag_gemm computes [M, K]@[K, N/n]
     # per chip, gemm_rs/gemm_ar compute [M, K/n]@[K/n, N]
-    add("ag_gemm",
-        chain(lambda v: ag_gemm(v, b_cols, ag_ctx)), a_rows,
-        gemm_sol_us(M, K, N // n, itemsize=isz, spec=spec)
-        + collective_sol_us("ag", M * K * isz, n, spec=spec))
-    add("gemm_rs",
-        chain(lambda v: gemm_rs(v, b_rows, rs_ctx)), a_cols,
-        gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
-        + collective_sol_us("rs", M * N * isz, n, spec=spec))
-    add("gemm_allreduce",
-        chain(lambda v: gemm_allreduce(v, b_rows, ar_ctx)), a_cols,
-        gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
-        + collective_sol_us("ar", M * N * isz, n, spec=spec))
+    if want("ag_gemm"):
+        a_rows = jax.device_put(a, NamedSharding(mesh, P("tp", None)))
+        b_cols = jax.device_put(b, NamedSharding(mesh, P(None, "tp")))
+        ag_ctx = create_ag_gemm_context(mesh)
+        add("ag_gemm",
+            chain(lambda v: ag_gemm(v, b_cols, ag_ctx)), a_rows,
+            gemm_sol_us(M, K, N // n, itemsize=isz, spec=spec)
+            + collective_sol_us("ag", M * K * isz, n, spec=spec))
+    if want("gemm_rs"):
+        rs_ctx = create_gemm_rs_context(mesh)
+        add("gemm_rs",
+            chain(lambda v: gemm_rs(v, b_rows, rs_ctx)), a_cols,
+            gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
+            + collective_sol_us("rs", M * N * isz, n, spec=spec))
+    if want("gemm_allreduce"):
+        ar_ctx = create_gemm_ar_context(mesh)
+        add("gemm_allreduce",
+            chain(lambda v: gemm_allreduce(v, b_rows, ar_ctx)), a_cols,
+            gemm_sol_us(M, K // n, N, itemsize=isz, spec=spec)
+            + collective_sol_us("ar", M * N * isz, n, spec=spec))
 
     # flash decode: B=8 heads=16/8 T=2048
     B, S, Hq, Hkv, T, d = (8, 1, 16, 8, 2048, 128) if on_tpu else \
@@ -272,149 +335,166 @@ def run_report(write_json=None):
         note="same bytes as flash_decode; gap = page-walk overhead")
 
     # MoE ring kernels (resident-B path at these sizes)
-    from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
-    from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
-    E, capT, Dm, Nm = (8, 512, 1024, 1024) if on_tpu else (2, 8 * n, 64,
-                                                           64 * n)
-    xe = jax.device_put(jnp.asarray(rng.randn(E, capT, Dm), dt) * 0.1,
-                        NamedSharding(mesh, P(None, "tp", None)))
-    we = jax.device_put(jnp.asarray(rng.randn(E, Dm, Nm), dt) * 0.1,
-                        NamedSharding(mesh, P(None, None, "tp")))
-    add("ag_group_gemm",
-        chain(lambda v: ag_group_gemm(v, we, mesh=mesh)), xe,
-        gemm_sol_us(E * capT, Dm, Nm // n, itemsize=isz, spec=spec)
-        + collective_sol_us("ag", E * capT * Dm * isz, n, spec=spec))
-    he = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
-                        NamedSharding(mesh, P(None, None, "tp")))
-    w2 = jax.device_put(jnp.asarray(rng.randn(E, Nm, Dm), dt) * 0.1,
-                        NamedSharding(mesh, P(None, "tp", None)))
-    add("moe_reduce_rs",
-        chain(lambda v: moe_reduce_rs(v, w2, mesh=mesh)), he,
-        gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
-        + collective_sol_us("rs", E * capT * Dm * isz, n, spec=spec))
+    if want("ag_group_gemm") or want("moe_reduce_rs") \
+            or want("moe_reduce_ar"):
+        from triton_dist_tpu.kernels.ag_group_gemm import ag_group_gemm
+        from triton_dist_tpu.kernels.moe_reduce_rs import moe_reduce_rs
+        E, capT, Dm, Nm = (8, 512, 1024, 1024) if on_tpu else \
+                          (2, 8 * n, 64, 64 * n)
+        xe = jax.device_put(jnp.asarray(rng.randn(E, capT, Dm), dt) * 0.1,
+                            NamedSharding(mesh, P(None, "tp", None)))
+        we = jax.device_put(jnp.asarray(rng.randn(E, Dm, Nm), dt) * 0.1,
+                            NamedSharding(mesh, P(None, None, "tp")))
+        add("ag_group_gemm",
+            chain(lambda v: ag_group_gemm(v, we, mesh=mesh)), xe,
+            gemm_sol_us(E * capT, Dm, Nm // n, itemsize=isz, spec=spec)
+            + collective_sol_us("ag", E * capT * Dm * isz, n, spec=spec))
+        he = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
+                            NamedSharding(mesh, P(None, None, "tp")))
+        w2 = jax.device_put(jnp.asarray(rng.randn(E, Nm, Dm), dt) * 0.1,
+                            NamedSharding(mesh, P(None, "tp", None)))
+        add("moe_reduce_rs",
+            chain(lambda v: moe_reduce_rs(v, w2, mesh=mesh)), he,
+            gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
+            + collective_sol_us("rs", E * capT * Dm * isz, n, spec=spec))
 
-    he2 = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
-                         NamedSharding(mesh, P(None, None, "tp")))
-    from triton_dist_tpu.kernels.moe_reduce_ar import moe_reduce_ar
-    add("moe_reduce_ar",
-        chain(lambda v: moe_reduce_ar(v, w2, mesh=mesh)), he2,
-        gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
-        + collective_sol_us("ar", E * capT * Dm * isz, n, spec=spec))
+        he2 = jax.device_put(jnp.asarray(rng.randn(E, capT, Nm), dt) * 0.1,
+                             NamedSharding(mesh, P(None, None, "tp")))
+        from triton_dist_tpu.kernels.moe_reduce_ar import moe_reduce_ar
+        add("moe_reduce_ar",
+            chain(lambda v: moe_reduce_ar(v, w2, mesh=mesh)), he2,
+            gemm_sol_us(E * capT, Nm // n, Dm, itemsize=isz, spec=spec)
+            + collective_sol_us("ar", E * capT * Dm * isz, n, spec=spec))
 
     # fused one-kernel EP MoE at the ep_fused docstring shape; SOL =
     # the grouped-GEMM flops over the CAPACITY rows the kernel actually
     # multiplies + the a2a payload both ways
-    from triton_dist_tpu.layers.ep_moe import EP_MoE
-    Ee, De, Ie = (8, 1024, 512) if on_tpu else (2 * n, 64, 32)
-    Te = 1024 if on_tpu else 8 * n
-    epr_rng = np.random.RandomState(7)
-    moe_f = EP_MoE.init(
-        jnp.asarray(epr_rng.randn(De, Ee), dt) * 0.5,
-        jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
-        jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
-        jnp.asarray(epr_rng.randn(Ee, Ie, De), dt) * (Ie ** -0.5),
-        mesh=mesh, axis="tp", top_k=2, capacity_factor=1.25)
-    xe_f = jax.device_put(jnp.asarray(epr_rng.randn(Te, De), dt) * 0.3,
-                          NamedSharding(mesh, P("tp", None)))
-    cap_rows = Ee * moe_f._cap_e(Te // n) * n
-    ep_sol = (gemm_sol_us(cap_rows, De, 2 * Ie, itemsize=isz, spec=spec)
-              + gemm_sol_us(cap_rows, Ie, De, itemsize=isz, spec=spec)
-              + 2 * collective_sol_us("a2a", cap_rows * De * isz, n,
-                                      spec=spec))
-    add("ep_fused",
-        chain(lambda v: moe_f(v, mode="ep_fused")), xe_f, ep_sol)
+    if want("ep_fused"):
+        from triton_dist_tpu.layers.ep_moe import EP_MoE
+        Ee, De, Ie = (8, 1024, 512) if on_tpu else (2 * n, 64, 32)
+        Te = 1024 if on_tpu else 8 * n
+        epr_rng = np.random.RandomState(7)
+        moe_f = EP_MoE.init(
+            jnp.asarray(epr_rng.randn(De, Ee), dt) * 0.5,
+            jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
+            jnp.asarray(epr_rng.randn(Ee, De, Ie), dt) * (De ** -0.5),
+            jnp.asarray(epr_rng.randn(Ee, Ie, De), dt) * (Ie ** -0.5),
+            mesh=mesh, axis="tp", top_k=2, capacity_factor=1.25)
+        xe_f = jax.device_put(jnp.asarray(epr_rng.randn(Te, De), dt) * 0.3,
+                              NamedSharding(mesh, P("tp", None)))
+        cap_rows = Ee * moe_f._cap_e(Te // n) * n
+        ep_sol = (gemm_sol_us(cap_rows, De, 2 * Ie, itemsize=isz,
+                              spec=spec)
+                  + gemm_sol_us(cap_rows, Ie, De, itemsize=isz, spec=spec)
+                  + 2 * collective_sol_us("a2a", cap_rows * De * isz, n,
+                                          spec=spec))
+        add("ep_fused",
+            chain(lambda v: moe_f(v, mode="ep_fused")), xe_f, ep_sol)
 
     # Ulysses fused QKV/O kernels (both a2a directions ride their
     # adjacent GEMMs): SOL = GEMM + a2a payload
-    from triton_dist_tpu.kernels.sp_attention import (o_a2a_gemm,
-                                                      qkv_gemm_a2a)
-    Bu, Su, Du, Nu = (2, 2048, 1024, 1024) if on_tpu else (1, 8 * n, 64,
-                                                           64)
-    xu = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Du), dt) * 0.1,
-                        NamedSharding(mesh, P(None, "tp", None)))
-    wu_ = jnp.asarray(rng.randn(Du, Nu), dt) * 0.1
-    add("ulysses_qkv_gemm_a2a",
-        chain(lambda v: qkv_gemm_a2a(v, wu_, mesh=mesh, axis="tp")), xu,
-        gemm_sol_us(Bu * Su // n, Du, Nu, itemsize=isz, spec=spec)
-        + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n, spec=spec))
-    xo = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Nu), dt) * 0.1,
-                        NamedSharding(mesh, P(None, None, "tp")))
-    wo_ = jnp.asarray(rng.randn(Nu, Du), dt) * 0.1
-    add("ulysses_o_a2a_gemm",
-        chain(lambda v: o_a2a_gemm(v, wo_, mesh=mesh, axis="tp")), xo,
-        gemm_sol_us(Bu * Su // n, Nu, Du, itemsize=isz, spec=spec)
-        + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n, spec=spec))
+    if want("ulysses_qkv_gemm_a2a") or want("ulysses_o_a2a_gemm"):
+        from triton_dist_tpu.kernels.sp_attention import (o_a2a_gemm,
+                                                          qkv_gemm_a2a)
+        Bu, Su, Du, Nu = (2, 2048, 1024, 1024) if on_tpu else \
+                         (1, 8 * n, 64, 64)
+        xu = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Du), dt) * 0.1,
+                            NamedSharding(mesh, P(None, "tp", None)))
+        wu_ = jnp.asarray(rng.randn(Du, Nu), dt) * 0.1
+        add("ulysses_qkv_gemm_a2a",
+            chain(lambda v: qkv_gemm_a2a(v, wu_, mesh=mesh, axis="tp")),
+            xu,
+            gemm_sol_us(Bu * Su // n, Du, Nu, itemsize=isz, spec=spec)
+            + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n,
+                                spec=spec))
+        xo = jax.device_put(jnp.asarray(rng.randn(Bu, Su, Nu), dt) * 0.1,
+                            NamedSharding(mesh, P(None, None, "tp")))
+        wo_ = jnp.asarray(rng.randn(Nu, Du), dt) * 0.1
+        add("ulysses_o_a2a_gemm",
+            chain(lambda v: o_a2a_gemm(v, wo_, mesh=mesh, axis="tp")),
+            xo,
+            gemm_sol_us(Bu * Su // n, Nu, Du, itemsize=isz, spec=spec)
+            + collective_sol_us("a2a", Bu * Su // n * Nu * isz, n,
+                                spec=spec))
 
     # PP: GPipe forward at pp=ndev. SOL = (M + n - 1) ticks x the
     # per-stage GEMM bound (the schedule's ideal span; the gap above it
     # is handoff + bank overhead). At ndev=1 the ring degenerates but
     # the tick loop still runs — the row then measures pure schedule
     # overhead per tick.
-    from triton_dist_tpu.layers.pp import PPipeline
-    Mp, Bp, Dp = 4 * max(n, 2), (64 if on_tpu else 8), (1024 if on_tpu
-                                                        else 64)
-    wp = jnp.asarray(rng.randn(n, Dp, Dp), dt) * (Dp ** -0.5)
-    bp = jnp.asarray(rng.randn(n, Dp), dt) * 0.1
-    pp_mesh = jax.make_mesh((n,), ("pp",))
-    pipe = PPipeline.init(
-        {"w": wp, "b": bp},
-        lambda p, xx: jnp.tanh(xx @ p["w"] + p["b"]),
-        mesh=pp_mesh, axis="pp")
-    xpp = jnp.asarray(rng.randn(Mp, Bp, Dp), dt) * 0.3
-    add("pp_gpipe_fwd",
-        lambda v: v + 1e-30 * jnp.sum(pipe(v),
-                                      dtype=jnp.float32).astype(v.dtype),
-        xpp,
-        (Mp + n - 1) * gemm_sol_us(Bp, Dp, Dp, itemsize=isz, spec=spec),
-        note=f"M={Mp} microbatches, {Mp + n - 1} ticks; SOL = ideal "
-             "schedule span")
+    if want("pp_gpipe_fwd"):
+        from triton_dist_tpu.layers.pp import PPipeline
+        Mp, Bp, Dp = 4 * max(n, 2), (64 if on_tpu else 8), (1024 if on_tpu
+                                                            else 64)
+        wp = jnp.asarray(rng.randn(n, Dp, Dp), dt) * (Dp ** -0.5)
+        bp = jnp.asarray(rng.randn(n, Dp), dt) * 0.1
+        pp_mesh = jax.make_mesh((n,), ("pp",))
+        pipe = PPipeline.init(
+            {"w": wp, "b": bp},
+            lambda p, xx: jnp.tanh(xx @ p["w"] + p["b"]),
+            mesh=pp_mesh, axis="pp")
+        xpp = jnp.asarray(rng.randn(Mp, Bp, Dp), dt) * 0.3
+        add("pp_gpipe_fwd",
+            lambda v: v + 1e-30 * jnp.sum(
+                pipe(v), dtype=jnp.float32).astype(v.dtype),
+            xpp,
+            (Mp + n - 1) * gemm_sol_us(Bp, Dp, Dp, itemsize=isz,
+                                       spec=spec),
+            note=f"M={Mp} microbatches, {Mp + n - 1} ticks; SOL = ideal "
+                 "schedule span")
 
     # GDN chunkwise forward, Pallas kernel (gdn_fwd default; roofline:
     # qkv/g/beta/o traffic vs the chunk matmul FLOPs)
-    from triton_dist_tpu.kernels.gdn import gdn_fwd
-    Bg, Hg, Tg, dk_, dv_ = (8, 16, 2048, 128, 128) if on_tpu else \
-                           (2, 2, 256, 32, 32)
-    C = 64
-    qg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
-    kg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
-    vg = jnp.asarray(rng.randn(Bg, Hg, Tg, dv_), dt) * 0.3
-    gg = jnp.asarray(-np.abs(rng.rand(Bg, Hg, Tg)) * 0.1, jnp.float32)
-    bg = jnp.asarray(rng.rand(Bg, Hg, Tg), jnp.float32)
-    gdn_bytes = Bg * Hg * Tg * (2 * dk_ + 2 * dv_) * isz
-    gdn_flops = 2 * Bg * Hg * Tg * (2 * C * dk_ + 2 * C * dv_
-                                    + 2 * dk_ * dv_)
-    gdn_sol = max(gdn_bytes / (spec.hbm_gbps * 1e9),
-                  gdn_flops / (spec.bf16_tflops * 1e12)) * 1e6
-    add("gdn_fwd(pallas)",
-        lambda u: gdn_fwd(u, kg, vg, gg, bg, chunk=C)[0], qg, gdn_sol)
+    if want("gdn_fwd(pallas)"):
+        from triton_dist_tpu.kernels.gdn import gdn_fwd
+        Bg, Hg, Tg, dk_, dv_ = (8, 16, 2048, 128, 128) if on_tpu else \
+                               (2, 2, 256, 32, 32)
+        C = 64
+        qg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
+        kg = jnp.asarray(rng.randn(Bg, Hg, Tg, dk_), dt) * 0.3
+        vg = jnp.asarray(rng.randn(Bg, Hg, Tg, dv_), dt) * 0.3
+        gg = jnp.asarray(-np.abs(rng.rand(Bg, Hg, Tg)) * 0.1, jnp.float32)
+        bg = jnp.asarray(rng.rand(Bg, Hg, Tg), jnp.float32)
+        gdn_bytes = Bg * Hg * Tg * (2 * dk_ + 2 * dv_) * isz
+        gdn_flops = 2 * Bg * Hg * Tg * (2 * C * dk_ + 2 * C * dv_
+                                        + 2 * dk_ * dv_)
+        gdn_sol = max(gdn_bytes / (spec.hbm_gbps * 1e9),
+                      gdn_flops / (spec.bf16_tflops * 1e12)) * 1e6
+        add("gdn_fwd(pallas)",
+            lambda u: gdn_fwd(u, kg, vg, gg, bg, chunk=C)[0], qg, gdn_sol)
 
     # SP ring attention: fused one-kernel shmem ring vs the XLA-permute
     # ring (at ndev=1 the ring degenerates to the local block — the row
     # then times the fused kernel's tile engine, comm-free)
-    from triton_dist_tpu.kernels.sp_attention import sp_ring_attention
-    # rows kept small enough for BOTH modes' tilings (the XLA-permute
-    # partial path needs an 8-aligned batch block)
-    # d=128 in BOTH substrates: smaller d fails ring_shmem's alignment
-    # gate and would silently time the XLA ring under the shmem label
-    Bs, Hqs, Hkvs, Ss, ds = (2, 16, 16, 256, 128) if on_tpu else \
-                            (1, 2, 2, 8 * n, 128)
-    qr = jnp.asarray(rng.randn(Bs, Ss, Hqs, ds), dt) * 0.3
-    kr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
-    vr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
-    qr = jax.device_put(qr, NamedSharding(mesh, P(None, "tp", None, None)))
-    kr = jax.device_put(kr, NamedSharding(mesh, P(None, None, "tp", None)))
-    vr = jax.device_put(vr, NamedSharding(mesh, P(None, None, "tp", None)))
-    ring_flops = 2 * 2 * Bs * Hqs * Ss * Ss * ds / 2  # qk+pv, causal half
-    ring_sol = ring_flops / (spec.bf16_tflops * 1e12) * 1e6
-    for ring_mode in ("ring_shmem", "ring"):
-        add(f"sp_ring({ring_mode})",
-            (lambda mm: lambda u: u + 1e-30 * jnp.sum(
-                sp_ring_attention(u, kr, vr, mesh=mesh, axis="tp",
-                                  mode=mm), dtype=jnp.float32
-                ).astype(u.dtype))(ring_mode),
-            qr, ring_sol,
-            note="latency-bound at this size; SOL is the pure-FLOPs "
-                 "bound (compare the two modes, not the fraction)")
+    if want("sp_ring(ring_shmem)") or want("sp_ring(ring)"):
+        from triton_dist_tpu.kernels.sp_attention import sp_ring_attention
+        # rows kept small enough for BOTH modes' tilings (the XLA-permute
+        # partial path needs an 8-aligned batch block)
+        # d=128 in BOTH substrates: smaller d fails ring_shmem's
+        # alignment gate and would silently time the XLA ring under the
+        # shmem label
+        Bs, Hqs, Hkvs, Ss, ds = (2, 16, 16, 256, 128) if on_tpu else \
+                                (1, 2, 2, 8 * n, 128)
+        qr = jnp.asarray(rng.randn(Bs, Ss, Hqs, ds), dt) * 0.3
+        kr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
+        vr = jnp.asarray(rng.randn(Bs, Hkvs, Ss, ds), dt) * 0.3
+        qr = jax.device_put(qr,
+                            NamedSharding(mesh, P(None, "tp", None, None)))
+        kr = jax.device_put(kr,
+                            NamedSharding(mesh, P(None, None, "tp", None)))
+        vr = jax.device_put(vr,
+                            NamedSharding(mesh, P(None, None, "tp", None)))
+        ring_flops = 2 * 2 * Bs * Hqs * Ss * Ss * ds / 2  # qk+pv, causal
+        ring_sol = ring_flops / (spec.bf16_tflops * 1e12) * 1e6
+        for ring_mode in ("ring_shmem", "ring"):
+            add(f"sp_ring({ring_mode})",
+                (lambda mm: lambda u: u + 1e-30 * jnp.sum(
+                    sp_ring_attention(u, kr, vr, mesh=mesh, axis="tp",
+                                      mode=mm), dtype=jnp.float32
+                    ).astype(u.dtype))(ring_mode),
+                qr, ring_sol,
+                note="latency-bound at this size; SOL is the pure-FLOPs "
+                     "bound (compare the two modes, not the fraction)")
 
     # provenance stamp: a perf artifact must say WHICH code it measured
     # (r4 verdict: stale rows were indistinguishable from current ones)
@@ -434,8 +514,12 @@ def run_report(write_json=None):
               "git": git + ("+dirty" if dirty else ""),
               "date": datetime.datetime.now(
                   datetime.timezone.utc).isoformat(timespec="seconds")}
+    # a filtered run would report every unfiltered kernel "uncovered";
+    # record what it was filtered to instead
     out = {"env": header, "ops": rows,
-           "registry": registry_coverage([r["op"] for r in rows])}
+           "registry": (registry_coverage([r["op"] for r in rows])
+                        if wanted is None
+                        else {"filtered_to": sorted(wanted)})}
     if write_json:
         with open(write_json, "w") as f:
             json.dump(out, f, indent=1)
@@ -446,8 +530,12 @@ def run_report(write_json=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated row names (e.g. the CI gate's "
+                         "subset: " + ",".join(GATE_OPS) + ")")
     args = ap.parse_args()
-    run_report(args.json)
+    only = args.only.split(",") if args.only else None
+    run_report(args.json, only=only)
 
 
 if __name__ == "__main__":

@@ -21,12 +21,14 @@ deadlock). Differences that make it TPU-shaped:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import json
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, \
+    Sequence
 
 import jax
 
@@ -49,12 +51,25 @@ def _load_cache(path: str) -> Dict[str, Any]:
 
 
 def _store_cache(path: str, cache: Dict[str, Any]) -> None:
+    """Merge `cache` into the on-disk store under an exclusive file
+    lock: concurrent tuner/sweep processes UNION their keys instead of
+    last-writer-wins (two sweeps tuning disjoint kernels both land,
+    ISSUE 16 cache hardening). The write stays tmp+rename so a reader
+    never sees a torn file even where flock is a no-op."""
     if os.path.dirname(path):
         os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(cache, f, indent=1, sort_keys=True)
-    os.replace(tmp, path)          # atomic: concurrent tuners can't tear
+    with open(f"{path}.lock", "w") as lf:
+        try:
+            import fcntl
+            fcntl.flock(lf, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass               # no POSIX locks: atomic rename only
+        merged = _load_cache(path)
+        merged.update(cache)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
 
 
 def clear_cache(path: Optional[str] = None) -> None:
@@ -70,9 +85,24 @@ def _device_tag() -> str:
     return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
 
 
-def _arg_sig(args, kwargs) -> str:
+def shape_bucket(dims: Iterable[int]) -> str:
+    """Power-of-two shape bucket tag, e.g. (5, 256) -> "8x256": one
+    sweep at a bucket's shapes covers the whole serving batch-size
+    range that rounds to it (ISSUE 16 — the tune store and the bucketed
+    cache key both use this)."""
+    def up(n: int) -> int:
+        n = int(n)
+        return n if n <= 1 else 1 << (n - 1).bit_length()
+    return "x".join(str(up(d)) for d in dims)
+
+
+def _arg_sig(args, kwargs, bucket: bool = False) -> str:
     def one(a):
         if hasattr(a, "shape") and hasattr(a, "dtype"):
+            if bucket:
+                # bucketed signature (marked ~ so it can never collide
+                # with an exact-shape key): one entry per shape bucket
+                return f"~{shape_bucket(a.shape)}~{a.dtype}"
             return f"{tuple(a.shape)}{a.dtype}"
         return repr(a)
     parts = [one(a) for a in args]
@@ -115,6 +145,11 @@ class AutoTuner:
     cache_path: Optional[str] = None
     iters: int = 3
     warmup: int = 1
+    # bucket_shapes: key the cache by power-of-two shape BUCKET instead
+    # of exact shape, so one tuning run covers a serving batch-size
+    # range (the sweep harness turns this on; default stays exact so
+    # shape-sensitive callers keep per-shape winners)
+    bucket_shapes: bool = False
 
     def __post_init__(self):
         self.name = self.name or getattr(self.fn, "__name__", "fn")
@@ -124,7 +159,7 @@ class AutoTuner:
     def _key(self, args, kwargs) -> str:
         return "|".join([
             _device_tag(), jax.__version__, self.name,
-            _arg_sig(args, kwargs),
+            _arg_sig(args, kwargs, bucket=self.bucket_shapes),
             json.dumps(list(self.configs), sort_keys=True),
         ])
 
@@ -186,9 +221,7 @@ class AutoTuner:
         new_entry = {"cfg": dict(self.configs[best]),
                      "time_s": times[best]}
         self._mem[key] = new_entry
-        disk = _load_cache(self.cache_path)   # re-read: merge writers
-        disk[key] = new_entry
-        _store_cache(self.cache_path, disk)
+        _store_cache(self.cache_path, {key: new_entry})
         return new_entry["cfg"]
 
     def __call__(self, *args, **kwargs):
@@ -242,6 +275,26 @@ def set_contextual(profile: Dict[str, Dict[str, Any]]) -> None:
     """Install a tuning profile directly (tests / precomputed)."""
     _CONTEXTUAL.clear()
     _CONTEXTUAL.update(profile)
+
+
+_MISSING = object()
+
+
+@contextlib.contextmanager
+def contextual_override(name: str, cfg: Dict[str, Any]):
+    """Temporarily install ONE profile entry — the sweep harness's
+    config-injection point: kernels re-read the profile at trace time,
+    so rebuilding a kernel under this override applies `cfg` without
+    threading it through every call signature."""
+    prior = _CONTEXTUAL.get(name, _MISSING)
+    _CONTEXTUAL[name] = dict(cfg)
+    try:
+        yield
+    finally:
+        if prior is _MISSING:
+            _CONTEXTUAL.pop(name, None)
+        else:
+            _CONTEXTUAL[name] = prior
 
 
 def _sync_profile_hit(hit, vary):
@@ -326,9 +379,7 @@ def contextual_autotune(fn: Callable, args: Sequence[Any],
                 f"{kname} failed")
         chosen[kname] = dict(cfgs[best])
         _CONTEXTUAL[kname] = chosen[kname]
-    disk = _load_cache(cache_path)
-    disk[key] = {"cfg": chosen}
-    _store_cache(cache_path, disk)
+    _store_cache(cache_path, {key: {"cfg": chosen}})
     return chosen
 
 
