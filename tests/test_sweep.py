@@ -329,7 +329,7 @@ def test_flash_decode_paged_bitwise_identical_under_cache(monkeypatch,
     base = _bits(flash_decode_paged(q, pages, pages, table, None,
                                     kv_lens=kv_lens))
     sweep.store_update(path, tune._device_tag(), "flash_decode_paged",
-                       tune.shape_bucket((B * Hq, NP * page)),
+                       tune.shape_bucket((B * Hkv, B * Hq, NP * page)),
                        {"cfg": {"block_w": 2}})
     assert _bits(flash_decode_paged(q, pages, pages, table, None,
                                     kv_lens=kv_lens)) == base
@@ -338,6 +338,33 @@ def test_flash_decode_paged_bitwise_identical_under_cache(monkeypatch,
     with pytest.raises(ValueError, match="block_w=3"):
         flash_decode_paged(q, pages, pages, table, None,
                            kv_lens=kv_lens, block_w=3)
+
+
+def test_paged_tuned_block_w_reclamps_at_foreign_shape(monkeypatch,
+                                                       tmp_path):
+    """A tune-cache block_w that does not divide this call's X = B*Hkv
+    (single-bucket fallback from a sweep at another GQA ratio) must
+    re-clamp to the divisor ladder, not raise at serving time — only an
+    EXPLICIT indivisible block_w is an error. Exercised at B=1, Hkv=2
+    (X=2) against a cached winner of 8."""
+    from triton_dist_tpu.kernels.paged_kv import flash_decode_paged
+    rng = np.random.RandomState(6)
+    B, Hq, Hkv, d, page, maxp = 1, 4, 2, 128, 128, 2
+    NP = B * Hkv * maxp
+    q = jnp.asarray(rng.randn(B, 1, Hq, d), jnp.float32)
+    pages = jnp.asarray(rng.randn(NP, page, d), jnp.float32)
+    table = jnp.arange(NP, dtype=jnp.int32).reshape(B * Hkv, maxp)
+    kv_lens = jnp.asarray([page * maxp], jnp.int32)
+    path = _store(monkeypatch, tmp_path)
+    base = _bits(flash_decode_paged(q, pages, pages, table, None,
+                                    kv_lens=kv_lens))
+    # sole bucket in the store, swept at a shape where block_w=8 was
+    # legal: tuned_choice's cross-bucket fallback serves it here too
+    sweep.store_update(path, tune._device_tag(), "flash_decode_paged",
+                       tune.shape_bucket((16, 32, 16384)),
+                       {"cfg": {"block_w": 8}})
+    assert _bits(flash_decode_paged(q, pages, pages, table, None,
+                                    kv_lens=kv_lens)) == base
 
 
 # ---------------------------------------------------------------------------

@@ -399,14 +399,17 @@ def _b_flash_decode_paged(partial):
         pages = _f32(rng, NP, page, d)
         table = jnp.arange(NP, dtype=jnp.int32).reshape(B * Hkv, maxp)
         kv_lens = jnp.asarray([page * maxp, page], jnp.int32)
+        # the table rides as a positional arg so tune_dims can read
+        # X = B*Hkv off it (the dim block_w legality divides)
         if partial:
             owned = jnp.asarray(
                 np.ones((B * Hkv, maxp), np.int32))
-            return (lambda q_, pk, pv: flash_decode_paged_partial(
-                q_, pk, pv, table, kv_lens=kv_lens, tile_owned=owned),
-                (q, pages, pages))
-        return (lambda q_, pk, pv: flash_decode_paged(
-            q_, pk, pv, table, None, kv_lens=kv_lens), (q, pages, pages))
+            return (lambda q_, pk, pv, t_: flash_decode_paged_partial(
+                q_, pk, pv, t_, kv_lens=kv_lens, tile_owned=owned),
+                (q, pages, pages, table))
+        return (lambda q_, pk, pv, t_: flash_decode_paged(
+            q_, pk, pv, t_, None, kv_lens=kv_lens),
+            (q, pages, pages, table))
     return build
 
 
@@ -482,14 +485,17 @@ _TUNE_MOE_RS = _grid("wb_depth", 2, 3, 4)
 _TUNE_EP_FUSED = _grid("resident_w", True, False)
 
 # bucketing dims, shared convention with the consuming kernel (see
-# KernelSpec docstring): flash_decode (X=B*Hkv, T); paged (B*Hq,
-# pool positions); grouped_gemm (C, F); ag_group_gemm (E, capT, N);
-# moe_reduce_rs (E, capT, D). Context-scoped kernels (ag_gemm/gemm_rs/
-# gemm_ar/ep_fused) have no shapes at resolution time: tune_dims=None.
+# KernelSpec docstring): flash_decode (X=B*Hkv, T); paged (X=B*Hkv,
+# B*Hq, pool positions) — X leads because block_w legality divides X,
+# so the bucket key must separate GQA ratios; grouped_gemm (C, F);
+# ag_group_gemm (E, capT, N); moe_reduce_rs (E, capT, D).
+# Context-scoped kernels (ag_gemm/gemm_rs/gemm_ar/ep_fused) have no
+# shapes at resolution time: tune_dims=None.
 _DIMS_FLASH_DECODE = lambda q, k, v: (q.shape[0] * k.shape[1],  # noqa: E731
                                       k.shape[2])
-_DIMS_PAGED = lambda q, pk, pv: (q.shape[0] * q.shape[2],       # noqa: E731
-                                 pk.shape[0] * pk.shape[1])
+_DIMS_PAGED = lambda q, pk, pv, t: (t.shape[0],                 # noqa: E731
+                                    q.shape[0] * q.shape[2],
+                                    pk.shape[0] * pk.shape[1])
 _DIMS_GROUPED = lambda x, w: (x.shape[1], w.shape[2])           # noqa: E731
 _DIMS_EXPERT = lambda a, b: (a.shape[0], a.shape[1],            # noqa: E731
                              b.shape[2])

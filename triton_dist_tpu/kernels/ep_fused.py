@@ -427,10 +427,12 @@ def ep_moe_fused_device(x_loc, wgu_loc, wd_loc, *, n: int, axis: str,
     # count against every budget below or a real chip OOMs where the
     # interpreter passes
     s_bytes = E_loc * (2 * I + D) * 4 if quant else 0
-    if resident_w is None and block_i is None:
+    if resident_w is None:
         # weight residency is a pure staging choice (same dots either
         # way): explicit arg > tuned config (tools/sweep) > VMEM-fit
-        # heuristic
+        # heuristic. A pinned block_i still forces resident_w=False
+        # below — residency is incompatible with I-tile streaming — so
+        # the tuned choice only decides the untiled path.
         from triton_dist_tpu.tools.sweep import resolve_config
         resident_w = resolve_config("ep_fused").get("resident_w")
     if resident_w is None:

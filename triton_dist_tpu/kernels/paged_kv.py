@@ -305,19 +305,37 @@ def _flash_decode_paged_call(q, pages_k, pages_v, page_table, kv_len, *,
            .transpose(0, 2, 1, 3, 4)
            .reshape(X, rows, d))
     # W streams per grid step (see module docstring). Resolution:
-    # explicit block_w > contextual/tuned config (tools/sweep) > the
-    # largest divisor of X in (8, 4, 2, 1). W only regroups streams
-    # across grid steps — per-stream accumulators are untouched, so any
-    # legal W is bitwise-identical.
+    # explicit block_w > contextual profile > tune cache (tools/sweep)
+    # > the largest divisor of X in (8, 4, 2, 1). W only regroups
+    # streams across grid steps — per-stream accumulators are
+    # untouched, so any legal W is bitwise-identical. Strictness splits
+    # by provenance: an indivisible block_w that was pinned explicitly
+    # or installed in the contextual profile is a loud error (the sweep
+    # pruner probes configs through the profile and relies on this
+    # trace failing), while a DISK-cache winner is a hint from whatever
+    # shape it was swept at (bucket fallback, another GQA ratio) and
+    # re-clamps to the divisor ladder instead of failing at serving
+    # time — the tuned_choice contract: perf may degrade, never
+    # correctness. The two-step lookup below mirrors
+    # sweep.resolve_config's precedence, split so provenance is known.
+    strict_w = block_w is not None
     if block_w is None:
-        from triton_dist_tpu.tools.sweep import resolve_config
-        block_w = resolve_config(
-            tune_name, (B * Hq, NP * page)).get("block_w")
-    if block_w is not None:
-        if X % block_w:
+        from triton_dist_tpu.tools.tune import contextual_choice
+        prof = contextual_choice(tune_name)
+        if prof is not None:
+            block_w = prof.get("block_w")
+            strict_w = block_w is not None
+        else:
+            from triton_dist_tpu.tools.sweep import tuned_choice
+            block_w = (tuned_choice(tune_name, (X, B * Hq, NP * page))
+                       or {}).get("block_w")
+    if block_w is not None and X % block_w:
+        if strict_w:
             raise ValueError(
                 f"{tune_name}: block_w={block_w} does not divide the "
                 f"stream count X={X} (B*Hkv)")
+        block_w = None
+    if block_w is not None:
         W = int(block_w)
     else:
         W = next(w for w in (8, 4, 2, 1) if X % w == 0)

@@ -90,7 +90,13 @@ def store_update(path: str, chip: str, kernel: str, bucket: str,
                  entry: Dict[str, Any]) -> None:
     """Deep-merge ONE winner into the store under an exclusive lock:
     concurrent sweep processes union their (chip, kernel, bucket) cells
-    instead of last-writer-wins; same-cell writes take the newest."""
+    instead of last-writer-wins; same-cell writes take the newest.
+
+    Where POSIX flock is unavailable the merge runs unlocked: the
+    tmp+rename still keeps readers from ever seeing a torn file, but
+    two simultaneous writers can lose each other's cells (read-merge-
+    write race). Sweeps on such platforms should serialize or use
+    distinct --store paths."""
     if os.path.dirname(path):
         os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(f"{path}.lock", "w") as lf:
@@ -119,9 +125,13 @@ def tuned_choice(name: str, dims: Optional[Sequence[int]] = None,
     tune_dims — see KernelSpec docstring); None looks up the
     shape-generic bucket. When the exact bucket was never swept but
     exactly ONE bucket was, that winner is returned — a schedule choice
-    only, and consumers re-clamp blocks to legal divisors at their real
-    shapes, so a cross-bucket fallback can degrade perf but never
-    correctness."""
+    only: every consumer re-clamps blocks to legal divisors at its real
+    shapes (paged_kv's block_w ladder, group_gemm's _pick, flash_attn's
+    _pick_bx), so a cross-bucket fallback can degrade perf but never
+    correctness. Constraint-bearing dims additionally belong IN the
+    bucket key (the paged kernels lead with X=B*Hkv, which block_w must
+    divide) so exact-bucket hits are legal by construction and the
+    re-clamp stays a fallback, not the common path."""
     from triton_dist_tpu.tools.tune import _device_tag, shape_bucket
     path = path or default_store_path()
     per = _load_store(path).get(_device_tag(), {}).get(name)
@@ -190,16 +200,22 @@ def _cfg_key(cfg: Dict[str, Any]) -> str:
 
 
 def sweep_kernel(spec, mesh, *, iters: int = 2, warmup: int = 1,
-                 force: bool = False, store_path: Optional[str] = None
+                 force: bool = False, store_path: Optional[str] = None,
+                 pruned: Optional[Tuple[List[dict],
+                                        List[Tuple[dict, str]]]] = None
                  ) -> List[Dict[str, Any]]:
     """Prune, time and persist ONE kernel at its canonical shapes plus
     every declared shape-bucket variant. Returns one result dict per
-    swept bucket ({"kernel", "bucket", "cfg", "cached", ...})."""
+    swept bucket ({"kernel", "bucket", "cfg", "cached", ...}).
+    pruned: a prune_space(spec, mesh) result the caller already has
+    (the CLI prints a summary first) — passing it skips re-tracing the
+    whole config space."""
     import jax
     from triton_dist_tpu.tools import tune as _tune
     store_path = store_path or default_store_path()
     chip = _tune._device_tag()
-    survivors, rejected = prune_space(spec, mesh)
+    survivors, rejected = (pruned if pruned is not None
+                           else prune_space(spec, mesh))
     results: List[Dict[str, Any]] = []
     for build in (spec.build,) + tuple(spec.variants):
         fn0, args0 = build(mesh)
@@ -321,7 +337,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(line)
         for res in sweep_kernel(spec, mesh, iters=args.iters,
                                 warmup=args.warmup, force=args.force,
-                                store_path=store_path):
+                                store_path=store_path,
+                                pruned=(survivors, rejected)):
             swept += 1
             tag = ("cached" if res["cached"]
                    else (f"{res['time_us']:.1f}us"

@@ -55,7 +55,10 @@ def _store_cache(path: str, cache: Dict[str, Any]) -> None:
     lock: concurrent tuner/sweep processes UNION their keys instead of
     last-writer-wins (two sweeps tuning disjoint kernels both land,
     ISSUE 16 cache hardening). The write stays tmp+rename so a reader
-    never sees a torn file even where flock is a no-op."""
+    never sees a torn file even where flock is a no-op — but WITHOUT
+    flock the read-merge-write is unlocked, so two simultaneous writers
+    can still lose each other's keys (a lost key just re-tunes later;
+    it never corrupts the file)."""
     if os.path.dirname(path):
         os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(f"{path}.lock", "w") as lf:
