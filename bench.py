@@ -1248,6 +1248,109 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- structured generation rows (models/structured.py): (a) n=4
+    # parallel sampling through the KV fork — ONE submit fans into n
+    # decode slots sharing the prompt's pages (refcount+1, CoW
+    # boundary), so n-1 of n prompt prefills are skipped; the row's
+    # value is the measured prefill_skip_frac (≈ (n-1)/n) with the
+    # 4-sequential-requests arm timed alongside and the fork streams
+    # asserted bitwise equal to the sequential same-seed replays.
+    # (b) grammar-constrained decode (JSON schema → token FSM masks)
+    # with spec-K jump-ahead: deterministic grammar segments (fixed
+    # keys, braces, literals) ride the verify window as forced drafts,
+    # so constrained decoding is multi-token-per-forward — the row
+    # compares jump-ahead on (spec=K) vs off (spec=0) vs the
+    # unconstrained baseline on the same prompts.
+    from triton_dist_tpu.models.structured import GrammarSpec, byte_vocab
+    if on_tpu:
+        fs_len, fs_gen, fs_n, fs_page = 96, 32, 4, 16
+        cg_n, cg_gen, cg_K = 8, 64, 4
+    else:
+        fs_len, fs_gen, fs_n, fs_page = 24, 8, 4, 8
+        cg_n, cg_gen, cg_K = 3, 40, 4
+    eng_f = Engine(model, max_seq=fs_len + max(fs_gen, cg_gen) + 24,
+                   backend=backend)
+    rng = np.random.RandomState(21)
+    fs_ids = rng.randint(0, cfg.vocab_size,
+                         size=(fs_len,)).astype(np.int32)
+
+    def fork_run():
+        sched = ContinuousScheduler(eng_f, batch=fs_n, chunk=4,
+                                    paged=True, page=fs_page)
+        t0 = time.perf_counter()
+        out = sched.run([Request(rid="f", ids=fs_ids, gen_len=fs_gen,
+                                 seed=0, n=fs_n)])
+        return time.perf_counter() - t0, sched.stats(), out
+
+    def seq_run():
+        sched = ContinuousScheduler(eng_f, batch=fs_n, chunk=4,
+                                    paged=True, page=fs_page,
+                                    prefix_cache=False)
+        t0 = time.perf_counter()
+        out = sched.run([Request(rid=k, ids=fs_ids, gen_len=fs_gen,
+                                 seed=k) for k in range(fs_n)])
+        return time.perf_counter() - t0, out
+
+    fork_run(), seq_run()                  # warm the slot programs
+    fk_dt, fk_st, fk_out = fork_run()
+    sq_dt, sq_out = seq_run()
+    assert all(np.array_equal(fk_out[("f", k)], sq_out[k])
+               for k in range(fs_n)), "fork streams diverged"
+    _emit_json({
+        "metric": "parallel_sampling_prefill_skip_frac",
+        "value": round(fk_st["prefill_skip_frac"], 4),
+        "unit": "frac",
+        "n": fs_n,
+        "fork_wall_s": round(fk_dt, 4),
+        "sequential_wall_s": round(sq_dt, 4),
+        "fork_shared_pages": fk_st["fork_shared_pages"],
+        "fork_cow_breaks": fk_st["fork_cow_breaks"],
+        "prompt_tokens": fs_len,
+        "backend": jax.default_backend(),
+    })
+
+    cg_schema = {"type": "object", "properties": {
+        "answer": {"type": "boolean"},
+        "count": {"type": "integer", "maxDigits": 3}}}
+    cg_g = GrammarSpec.from_json_schema(cg_schema,
+                                        byte_vocab(cfg.vocab_size))
+
+    def cg_reqs(grammar):
+        r = np.random.RandomState(22)
+        return [Request(rid=i,
+                        ids=r.randint(0, cfg.vocab_size,
+                                      size=(fs_len,)).astype(np.int32),
+                        gen_len=cg_gen, grammar=grammar)
+                for i in range(cg_n)]
+
+    def cg_run(grammar, K):
+        mk = lambda: ContinuousScheduler(eng_f, batch=cg_n, chunk=4,
+                                         paged=True, page=fs_page,
+                                         spec=K)
+        mk().run(cg_reqs(grammar))         # warm the programs
+        sched = mk()
+        t0 = time.perf_counter()
+        out = sched.run(cg_reqs(grammar))
+        dt = time.perf_counter() - t0
+        total = sum(len(t) for t in out.values())
+        return total / dt, sched.stats()
+
+    cg_on, st_on = cg_run(cg_g, cg_K)      # jump-ahead: forced drafts
+    cg_off, _ = cg_run(cg_g, 0)            # masked, one token/forward
+    cg_base, _ = cg_run(None, 0)           # unconstrained baseline
+    _emit_json({
+        "metric": "constrained_decode_tok_per_s",
+        "value": round(cg_on, 2),
+        "unit": "tok/s",
+        "jump_ahead": True, "spec": cg_K,
+        "jump_off_tok_per_s": round(cg_off, 2),
+        "unconstrained_tok_per_s": round(cg_base, 2),
+        "jump_ahead_tokens": st_on.get("jump_ahead_tokens"),
+        "grammar_mask_tokens": st_on.get("grammar_mask_tokens"),
+        "requests": cg_n,
+        "backend": jax.default_backend(),
+    })
+
     # roofline rows: per-kernel achieved/SOL fractions from
     # tools/perf_report, into the same capture + history ledger so
     # bench_compare --strict gates on same-backend roofline

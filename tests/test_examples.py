@@ -122,6 +122,14 @@ def test_disaggregation_example_runs():
 
 
 @pytest.mark.slow
+def test_structured_output_example_runs():
+    # slow: same budget note — the fork/grammar differentials run
+    # in-suite (tests/test_structured.py); tools/struct_smoke.sh and
+    # manual runs cover the example itself.
+    _run_example("21_structured_output.py")
+
+
+@pytest.mark.slow
 def test_socket_serving_two_process():
     # slow: same budget note — the two-process socket matrix is
     # test_serving.py's; this is the doc artifact run.

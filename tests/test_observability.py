@@ -335,6 +335,32 @@ def test_bench_compare_sp_row_directions():
         == "higher-is-better"
 
 
+def test_bench_compare_structured_row_directions():
+    """ISSUE 17 satellite: the two structured-generation bench rows
+    resolve to the right regression direction —
+    `parallel_sampling_prefill_skip_frac` (unit "frac": a shared-work
+    fraction, DOWN = regressed) and `constrained_decode_tok_per_s`
+    (tok/s: DOWN = regressed — the metric NAME ends in "_s", so only
+    the rate-unit "/" rule keeps it from resolving as a latency)."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "parallel_sampling_prefill_skip_frac",
+          "value": 0.75, "unit": "frac", "backend": "tpu"},
+         {"metric": "constrained_decode_tok_per_s", "value": 700.0,
+          "unit": "tok/s", "backend": "tpu"}]
+    b = [{"metric": "parallel_sampling_prefill_skip_frac",
+          "value": 0.25, "unit": "frac", "backend": "tpu"},
+         {"metric": "constrained_decode_tok_per_s", "value": 300.0,
+          "unit": "tok/s", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["parallel_sampling_prefill_skip_frac"]["flag"] \
+        == "regressed"
+    assert res["parallel_sampling_prefill_skip_frac"]["direction"] \
+        == "higher-is-better"
+    assert res["constrained_decode_tok_per_s"]["flag"] == "regressed"
+    assert res["constrained_decode_tok_per_s"]["direction"] \
+        == "higher-is-better"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

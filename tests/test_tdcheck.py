@@ -411,6 +411,44 @@ def test_races_shadow_mode_real_tick_and_seeded_stray():
     assert any(f"page {stray}" in m for m in msgs), msgs
 
 
+def test_races_fork_sharing_legal_and_violation_fires():
+    """ISSUE 17: the fork-aware write-exclusivity proof. (a) n KV-fork
+    slots mapping the SAME refcount>1 prompt pages read-only is LEGAL
+    — check_scheduler over a live n=3 forked scheduler stays clean.
+    (b) Seeded violation: mutate one fork's table so its write tile
+    resolves to a fork-shared page (bypassing the CoW boundary copy)
+    and the checker must fire a 'fork CoW violation' naming the page."""
+    import dataclasses
+    from triton_dist_tpu.models.scheduler import (ContinuousScheduler,
+                                                  Request)
+    cfg, eng = _tiny_engine(backend="xla")
+    sched = ContinuousScheduler(eng, batch=4, chunk=2, paged=True,
+                                page=4)
+    sched.submit(Request(rid="F", ids=np.arange(1, 10, dtype=np.int32),
+                         gen_len=6, n=3))
+    for _ in range(2):
+        sched.poll()
+    slots = sched.slots
+    assert int(slots._is_fork.sum()) == 2, slots._is_fork
+    clean = races.check_scheduler(sched)
+    assert not clean.errors, _errors(clean)
+    # mutation: point a fork's write tile at a page its parent (and
+    # sibling) still map — the write the CoW boundary copy exists to
+    # prevent
+    table = np.asarray(jax.device_get(slots.cache.table)).copy()
+    pos = np.asarray(jax.device_get(slots.pos))
+    Hkv = cfg.num_kv_heads
+    fork = int(np.nonzero(slots._is_fork)[0][0])
+    shared_page = int(slots._groups[fork][0][0])
+    table[fork * Hkv, int(pos[fork]) // slots.page] = shared_page
+    slots.cache = dataclasses.replace(slots.cache,
+                                      table=jnp.asarray(table))
+    r = races.check_scheduler(sched)
+    msgs = _errors(r)
+    assert any("fork CoW violation" in m and f"page {shared_page}" in m
+               for m in msgs), msgs
+
+
 # ---------------------------------------------------------------------------
 # checker 4: hot-loop lint
 # ---------------------------------------------------------------------------

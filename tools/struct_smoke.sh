@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Structured-generation smoke — the full KV-fork + grammar matrix
+# (tests/test_structured.py: greedy/sampled/spec fork differentials,
+# preempt-mid-fork, pool pressure, jump-ahead bitwise, the randomized
+# cancel/preempt zero-leak soak, the TokenServer wire arms and the
+# example) plus the fork-aware race-checker proof in test_tdcheck, on
+# the forced multi-device CPU mesh tier-1 uses. Archives the pass
+# count next to the log and reports the delta vs the previous run,
+# tier1.sh-style. Run from the repo root: bash tools/struct_smoke.sh
+set -o pipefail
+rm -f /tmp/_struct_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the FULL
+# structured matrix, including the arms tier-1's 870 s budget pushes
+# behind the slow mark (sampled/spec forks, pressure, soak, sockets,
+# the example).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_structured.py \
+    "tests/test_tdcheck.py::test_races_fork_sharing_legal_and_violation_fires" \
+    "tests/test_examples.py::test_structured_output_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_struct_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_struct_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_struct_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "STRUCT_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "STRUCT_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

@@ -56,11 +56,12 @@ def page_write_targets(table: np.ndarray, pos: np.ndarray, page: int,
 
 
 def check_state(table, pos, active, page: int, n_kv_heads: int, *,
-                trash: int, refcount=None, subject: str = "paged-state",
+                trash: int, refcount=None, shared=None,
+                subject: str = "paged-state",
                 report: Optional[Report] = None) -> Report:
     """Write-exclusivity + CoW discipline over one host-side snapshot.
 
-    Three rules:
+    Four rules:
     - no two (slot, head) streams write one physical page this tick;
     - no slot writes a page that lies inside ANOTHER slot's mapped
       valid extent (tiles 0..pos//page) — that reader would see the
@@ -72,6 +73,12 @@ def check_state(table, pos, active, page: int, n_kv_heads: int, *,
     - with `refcount` (prefix_cache.RefcountedPages.refcount): a
       non-trash write target at refcount 0 is a freed page — the
       allocator may re-issue it mid-write.
+    - with `shared` (the page set mapped by TWO OR MORE live slots —
+      the KV-fork sharing set, models/structured.py): n slots holding
+      those pages READ-ONLY is legal (that sharing is the point of
+      fork), but any write target inside the set is a fork CoW
+      violation — fork must boundary-copy before a fork's appends can
+      land, exactly like admission's prefix-cache CoW.
     """
     if report is None:
         report = Report("races")
@@ -123,15 +130,25 @@ def check_state(table, pos, active, page: int, n_kv_heads: int, *,
                     f"write to freed page: slot {b} head {h} writes "
                     f"page {p} at refcount 0 — the allocator may "
                     f"re-issue it to another slot mid-write")
+            if shared is not None and p in shared:
+                report.add(
+                    "error", _HERE + ":check_state", subject,
+                    f"fork CoW violation: slot {b} head {h} writes "
+                    f"page {p} which two or more live slots map "
+                    f"(fork-shared prefix KV) — a fork's appends must "
+                    f"land on a boundary-copied page, never the "
+                    f"shared original (every sibling reads it)")
     report.covered.append(subject)
     return report
 
 
 def check_scheduler(sched, report: Optional[Report] = None) -> Report:
     """check_state over a live PagedDecodeSlots/ContinuousScheduler
-    (device table+pos are tiny: one coalesced device_get). Also
-    re-proves the pool conservation invariant as a finding instead of
-    an assert."""
+    (device table+pos are tiny: one coalesced device_get). Fork-aware:
+    the pages mapped by two or more live slots' host group mirrors
+    form the `shared` set — KV-fork siblings reading them is legal,
+    any write target among them fires. Also re-proves the pool
+    conservation invariant as a finding instead of an assert."""
     import jax
     if report is None:
         report = Report("races")
@@ -139,10 +156,18 @@ def check_scheduler(sched, report: Optional[Report] = None) -> Report:
     table, pos, active = jax.device_get(
         (slots.cache.table, slots.pos, slots.active))
     pool = slots.prefix.pool
+    # fork sharing set: a page counted once per live slot that maps it
+    holders: Dict[int, int] = {}
+    for b, groups in enumerate(getattr(slots, "_groups", ())):
+        if b < len(active) and active[b]:
+            for p in {int(p) for g in groups for p in g}:
+                holders[p] = holders.get(p, 0) + 1
+    shared = {p for p, c in holders.items() if c >= 2}
     check_state(table, pos, active, slots.page,
                 slots.engine.model.config.num_kv_heads,
                 trash=slots.cache.trash, refcount=pool.refcount,
-                subject=type(slots).__name__, report=report)
+                shared=shared, subject=type(slots).__name__,
+                report=report)
     if pool.available + pool.outstanding != pool.num_pages:
         report.add(
             "error", _HERE + ":check_scheduler", type(slots).__name__,

@@ -424,7 +424,7 @@ class Engine:
         return logits[0], cache
 
     def slot_chunk(self, logits, cache, pos, active, *, chunk: int,
-                   keys=None):
+                   keys=None, mask=None):
         """One chunk of slot-masked decode: `chunk` scan steps where
         row b samples from its own logits, appends KV at its own
         pos[b], and advances only if active[b] (inactive slots write
@@ -441,22 +441,43 @@ class Engine:
         holds for every slot program below (verify, mixed, paged):
         scheduler.DecodeSlots defers that read to one coalesced
         device_get per poll (_fetch), and overlap=True moves it past
-        the next dispatch."""
+        the next dispatch.
+
+        mask: [B, V] bool grammar masks (models/structured.py) riding
+        the existing operands — requires chunk == 1 (the mask is a
+        scan constant); mask=None leaves every call expression
+        byte-identical, so unconstrained serving never retraces."""
         if self.backend == "mega":
             raise ValueError(
                 "backend='mega' fuses the PAGED decode tick only "
                 "(paged_slot_chunk); contiguous slot serving runs the "
                 "per-op backends — use ContinuousScheduler(paged=True) "
                 "or backend='flash'")
+        if mask is not None and chunk != 1:
+            raise ValueError(
+                f"grammar masks are per-step (scan constants): serve "
+                f"constrained slots at chunk == 1, got chunk={chunk}")
         self._c_decode.inc()
         if self._comm_backend:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
+            if mask is not None:
+                toks, logits, cache, pos = self._note_moe_load(
+                    self._slot_scan(self.model, logits, cache, pos,
+                                    active, jnp.asarray(mask, bool),
+                                    gen_len=chunk))
+                return toks, logits, cache, pos, None
             toks, logits, cache, pos = self._note_moe_load(
                 self._slot_scan(self.model, logits, cache, pos, active,
                                 gen_len=chunk))
             return toks, logits, cache, pos, None
+        if mask is not None:
+            toks, logits, cache, pos, keys = self._note_moe_load(
+                self._slot_scan(self.model, logits, cache, pos, active,
+                                keys, jnp.asarray(mask, bool),
+                                gen_len=chunk))
+            return toks, logits, cache, pos, keys
         toks, logits, cache, pos, keys = self._note_moe_load(
             self._slot_scan(self.model, logits, cache, pos, active,
                             keys, gen_len=chunk))
@@ -468,15 +489,19 @@ class Engine:
     # scheduler's spec=K mode drives these)
     # ------------------------------------------------------------------
 
-    def spec_seed(self, row_logits, key):
+    def spec_seed(self, row_logits, key, mask=None):
         """Draw the pending seed token for a freshly admitted slot from
         its prefill logits (sampled modes only; greedy admission takes
-        the host argmax). Returns (token, evolved key)."""
+        the host argmax). mask [V] bool: grammar-legal support for a
+        constrained slot. Returns (token, evolved key)."""
         assert self.sampling != "greedy"
+        if mask is not None:
+            return self._spec_seed(row_logits, key,
+                                   jnp.asarray(mask, bool))
         return self._spec_seed(row_logits, key)
 
     def slot_verify_chunk(self, cache, pos, active, tokens, q_lens, *,
-                          keys=None):
+                          keys=None, mask=None):
         """One speculative verify step over the CONTIGUOUS slot cache:
         score every slot's draft window (tokens [B, S] — the pending
         seed token at column 0, up to S-1 drafts after, padded; q_lens
@@ -488,6 +513,8 @@ class Engine:
         dead rows past the rewound length, overwritten by the next
         step. Returns (n_emit [B] — tokens kept from the window,
         t0_next [B] — the corrected next seed token, cache, pos, keys).
+        mask: [B, S, V] bool per-position grammar masks
+        (structured.window_masks) constraining acceptance + reseed.
         """
         if self.backend == "mega":
             raise ValueError(
@@ -501,17 +528,29 @@ class Engine:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
+            if mask is not None:
+                n_emit, t0n, cache, pos = self._note_moe_load(
+                    self._slot_verify(self.model, cache, pos, active,
+                                      tokens, q_lens,
+                                      jnp.asarray(mask, bool)))
+                return n_emit, t0n, cache, pos, None
             n_emit, t0n, cache, pos = self._note_moe_load(
                 self._slot_verify(self.model, cache, pos, active,
                                   tokens, q_lens))
             return n_emit, t0n, cache, pos, None
+        if mask is not None:
+            n_emit, t0n, cache, pos, keys = self._note_moe_load(
+                self._slot_verify(self.model, cache, pos, active,
+                                  tokens, q_lens, keys,
+                                  jnp.asarray(mask, bool)))
+            return n_emit, t0n, cache, pos, keys
         n_emit, t0n, cache, pos, keys = self._note_moe_load(
             self._slot_verify(self.model, cache, pos, active, tokens,
                               q_lens, keys))
         return n_emit, t0n, cache, pos, keys
 
     def paged_slot_verify_chunk(self, pcache, pos, active, tokens,
-                                q_lens, *, keys=None):
+                                q_lens, *, keys=None, mask=None):
         """slot_verify_chunk over the PAGED pool: identical contract,
         with the window KV scatter and attention resolved through the
         page table (a padded row's write drops out of bounds, so it can
@@ -530,10 +569,22 @@ class Engine:
             self._c_comm.inc()
         if self.sampling == "greedy":
             assert keys is None
+            if mask is not None:
+                n_emit, t0n, pcache, pos = self._note_moe_load(
+                    self._paged_slot_verify(self.model, pcache, pos,
+                                            active, tokens, q_lens,
+                                            jnp.asarray(mask, bool)))
+                return n_emit, t0n, pcache, pos, None
             n_emit, t0n, pcache, pos = self._note_moe_load(
                 self._paged_slot_verify(self.model, pcache, pos, active,
                                         tokens, q_lens))
             return n_emit, t0n, pcache, pos, None
+        if mask is not None:
+            n_emit, t0n, pcache, pos, keys = self._note_moe_load(
+                self._paged_slot_verify(self.model, pcache, pos, active,
+                                        tokens, q_lens, keys,
+                                        jnp.asarray(mask, bool)))
+            return n_emit, t0n, pcache, pos, keys
         n_emit, t0n, pcache, pos, keys = self._note_moe_load(
             self._paged_slot_verify(self.model, pcache, pos, active,
                                     tokens, q_lens, keys))
@@ -552,7 +603,7 @@ class Engine:
     # ------------------------------------------------------------------
 
     def slot_mixed_chunk(self, logits, cache, pos, active, prefilling,
-                         tokens, q_lens, *, keys=None):
+                         tokens, q_lens, *, keys=None, mask=None):
         """One MIXED prefill+decode tick over the CONTIGUOUS slot cache.
 
         tokens [B, S] / q_lens [B]: row b of a PREFILLING slot holds
@@ -568,7 +619,8 @@ class Engine:
         logits at each row's last valid window position (a decode
         row's next carry; a final-chunk prefill row's ARMING logits),
         cache, pos, keys). pos advances by q_lens for prefill rows and
-        by 1 for active decode rows."""
+        by 1 for active decode rows. mask: [B, V] grammar masks over
+        the decode rows' token selection (sel_logits stay raw)."""
         if self.backend == "mega":
             raise ValueError(
                 "backend='mega' fuses the PAGED decode tick only; "
@@ -582,12 +634,18 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
+        if mask is not None:
+            return self._note_moe_load(
+                self._slot_mixed(self.model, logits, cache, pos, active,
+                                 prefilling, tokens, q_lens, keys,
+                                 jnp.asarray(mask, bool)))
         return self._note_moe_load(
             self._slot_mixed(self.model, logits, cache, pos, active,
                              prefilling, tokens, q_lens, keys))
 
     def paged_slot_mixed_chunk(self, logits, pcache, pos, active,
-                               prefilling, tokens, q_lens, *, keys=None):
+                               prefilling, tokens, q_lens, *, keys=None,
+                               mask=None):
         """slot_mixed_chunk over the PAGED pool: identical contract,
         chunk rows scatter their KV through the page table (padded rows
         drop out of bounds) and attention walks the pool with per-slot
@@ -600,13 +658,19 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
+        if mask is not None:
+            return self._note_moe_load(
+                self._paged_slot_mixed(self.model, logits, pcache, pos,
+                                       active, prefilling, tokens,
+                                       q_lens, keys,
+                                       jnp.asarray(mask, bool)))
         return self._note_moe_load(
             self._paged_slot_mixed(self.model, logits, pcache, pos,
                                    active, prefilling, tokens, q_lens,
                                    keys))
 
     def slot_mixed_verify_chunk(self, cache, pos, active, prefilling,
-                                tokens, q_lens, *, keys=None):
+                                tokens, q_lens, *, keys=None, mask=None):
         """Spec-mode mixed tick (CONTIGUOUS): decode rows carry their
         draft-verify windows (seed at column 0, q_lens up to spec+1 —
         the _slot_verify contract) while prefill rows carry prompt
@@ -614,7 +678,8 @@ class Engine:
         runs for decode rows only; prefill rows advance by their full
         chunk unconditionally. Returns (n_emit [B], t0_next [B],
         sel_logits [B, V] — arming logits at each row's last valid
-        window position, cache, pos, keys)."""
+        window position, cache, pos, keys). mask: [B, S, V] grammar
+        window masks over acceptance (sel_logits stay raw)."""
         if self.backend == "mega":
             raise ValueError(
                 "backend='mega' does not fuse the spec-decode verify "
@@ -627,13 +692,18 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
+        if mask is not None:
+            return self._note_moe_load(
+                self._slot_mixed_verify(self.model, cache, pos, active,
+                                        prefilling, tokens, q_lens,
+                                        keys, jnp.asarray(mask, bool)))
         return self._note_moe_load(
             self._slot_mixed_verify(self.model, cache, pos, active,
                                     prefilling, tokens, q_lens, keys))
 
     def paged_slot_mixed_verify_chunk(self, pcache, pos, active,
                                       prefilling, tokens, q_lens, *,
-                                      keys=None):
+                                      keys=None, mask=None):
         """slot_mixed_verify_chunk over the PAGED pool."""
         tokens = jnp.asarray(tokens, jnp.int32)
         q_lens = jnp.asarray(q_lens, jnp.int32)
@@ -643,6 +713,12 @@ class Engine:
         self._c_mixed.inc()
         if self._comm_backend:
             self._c_comm.inc()
+        if mask is not None:
+            return self._note_moe_load(
+                self._paged_slot_mixed_verify(self.model, pcache, pos,
+                                              active, prefilling,
+                                              tokens, q_lens, keys,
+                                              jnp.asarray(mask, bool)))
         return self._note_moe_load(
             self._paged_slot_mixed_verify(self.model, pcache, pos,
                                           active, prefilling, tokens,
@@ -802,7 +878,7 @@ class Engine:
         return logits[0], pcache
 
     def paged_slot_chunk(self, logits, pcache, pos, active, *,
-                         chunk: int, keys=None):
+                         chunk: int, keys=None, mask=None):
         """slot_chunk over the paged pool: identical contract, but each
         row's KV scatter resolves through the page table (a retired
         row's table maps the trash page, so its masked-out writes can
@@ -811,22 +887,50 @@ class Engine:
         backend='mega' routes this tick through the FUSED program
         (_paged_slot_mega_scan_fn — one MegaPagedDecodeLayer kernel
         per layer per step instead of the per-op dispatch chain),
-        greedy-only by construction; same contract, same carry."""
+        greedy-only by construction; same contract, same carry.
+
+        mask: [B, V] grammar masks (chunk == 1 required, see
+        slot_chunk); the fused mega tick does not take them — its
+        in-kernel argmax never sees a mask operand."""
         self._c_decode.inc()
         if self._comm_backend:
             self._c_comm.inc()
         if self.backend == "mega":
+            if mask is not None:
+                raise ValueError(
+                    "backend='mega' fuses the greedy paged tick with "
+                    "an in-kernel argmax and takes no grammar mask "
+                    "operand; serve constrained requests on the "
+                    "per-op backends (backend='flash'/'dist'/...)")
             assert keys is None   # greedy enforced at __init__
             self._c_mega.inc()
             toks, logits, pcache, pos = self._paged_slot_mega(
                 self.model, logits, pcache, pos, active, gen_len=chunk)
             return toks, logits, pcache, pos, None
+        if mask is not None and chunk != 1:
+            raise ValueError(
+                f"grammar masks are per-step (scan constants): serve "
+                f"constrained slots at chunk == 1, got chunk={chunk}")
         if self.sampling == "greedy":
             assert keys is None
+            if mask is not None:
+                toks, logits, pcache, pos = self._note_moe_load(
+                    self._paged_slot_scan(self.model, logits, pcache,
+                                          pos, active,
+                                          jnp.asarray(mask, bool),
+                                          gen_len=chunk))
+                return toks, logits, pcache, pos, None
             toks, logits, pcache, pos = self._note_moe_load(
                 self._paged_slot_scan(self.model, logits, pcache, pos,
                                       active, gen_len=chunk))
             return toks, logits, pcache, pos, None
+        if mask is not None:
+            toks, logits, pcache, pos, keys = self._note_moe_load(
+                self._paged_slot_scan(self.model, logits, pcache, pos,
+                                      active, keys,
+                                      jnp.asarray(mask, bool),
+                                      gen_len=chunk))
+            return toks, logits, pcache, pos, keys
         toks, logits, pcache, pos, keys = self._note_moe_load(
             self._paged_slot_scan(self.model, logits, pcache, pos,
                                   active, keys, gen_len=chunk))
@@ -1116,8 +1220,8 @@ def _is_moe(model) -> bool:
         and hasattr(model, "forward_tokens_slots")
 
 
-def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
-                         gen_len: int):
+def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active,
+                         mask=None, *, gen_len: int):
     """Slot-masked greedy decode chunk (continuous batching): same
     shape as _scan_decode_fn, but each batch row is an independent
     request at its own position. Inactive rows still flow through the
@@ -1125,7 +1229,13 @@ def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
     their writes land in their own dead cache rows and their tokens are
     discarded by the scheduler. MoE family: the routing-load vector
     rides the scan carry and returns as one extra output (the dense
-    trace is untouched)."""
+    trace is untouched).
+
+    mask [B, V] bool (models/structured.py grammar masks): token
+    selection argmaxes over where(mask, logits, -inf) — constant
+    across the scan, so grammar serving runs chunk == 1 (the
+    scheduler's _eff_chunk); mask=None leaves the trace byte-identical
+    to before the grammar subsystem existed."""
     act = active.astype(jnp.int32)
     moe = _is_moe(model)
 
@@ -1134,7 +1244,9 @@ def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
             logits, cache, pos, load = carry
         else:
             logits, cache, pos = carry
-        tok = jnp.argmax(logits, axis=-1)           # greedy [B]
+        sel = logits if mask is None else \
+            jnp.where(mask, logits, -jnp.inf)
+        tok = jnp.argmax(sel, axis=-1)              # greedy [B]
         tok = jnp.where(active, tok, 0)
         if moe:
             logits, cache, st = model.forward_tokens_slots(
@@ -1161,12 +1273,16 @@ def _slot_scan_decode_fn(backend, model, logits0, cache, pos, active, *,
 
 
 def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
-                                 logits0, cache, pos, active, keys, *,
-                                 gen_len: int):
+                                 logits0, cache, pos, active, keys,
+                                 mask=None, *, gen_len: int):
     """Sampled slot decode chunk: per-slot PRNG keys split once per
     step, so each slot's sampled chain equals a single-request
     Engine.serve() at that slot's seed — and is invariant to chunk
-    boundaries and to whatever the other slots are doing."""
+    boundaries and to whatever the other slots are doing.
+
+    mask [B, V] bool: grammar-illegal logits drop to -inf BEFORE the
+    top-k/top-p sampler, so the emitted marginal is the sampler's
+    renormalized over the legal support (mask=None: untouched trace)."""
     from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
 
     temp = max(params["temperature"], 0.0)
@@ -1190,7 +1306,9 @@ def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
         split = jax.vmap(functools.partial(jax.random.split, num=2))
         ks = split(keys)
         keys, subs = ks[:, 0], ks[:, 1]
-        tok = jax.vmap(sample_one)(subs, logits)    # [B]
+        sel = logits if mask is None else \
+            jnp.where(mask, logits, -jnp.inf)
+        tok = jax.vmap(sample_one)(subs, sel)       # [B]
         tok = jnp.where(active, tok, 0)
         if moe:
             logits, cache, st = model.forward_tokens_slots(
@@ -1214,13 +1332,17 @@ def _sampled_slot_scan_decode_fn(backend, sampling, params, model,
     return toks.T, logits, cache, pos, keys          # [B, gen_len]
 
 
-def _spec_seed_fn(sampling, params, logits, key):
+def _spec_seed_fn(sampling, params, logits, key, mask=None):
     """Sample the pending seed token for a fresh spec-mode slot from
     its prefill logits, consuming one split of the slot's PRNG chain
-    (models/spec_decode.py; greedy admission argmaxes on the host)."""
+    (models/spec_decode.py; greedy admission argmaxes on the host).
+    mask [V] bool: grammar-legal support for a constrained slot's
+    arming draw (None: untouched trace)."""
     from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
     temp = max(params["temperature"], 0.0)
     key, sub = jax.random.split(key)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
     if temp == 0.0:
         tok = jnp.argmax(logits, axis=-1)
     elif sampling == "top_k":
@@ -1285,28 +1407,39 @@ def _verify_forward(backend, paged, model, cache, pos, tokens, q_lens):
     return logits_all, cache, cache.k[0].shape[2], load
 
 
-def _slot_verify_fn(backend, model, cache, pos, active, tokens, q_lens):
+def _slot_verify_fn(backend, model, cache, pos, active, tokens, q_lens,
+                    mask=None):
     """Greedy speculative verify (contiguous cache): one forward over
     every slot's padded draft window + the shared on-device acceptance
     epilogue (_verify_accept). Inactive slots flow through masked
-    (q_lens handed in as 1, writes land in their own dead rows)."""
+    (q_lens handed in as 1, writes land in their own dead rows).
+
+    mask [B, S, V] bool (structured.window_masks): the acceptance rule
+    — argmax matching and the corrected seed — runs over
+    where(mask, logits, -inf), so a grammar slot only ever accepts or
+    reseeds grammar-legal tokens; None = byte-identical trace."""
     logits_all, cache, cap, load = _verify_forward(
         backend, False, model, cache, pos, tokens, q_lens)
+    acc = logits_all if mask is None else \
+        jnp.where(mask, logits_all, -jnp.inf)
     n_emit, t0n, pos, _ = _verify_accept(
-        None, None, logits_all, tokens, q_lens, active, pos, cap)
+        None, None, acc, tokens, q_lens, active, pos, cap)
     if load is not None:
         return n_emit, t0n, cache, pos, load
     return n_emit, t0n, cache, pos
 
 
 def _sampled_slot_verify_fn(backend, sampling, params, model, cache, pos,
-                            active, tokens, q_lens, keys):
+                            active, tokens, q_lens, keys, mask=None):
     """Sampled _slot_verify_fn: leftover rejection sampling through the
-    per-slot PRNG chains (see _verify_accept)."""
+    per-slot PRNG chains (see _verify_accept); a grammar mask zeroes
+    the illegal tokens' target probabilities before acceptance."""
     logits_all, cache, cap, load = _verify_forward(
         backend, False, model, cache, pos, tokens, q_lens)
+    acc = logits_all if mask is None else \
+        jnp.where(mask, logits_all, -jnp.inf)
     n_emit, t0n, pos, keys = _verify_accept(
-        sampling, params, logits_all, tokens, q_lens, active, pos,
+        sampling, params, acc, tokens, q_lens, active, pos,
         cap, keys)
     if load is not None:
         return n_emit, t0n, cache, pos, keys, load
@@ -1314,13 +1447,15 @@ def _sampled_slot_verify_fn(backend, sampling, params, model, cache, pos,
 
 
 def _paged_slot_verify_fn(backend, model, pcache, pos, active, tokens,
-                          q_lens):
+                          q_lens, mask=None):
     """_slot_verify_fn over the PAGED pool (the prefix-cache serving
     path): identical acceptance, KV resolved through the page table."""
     logits_all, pcache, cap, load = _verify_forward(
         backend, True, model, pcache, pos, tokens, q_lens)
+    acc = logits_all if mask is None else \
+        jnp.where(mask, logits_all, -jnp.inf)
     n_emit, t0n, pos, _ = _verify_accept(
-        None, None, logits_all, tokens, q_lens, active, pos, cap)
+        None, None, acc, tokens, q_lens, active, pos, cap)
     if load is not None:
         return n_emit, t0n, pcache, pos, load
     return n_emit, t0n, pcache, pos
@@ -1328,12 +1463,14 @@ def _paged_slot_verify_fn(backend, model, pcache, pos, active, tokens,
 
 def _sampled_paged_slot_verify_fn(backend, sampling, params, model,
                                   pcache, pos, active, tokens, q_lens,
-                                  keys):
+                                  keys, mask=None):
     """Sampled _paged_slot_verify_fn (see _verify_accept)."""
     logits_all, pcache, cap, load = _verify_forward(
         backend, True, model, pcache, pos, tokens, q_lens)
+    acc = logits_all if mask is None else \
+        jnp.where(mask, logits_all, -jnp.inf)
     n_emit, t0n, pos, keys = _verify_accept(
-        sampling, params, logits_all, tokens, q_lens, active, pos,
+        sampling, params, acc, tokens, q_lens, active, pos,
         cap, keys)
     if load is not None:
         return n_emit, t0n, pcache, pos, keys, load
@@ -1341,7 +1478,8 @@ def _sampled_paged_slot_verify_fn(backend, sampling, params, model,
 
 
 def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
-                   cache, pos, active, prefilling, tokens, q_lens, keys):
+                   cache, pos, active, prefilling, tokens, q_lens, keys,
+                   mask=None):
     """Non-spec MIXED prefill+decode tick (chunked prefill,
     models/scheduler.py step_mixed): decode rows behave as exactly one
     step of the plain slot scan (sample from the carry logits — one key
@@ -1355,11 +1493,18 @@ def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
     logits (non-final chunks return live-but-unused logits the
     scheduler overwrites on the next tick). A budget-starved prefill
     row (q_len == 0) writes nothing (its padded rows scatter out of
-    bounds) and advances 0."""
+    bounds) and advances 0.
+
+    mask [B, V] bool: constrains the decode rows' token selection from
+    the carry logits only — sel_logits stay RAW (a prefill row's
+    arming logits must be the unconstrained model output; the grammar
+    mask applies at every SELECTION from them, never to the carry)."""
     from triton_dist_tpu.models.utils import sample_top_k, sample_top_p
     B, S = tokens.shape
+    sel0 = logits0 if mask is None else \
+        jnp.where(mask, logits0, -jnp.inf)
     if sampling is None or max(params["temperature"], 0.0) == 0.0:
-        tok = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(sel0, axis=-1).astype(jnp.int32)
     else:
         temp = max(params["temperature"], 0.0)
 
@@ -1373,7 +1518,7 @@ def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
         split = jax.vmap(functools.partial(jax.random.split, num=2))
         ks = split(keys)
         keys, subs = ks[:, 0], ks[:, 1]
-        tok = jax.vmap(sample_one)(subs, logits0).astype(jnp.int32)
+        tok = jax.vmap(sample_one)(subs, sel0).astype(jnp.int32)
     tok = jnp.where(active, tok, 0)
     toks = tokens.at[:, 0].set(jnp.where(active, tok, tokens[:, 0]))
     logits_all, cache, cap, load = _verify_forward(
@@ -1388,19 +1533,23 @@ def _mixed_step_fn(backend, sampling, params, paged, model, logits0,
 
 
 def _mixed_verify_fn(backend, sampling, params, paged, model, cache, pos,
-                     active, prefilling, tokens, q_lens, keys):
+                     active, prefilling, tokens, q_lens, keys,
+                     mask=None):
     """Spec-mode mixed tick: one verify-shaped forward over decode
     draft windows AND prefill chunks; the acceptance epilogue
     (_verify_accept) applies to decode rows only (n_emit masked by
     `active`, which is False for prefilling slots), then prefill rows
     advance unconditionally by their chunk length. sel_logits are the
     per-row last-valid-position logits (the arming logits when a final
-    chunk lands)."""
+    chunk lands). mask [B, S, V]: acceptance only — sel_logits stay
+    RAW (see _mixed_step_fn)."""
     B, S = tokens.shape
     logits_all, cache, cap, load = _verify_forward(
         backend, paged, model, cache, pos, tokens, q_lens)
+    acc = logits_all if mask is None else \
+        jnp.where(mask, logits_all, -jnp.inf)
     n_emit, t0n, pos, keys = _verify_accept(
-        sampling, params, logits_all, tokens, q_lens, active, pos, cap,
+        sampling, params, acc, tokens, q_lens, active, pos, cap,
         keys)
     pos = jnp.minimum(pos + jnp.where(prefilling, q_lens, 0), cap - 1)
     sel = jnp.maximum(q_lens - 1, 0)
@@ -1827,10 +1976,11 @@ def _restore_pages_fn(model, pcache, ids, hk, hv, hsk=None, hsv=None):
 
 
 def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,
-                               active, *, gen_len: int):
+                               active, mask=None, *, gen_len: int):
     """Greedy slot-masked decode chunk over the PAGED pool: same shape
     as _slot_scan_decode_fn with the per-row KV scatter and attention
-    resolved through the page table."""
+    resolved through the page table (and the same [B, V] grammar-mask
+    contract)."""
     act = active.astype(jnp.int32)
     cap = pcache.capacity
     moe = _is_moe(model)
@@ -1840,7 +1990,9 @@ def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,
             logits, pc, pos, load = carry
         else:
             logits, pc, pos = carry
-        tok = jnp.argmax(logits, axis=-1)
+        sel = logits if mask is None else \
+            jnp.where(mask, logits, -jnp.inf)
+        tok = jnp.argmax(sel, axis=-1)
         tok = jnp.where(active, tok, 0)
         if moe:
             logits, pc, st = model.forward_tokens_slots_paged(
@@ -1865,8 +2017,8 @@ def _paged_slot_scan_decode_fn(backend, model, logits0, pcache, pos,
 
 
 def _sampled_paged_slot_scan_fn(backend, sampling, params, model,
-                                logits0, pcache, pos, active, keys, *,
-                                gen_len: int):
+                                logits0, pcache, pos, active, keys,
+                                mask=None, *, gen_len: int):
     """Sampled paged slot chunk: per-slot PRNG chains exactly as in
     _sampled_slot_scan_decode_fn — the sampler never sees the cache
     layout, so paged streams equal contiguous streams token for token
@@ -1895,7 +2047,9 @@ def _sampled_paged_slot_scan_fn(backend, sampling, params, model,
         split = jax.vmap(functools.partial(jax.random.split, num=2))
         ks = split(keys)
         keys, subs = ks[:, 0], ks[:, 1]
-        tok = jax.vmap(sample_one)(subs, logits)
+        sel = logits if mask is None else \
+            jnp.where(mask, logits, -jnp.inf)
+        tok = jax.vmap(sample_one)(subs, sel)
         tok = jnp.where(active, tok, 0)
         if moe:
             logits, pc, st = model.forward_tokens_slots_paged(

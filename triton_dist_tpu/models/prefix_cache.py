@@ -53,6 +53,18 @@ that pair for the TPU serving stack:
   raw pool-dtype bytes, so warm-from-host streams are BITWISE equal
   to HBM-hit and cold-recompute streams (tests/test_kv_tier.py).
 
+- KV FORK (parallel sampling, models/structured.py + scheduler
+  `Request(n=N)`): `PagedDecodeSlots.fork` is the third consumer of
+  this module's refcount/CoW machinery — a fork child RETAINS the
+  parent slot's full prompt page groups (refcount+1, mapped into its
+  own table exactly like a tree hit) and copy-on-writes the
+  partially-filled boundary page, so n decode streams share one
+  prompt's physical KV. The fork records its skipped prefill through
+  the same `record()` accounting a tree hit uses, and a fork child
+  that cannot fork NOW falls back to ordinary admission whose tree
+  match rebuilds the identical mapping — which is what keeps forked
+  and sequential streams bitwise (tests/test_structured.py).
+
 Exactness contract (tests/test_prefix_cache.py): reused prefix KV is
 bitwise the KV the donor request computed for the same (token, position)
 pairs, and the suffix forward runs the same program as a cache-off

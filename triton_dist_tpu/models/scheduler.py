@@ -162,6 +162,28 @@ the finished page-groups to this scheduler's decode pool over the
 p2p/DCN transfer plane, so decode polls never run a mixed tick at
 all. Streams stay bitwise identical disagg vs fused
 (tests/test_disagg.py).
+
+Structured generation (models/structured.py — ISSUE 17): two
+policy-layer features riding the machinery above unchanged.
+PARALLEL SAMPLING: `Request(n=N)` fans out at admission (_fan_out)
+into N children; child 0 prefills normally and the moment its slot
+arms, _spawn_forks maps its prompt pages into the siblings' tables
+(PagedDecodeSlots.fork — refcount+1 on full pages, CoW boundary, the
+exact mapping a prefix-cache hit would build, which is why an
+overflowed sibling falling back to ordinary admission stays bitwise).
+Child k streams under rid (rid, k) at seed seed+k, cancels/preempts/
+retires independently, and equals a sequential same-seed request
+token-for-token (tests/test_structured.py). GRAMMAR-CONSTRAINED
+DECODING: `Request(grammar=GrammarSpec)` collapses the slot's chunk
+to 1 (_eff_chunk), threads per-state token masks into the EXISTING
+tick programs as logits operands (_mask_chunk/_mask_window — zero new
+XLA programs, zero extra host round trips), advances the host
+automaton per emitted token (dead end → loud per-request reject,
+final state → early finish), and under spec=K turns the automaton's
+forced continuation into jump-ahead drafts through the normal verify
+path (structured.constrained_draft; `jump_ahead_tokens` counter).
+Overlap grammar polls collapse to the sync iteration — the next mask
+needs the unlanded token (_grammar_sync_needed).
 """
 
 from __future__ import annotations
@@ -176,6 +198,8 @@ import numpy as np
 
 from triton_dist_tpu.runtime.telemetry import Telemetry, \
     trace_env_enabled
+from triton_dist_tpu.models.structured import NO_FORCED, \
+    constrained_draft, window_masks
 
 
 @dataclasses.dataclass
@@ -190,6 +214,7 @@ class ResumeState:
     t0: Optional[int] = None       # pending spec-mode seed token
     emitted: int = 0               # tokens already streamed pre-preempt
     preemptions: int = 1           # times this request was displaced
+    gstate: Optional[int] = None   # grammar automaton state (constrained)
 
 
 @dataclasses.dataclass
@@ -204,7 +229,15 @@ class Request:
     lifecycle latencies then land in per-class histograms and the
     request is judged into slo_goodput / slo_violations at its final
     transition. resume: set internally by preemption — callers never
-    construct it."""
+    construct it.
+
+    n > 1 requests PARALLEL SAMPLING (models/structured.py + the
+    PagedDecodeSlots.fork KV fork): one prefill, n decode streams with
+    seeds seed..seed+n-1, each streaming under rid (rid, k) — bitwise
+    identical to n sequential same-seed requests. grammar: an optional
+    structured.GrammarSpec; every emitted token is then masked to the
+    grammar's legal set inside the tick programs and the stream
+    finishes when the grammar completes."""
     rid: object                    # caller's id (any hashable)
     ids: np.ndarray                # prompt token ids [S]
     gen_len: int
@@ -212,6 +245,8 @@ class Request:
     deadline_ms: Optional[float] = None
     slo: Optional[str] = None
     resume: Optional[ResumeState] = None
+    n: int = 1                     # parallel samples (KV fork fan-out)
+    grammar: object = None         # structured.GrammarSpec (optional)
 
 
 class _TokenLog:
@@ -377,6 +412,34 @@ class DecodeSlots:
         self._pf_ids: List[Optional[np.ndarray]] = [None] * batch
         self._pf_off = np.zeros((batch,), np.int64)
         self.prefill_forwarded = 0
+        # grammar-constrained decoding (models/structured.py): one live
+        # host automaton per constrained slot, advanced per emitted
+        # token; its allowed-token row rides the tick programs' mask
+        # operand (engine.slot_* mask threading) so greedy AND sampled
+        # decode select only grammar-legal tokens in-program. on_armed:
+        # scheduler hook fired the instant a slot arms
+        # (ContinuousScheduler wires its fork fan-out here).
+        self._vocab_size = V
+        self._grammar: List[Optional[object]] = [None] * batch
+        self.on_armed = None
+        # slot -> error message for a stream whose automaton hit a dead
+        # end (no legal continuation): the scheduler reports the rid's
+        # failure loudly instead of emitting garbage
+        self.grammar_dead: Dict[int, str] = {}
+        # jump-ahead accounting: the verify-window index the
+        # GrammarDrafter's forced segment starts at (NO_FORCED = none)
+        self._forced_from = np.full((batch,), NO_FORCED, np.int64)
+        self._grammar_steps = 0
+        greg = self.tele.registry
+        self._c_mask_tokens = greg.counter(
+            "grammar_mask_tokens",
+            "tokens emitted under a grammar mask")
+        self._c_jump = greg.counter(
+            "jump_ahead_tokens",
+            "grammar-forced draft tokens accepted past the base draft")
+        self._g_constrained = greg.gauge(
+            "constrained_tokens_per_step",
+            "grammar-masked tokens emitted per constrained slot-step")
         # overlap scheduling (module docstring): the pipeline register
         # holding one dispatched-but-unlanded tick, and the cumulative
         # time spent BLOCKED on device readbacks (every blocking fetch
@@ -520,6 +583,17 @@ class DecodeSlots:
         extra key split the unpreempted chain never spent)."""
         import jax
         rs = req.resume
+        g = getattr(req, "grammar", None)
+        if g is not None:
+            gs = g.fresh()
+            if rs is not None and rs.gstate is not None:
+                # resumed constrained stream: the automaton continues
+                # from the preemption snapshot (the generated suffix is
+                # already consumed — re-walking it would double-count)
+                gs.state = int(rs.gstate)
+            self._grammar[slot] = gs
+        else:
+            self._grammar[slot] = None
         self.logits = self.logits.at[slot].set(row_logits)
         self.pos = self.pos.at[slot].set(n)
         self.active = self.active.at[slot].set(True)
@@ -544,10 +618,19 @@ class DecodeSlots:
                 # arming readbacks ride _fetch so their device wait is
                 # not misattributed as host time (host_ms_per_poll)
                 (row,) = self._fetch((row_logits,), land=False)
+                row = np.asarray(row)
+                if self._grammar[slot] is not None:
+                    # the seed obeys the grammar too (host-side masked
+                    # argmax — same selection the tick programs make)
+                    row = np.where(self._grammar[slot].allowed_row(),
+                                   row, -np.inf)
                 self._t0[slot] = int(np.argmax(row))
             else:
+                gmask = (self._grammar[slot].allowed_row()
+                         if self._grammar[slot] is not None else None)
                 t0, k2 = self.engine.spec_seed(row_logits,
-                                               self.keys[slot])
+                                               self.keys[slot],
+                                               mask=gmask)
                 self.keys = self.keys.at[slot].set(k2)
                 (t0,) = self._fetch((t0,), land=False)
                 self._t0[slot] = int(t0)
@@ -643,6 +726,9 @@ class DecodeSlots:
         self.reqs[slot] = None
         self._pf_ids[slot] = None
         self._pf_off[slot] = 0
+        self._grammar[slot] = None
+        self.grammar_dead.pop(slot, None)
+        self._forced_from[slot] = NO_FORCED
         if self.spec:
             self._hist[slot] = _TokenLog()
 
@@ -712,6 +798,82 @@ class DecodeSlots:
             float(self._moe_tokens_cum.max() / mean) if mean > 0
             else 0.0)
 
+    # ------------------------------------------------------------------
+    # grammar-constrained decoding (models/structured.py)
+    # ------------------------------------------------------------------
+
+    def _grammar_live(self) -> bool:
+        return any(self._grammar[b] is not None
+                   for b in self.decode_slots)
+
+    def _mask_chunk(self) -> Optional[np.ndarray]:
+        """[B, V] allowed-token mask for one decode tick, or None when
+        no armed slot is constrained — None keeps the tick on the
+        mask-free jit entry (zero new XLA programs per unconstrained
+        poll, the churn-guard contract)."""
+        if not self._grammar_live():
+            return None
+        mask = np.ones((self.batch, self._vocab_size), bool)
+        for b in self.decode_slots:
+            g = self._grammar[b]
+            if g is not None:
+                row = g.allowed_row()
+                if row.any():
+                    mask[b] = row
+        return mask
+
+    def _mask_window(self, tokens, q_lens) -> Optional[np.ndarray]:
+        """[B, S, V] per-position verify-window mask (spec mode), or
+        None when no armed slot is constrained. Position j of a row
+        constrains the prediction AFTER tokens[b, :j+1]
+        (structured.window_masks has the safety argument for the
+        all-True rows past a walk break)."""
+        if not self._grammar_live():
+            return None
+        S = tokens.shape[1]
+        mask = np.ones((self.batch, S, self._vocab_size), bool)
+        for b in self.decode_slots:
+            g = self._grammar[b]
+            if g is not None:
+                mask[b] = window_masks(g, tokens[b], int(q_lens[b]))
+        return mask
+
+    def _grammar_advance(self, b: int, kept) -> None:
+        """Advance slot b's automaton over its just-emitted tokens; a
+        completed grammar (is_final) finishes the stream early, a dead
+        end flags grammar_dead[b] for the scheduler's loud per-request
+        error."""
+        g = self._grammar[b]
+        for t in np.asarray(kept).reshape(-1):
+            ok = g.advance(int(t))
+            self._c_mask_tokens.inc()
+            if not ok or g.is_dead:
+                self.grammar_dead[b] = (
+                    f"grammar dead end after "
+                    f"{self.emitted_since_admit(b)} tokens: no legal "
+                    f"continuation from the automaton state")
+                self.remaining[b] = 0
+                break
+            if g.is_final:
+                self.remaining[b] = 0
+                break
+        self._grammar_steps += 1
+
+    def _finish_grammar(self, out: Dict[int, np.ndarray],
+                        finished: List[Tuple[int, object]]) -> None:
+        """Post-tick automaton advance for the deterministic (non-spec)
+        paths: walk each constrained slot's emitted tokens and finish
+        the stream when its grammar completes (or dies) — the budget
+        zeroing in _grammar_advance is what ends it early."""
+        fin = {b for b, _ in finished}
+        for b, kept in out.items():
+            if self._grammar[b] is None or not len(kept):
+                continue
+            self._grammar_advance(b, kept)
+            if self.remaining[b] == 0 and b not in fin:
+                finished.append((b, self.rids[b]))
+                fin.add(b)
+
     def _run_chunk(self, chunk: int):
         """Engine-call hook: DISPATCH one chunk of the slot scan (paged
         variant swaps in paged_slot_chunk). Returns the tick's token
@@ -720,7 +882,8 @@ class DecodeSlots:
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_chunk(self.logits, self.cache, self.pos,
                                    self.active, chunk=chunk,
-                                   keys=self.keys)
+                                   keys=self.keys,
+                                   mask=self._mask_chunk())
         return toks
 
     def _record(self, slot: int, toks) -> None:
@@ -732,9 +895,9 @@ class DecodeSlots:
         variant swaps in paged_slot_verify_chunk). Returns device
         (n_emit, t0_next) — landed via _fetch."""
         n_emit, t0n, self.cache, self.pos, self.keys = \
-            self.engine.slot_verify_chunk(self.cache, self.pos,
-                                          self.active, tokens, q_lens,
-                                          keys=self.keys)
+            self.engine.slot_verify_chunk(
+                self.cache, self.pos, self.active, tokens, q_lens,
+                keys=self.keys, mask=self._mask_window(tokens, q_lens))
         return n_emit, t0n
 
     def _draft_into(self, tokens: np.ndarray, q_lens: np.ndarray,
@@ -744,6 +907,7 @@ class DecodeSlots:
         remaining - 1, so a slot never writes past its budget). Shared
         by the pure-spec step and the mixed prefill+decode tick."""
         tokens[b, 0] = self._t0[b]
+        self._forced_from[b] = NO_FORCED
         kmax = min(self.spec, int(self.remaining[b]) - 1)
         if kmax > 0:
             # append the pending seed for the lookup, then undo — the
@@ -765,6 +929,16 @@ class DecodeSlots:
                 d = []
             finally:
                 h.pop()
+            g = self._grammar[b]
+            if g is not None:
+                # grammar stacking: the foreign draft is cut at its
+                # first grammar-illegal token, then the window extends
+                # with the automaton's FORCED run — jump-ahead: under
+                # the mask a forced token is the ONLY legal token at
+                # its position, so masked verification accepts the
+                # whole deterministic segment in one forward
+                d, self._forced_from[b] = constrained_draft(
+                    g, int(self._t0[b]), d, kmax)
         else:
             d = []
         tokens[b, 1:1 + len(d)] = d
@@ -778,6 +952,29 @@ class DecodeSlots:
         the remaining budget, thread counters/history, stage the next
         seed token."""
         keep = int(min(self.remaining[b], n_emit[b]))
+        g = self._grammar[b]
+        if keep and g is not None:
+            # walk the REAL automaton over the accepted window: the
+            # stream keeps tokens up to a grammar completion (or dead
+            # end), and forced tokens kept past the base draft count
+            # as jump-ahead wins
+            keep2 = 0
+            for t in tokens[b, :keep]:
+                ok = g.advance(int(t))
+                self._c_mask_tokens.inc()
+                if not ok or g.is_dead:
+                    self.grammar_dead[b] = (
+                        "grammar dead end: no legal continuation "
+                        "from the automaton state")
+                    break
+                keep2 += 1
+                if g.is_final:
+                    break
+            self._c_jump.inc(max(0, keep2 - int(self._forced_from[b])))
+            self._grammar_steps += 1
+            keep = keep2
+            if b in self.grammar_dead or g.is_final:
+                self.remaining[b] = min(self.remaining[b], keep)
         if keep:
             kept = tokens[b, :keep].copy()
             out[b] = kept
@@ -827,13 +1024,26 @@ class DecodeSlots:
         drafts — survives slot reuse, consistent with spec_emitted /
         spec_steps), tokens emitted per slot per verify forward (1.0 =
         no speculation win, K+1 = every draft accepted), and the
-        per-slot counter arrays for the CURRENT occupants."""
+        per-slot counter arrays for the CURRENT occupants. Grammar
+        runs additionally report the constrained-decoding counters
+        (grammar_mask_tokens / jump_ahead_tokens /
+        constrained_tokens_per_step)."""
+        out: dict = {}
+        if self._grammar_steps:
+            per_step = (self._c_mask_tokens.value
+                        / self._grammar_steps)
+            self._g_constrained.set(round(per_step, 3))
+            out.update({
+                "grammar_mask_tokens": self._c_mask_tokens.value,
+                "jump_ahead_tokens": self._c_jump.value,
+                "constrained_tokens_per_step": round(per_step, 3),
+            })
         if not self.spec:
-            return {}
+            return out
         drafted = self._spec_drafted_total.value
         accepted = self._spec_accepted_total.value
         slot_steps = self._spec_slot_steps.value
-        return {
+        out.update({
             "spec": self.spec,
             "spec_steps": self._spec_steps.value,
             "spec_drafted": drafted,
@@ -845,7 +1055,8 @@ class DecodeSlots:
             "spec_accepted_per_slot": self._spec_accepted.tolist(),
             "spec_drafted_per_slot": self._spec_drafted.tolist(),
             "drafter_errors": self._drafter_errors.value,
-        }
+        })
+        return out
 
     def step_chunk(self, chunk: int) -> Tuple[Dict[int, np.ndarray],
                                               List[Tuple[int, object]]]:
@@ -867,6 +1078,7 @@ class DecodeSlots:
         for b, _, keep in plan:
             out[b] = toks[b, :keep]
             self._record(b, toks[b, :keep])
+        self._finish_grammar(out, finished)
         return out, finished
 
     def _plan_chunk(self, chunk: int, skip=frozenset()
@@ -903,7 +1115,8 @@ class DecodeSlots:
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_mixed_chunk(
                 self.logits, self.cache, self.pos, self.active, pf,
-                tokens, q_lens, keys=self.keys)
+                tokens, q_lens, keys=self.keys,
+                mask=self._mask_chunk())
         return toks
 
     def _run_mixed_verify(self, tokens, q_lens, pf):
@@ -914,7 +1127,7 @@ class DecodeSlots:
         n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
             self.engine.slot_mixed_verify_chunk(
                 self.cache, self.pos, self.active, pf, tokens, q_lens,
-                keys=self.keys)
+                keys=self.keys, mask=self._mask_window(tokens, q_lens))
         return n_emit, t0n
 
     def _pf_record(self, slot: int, toks) -> None:
@@ -967,6 +1180,7 @@ class DecodeSlots:
                 kept = toks[b:b + 1].copy()
                 out[b] = kept
                 self._record(b, kept)
+            self._finish_grammar(out, finished)
         # advance the prefills; arm the ones whose final chunk landed
         self._advance_prefills(chunks)
         return out, finished
@@ -1032,6 +1246,8 @@ class DecodeSlots:
                 else:
                     self._arm_slot(b, req, self.logits[b], len(ids))
                     self._pf_armed(b)
+                    if self.on_armed is not None:
+                        self.on_armed(b)
 
     # ------------------------------------------------------------------
     # overlap scheduling: the dispatch/land split (module docstring).
@@ -1164,6 +1380,8 @@ class DecodeSlots:
             for b, req, n in inf.arm:
                 self._arm_slot(b, req, self.logits[b], n)
                 self._pf_armed(b)
+                if self.on_armed is not None:
+                    self.on_armed(b)
         return out, finished
 
 
@@ -1234,6 +1452,18 @@ class PagedDecodeSlots(DecodeSlots):
         self._groups: List[List[np.ndarray]] = [[] for _ in range(batch)]
         self._tokens: List[_TokenLog] = [_TokenLog()
                                          for _ in range(batch)]
+        # KV fork (parallel sampling — fork() below): per-slot flag
+        # backing the forks_active gauge, plus the sharing counters
+        self._is_fork = np.zeros((batch,), bool)
+        freg = self.tele.registry
+        self._c_fork_shared = freg.counter(
+            "fork_shared_pages",
+            "pages mapped shared (refcount+1) by slot forks")
+        self._c_fork_cow = freg.counter(
+            "fork_cow_breaks",
+            "boundary pages copy-on-written at fork time")
+        self._g_forks = freg.gauge(
+            "forks_active", "live forked decode slots")
 
     def _make_cache(self):
         return self.engine.make_paged_slot_cache(
@@ -1290,7 +1520,12 @@ class PagedDecodeSlots(DecodeSlots):
 
     @property
     def stats(self) -> dict:
-        out = dict(DecodeSlots.stats.fget(self))   # spec counters
+        out = dict(DecodeSlots.stats.fget(self))   # spec + grammar
+        nf = int(self._is_fork.sum())
+        self._g_forks.set(nf)
+        out["forks_active"] = nf
+        out["fork_shared_pages"] = self._c_fork_shared.value
+        out["fork_cow_breaks"] = self._c_fork_cow.value
         out.update(self.prefix.stats())
         return out
 
@@ -1425,6 +1660,80 @@ class PagedDecodeSlots(DecodeSlots):
         self.prefix.record(n, m)
         self._park_prefilling(slot, req, tokens, m)
 
+    def fork(self, parent: int, slot: int, req: Request) -> None:
+        """Clone slot `parent`'s sequence into free slot `slot` — the
+        KV fork of parallel sampling (PagedAttention's headline
+        physical-sharing case): every FULL page of the parent's current
+        sequence maps SHARED (refcount+1; read-only for both sides by
+        the write-exclusivity rule tools/tdcheck proves), the partially
+        filled boundary page copy-on-writes through the same engine
+        path a prefix-cache hit uses, and the fork arms from the
+        parent's carry logits with its OWN PRNG key (req.seed) —
+        bitwise identical to admitting `req` as a fresh request whose
+        prompt fully hits the prefix cache. Fork at ARMING, before the
+        parent diverges: both streams then match their sequential
+        same-seed replays. After this call the fork is an ordinary
+        slot — cancel/preempt/retire/eviction need no special cases
+        (retire's tree insert dedups against the parent's pages)."""
+        assert self.rids[slot] is None, f"slot {slot} is occupied"
+        assert self.rids[parent] is not None \
+            and self._pf_ids[parent] is None, \
+            f"fork parent {parent} must be an ARMED slot"
+        pool = self.prefix.pool
+        parent_groups = self._groups[parent]
+        # own copy: the parent's log keeps growing under the fork
+        tokens = self._tokens[parent].view().copy()
+        L = len(tokens)
+        self.validate_admission(req, tokens)
+        full, r = L // self.page, L % self.page
+        total = -(-(L + req.gen_len + self.margin - 1) // self.page)
+        retained: List[np.ndarray] = []
+        fresh: List[np.ndarray] = []
+        try:
+            # pin the shared prefix (and the boundary the CoW reads)
+            # BEFORE eviction can run for the fresh allocations
+            for g in parent_groups[:full]:
+                pool.retain(g)
+                retained.append(g)
+            boundary = parent_groups[full] if r else None
+            if boundary is not None:
+                pool.retain(boundary)
+                retained.append(boundary)
+            need = total - full
+            if not self.prefix.ensure_pages(need * pool.n_kv_heads):
+                from triton_dist_tpu.models.prefix_cache import \
+                    PoolExhausted
+                raise PoolExhausted(
+                    f"request {req.rid!r}: page pool exhausted at "
+                    f"fork ({need} fresh groups needed, "
+                    f"{pool.available} pages free, nothing evictable)")
+            fresh = [pool.alloc_group() for _ in range(need)]
+        except ValueError:
+            for g in fresh + retained:
+                pool.release(g)
+            raise
+        slot_groups = list(parent_groups[:full]) + fresh
+        Hkv, maxp = pool.n_kv_heads, self.cache.table.shape[1]
+        rows = np.full((Hkv, maxp), self.cache.trash, np.int32)
+        for j, g in enumerate(slot_groups):
+            rows[:, j] = g
+        trash_vec = np.full((Hkv,), self.cache.trash, np.int32)
+        cow_src = boundary if r else trash_vec
+        cow_dst = fresh[0] if r else trash_vec
+        self.cache = self.engine.install_slot_paged(
+            self.cache, slot, rows, cow_src, cow_dst, r)
+        if boundary is not None:
+            # only the CoW copy read it; the fork maps its own copy
+            pool.release(boundary)
+        self._arm_slot(slot, req, self.logits[parent], L)
+        self._groups[slot] = slot_groups
+        self._tokens[slot] = _TokenLog(tokens)
+        self.prefix.record(L, L)      # the whole prefill was skipped
+        self._is_fork[slot] = True
+        self._c_fork_shared.inc(full * Hkv)
+        if r:
+            self._c_fork_cow.inc()
+
     def preempt(self, slot: int) -> Request:
         """Evict a LIVE slot under pool pressure (vLLM-style recompute
         preemption) and return the request to re-queue. The snapshot is
@@ -1466,7 +1775,9 @@ class PagedDecodeSlots(DecodeSlots):
             key=self.keys[slot] if self.keys is not None else None,
             t0=int(self._t0[slot]) if self.spec else None,
             emitted=self.emitted(slot),
-            preemptions=(rs.preemptions + 1) if rs is not None else 1)
+            preemptions=(rs.preemptions + 1) if rs is not None else 1,
+            gstate=(self._grammar[slot].state
+                    if self._grammar[slot] is not None else None))
         self.retire(slot)      # tree insert + ref release + trash rows
         return dataclasses.replace(req, ids=toks, gen_len=remaining,
                                    resume=snap)
@@ -1486,34 +1797,37 @@ class PagedDecodeSlots(DecodeSlots):
         self.cache = self.engine.retire_slot_paged(self.cache, slot)
         self._groups[slot] = []
         self._tokens[slot] = _TokenLog()
+        self._is_fork[slot] = False
         super().retire(slot)
 
     def _run_chunk(self, chunk: int):
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_chunk(self.logits, self.cache,
                                          self.pos, self.active,
-                                         chunk=chunk, keys=self.keys)
+                                         chunk=chunk, keys=self.keys,
+                                         mask=self._mask_chunk())
         return toks
 
     def _run_verify(self, tokens, q_lens):
         n_emit, t0n, self.cache, self.pos, self.keys = \
-            self.engine.paged_slot_verify_chunk(self.cache, self.pos,
-                                                self.active, tokens,
-                                                q_lens, keys=self.keys)
+            self.engine.paged_slot_verify_chunk(
+                self.cache, self.pos, self.active, tokens, q_lens,
+                keys=self.keys, mask=self._mask_window(tokens, q_lens))
         return n_emit, t0n
 
     def _run_mixed(self, tokens, q_lens, pf):
         toks, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_mixed_chunk(
                 self.logits, self.cache, self.pos, self.active, pf,
-                tokens, q_lens, keys=self.keys)
+                tokens, q_lens, keys=self.keys,
+                mask=self._mask_chunk())
         return toks
 
     def _run_mixed_verify(self, tokens, q_lens, pf):
         n_emit, t0n, self.logits, self.cache, self.pos, self.keys = \
             self.engine.paged_slot_mixed_verify_chunk(
                 self.cache, self.pos, self.active, pf, tokens, q_lens,
-                keys=self.keys)
+                keys=self.keys, mask=self._mask_window(tokens, q_lens))
         return n_emit, t0n
 
     def _record(self, slot: int, toks) -> None:
@@ -1665,6 +1979,13 @@ class ContinuousScheduler:
             self.slots = DecodeSlots(engine, batch, spec=spec,
                                      drafter=drafter,
                                      telemetry=self.tele)
+        # KV-fork fan-out (Request.n > 1): siblings of an n>1 parent
+        # wait here (keyed by the parent child-0 rid) and fork the
+        # parent's pages the instant it arms — the on_armed hook
+        # covers the chunked-prefill arming sites; the monolithic
+        # admit path calls _spawn_forks directly
+        self.slots.on_armed = self._spawn_forks
+        self._pending_forks: Dict[object, List[Request]] = {}
         self.chunk = chunk
         self.prefill_budget = prefill_budget
         # the stall bound the chunking buys: the most prefill tokens
@@ -1821,7 +2142,20 @@ class ContinuousScheduler:
                     del self._queue[i]
                     self._deadline.pop(rid, None)
                     self.tele.retire(rid, "cancelled")
+                    # a cancelled fork parent orphans its waiting
+                    # siblings: they queue as ordinary admissions
+                    # (prefix cache keeps their streams identical)
+                    for kid in self._pending_forks.pop(rid, ()):
+                        self._queue.append(kid)
                     return True
+            # a fork sibling still waiting on its parent's arming
+            for kids in self._pending_forks.values():
+                for i, kid in enumerate(kids):
+                    if kid.rid == rid:
+                        del kids[i]
+                        self._deadline.pop(rid, None)
+                        self.tele.retire(rid, "cancelled")
+                        return True
         if self.overlap and not self._pipeline_idle() \
                 and any(self.slots.rids[b] == rid
                         for b in self.slots.occupied):
@@ -1835,6 +2169,10 @@ class ContinuousScheduler:
                 self.slots.retire(b)
                 with self._lock:
                     self._deadline.pop(rid, None)
+                    # parent cancelled mid-prefill: its waiting
+                    # siblings re-queue as ordinary admissions
+                    for kid in self._pending_forks.pop(rid, ()):
+                        self._queue.append(kid)
                 self.tele.retire(rid, "cancelled")
                 return True
         return False
@@ -1954,6 +2292,116 @@ class ContinuousScheduler:
         return (not self._queue and not self.slots.occupied
                 and not self._carry_out and not self._carry_done)
 
+    def _eff_chunk(self) -> int:
+        """Decode chunk for the next tick: a grammar mask is a
+        per-step scan constant (engine.slot_chunk contract), so any
+        live constrained slot drops the tick to single-step;
+        unconstrained polls keep the configured chunk."""
+        slots = self.slots
+        if any(slots._grammar[b] is not None
+               for b in slots.decode_slots):
+            return 1
+        return self.chunk
+
+    def _grammar_sync_needed(self) -> bool:
+        """overlap=True cannot dispatch-ahead a spec=0 grammar tick:
+        the next tick's mask depends on the token the unlanded tick
+        emits. spec=K grammar polls land in-poll already (begin_spec)
+        and stay on the overlap path."""
+        if self.slots.spec:
+            return False
+        slots = self.slots
+        if any(slots.reqs[b] is not None
+               and getattr(slots.reqs[b], "grammar", None) is not None
+               for b in range(slots.batch)):
+            return True
+        with self._lock:
+            return any(getattr(r, "grammar", None) is not None
+                       for r in self._queue)
+
+    def _fan_out(self, req: Request) -> Request:
+        """Validate the structured-generation fields of the admission
+        at the queue head and split an n>1 request into n same-prompt
+        children: child 0 prefills normally; children 1..n-1 wait in
+        _pending_forks and FORK the armed slot's pages (one prefill, n
+        decode streams). Child k streams under rid (rid, k) with seed
+        seed+k — bitwise identical to n sequential same-seed requests
+        (the fork maps exactly the pages a sequential admission's
+        prefix-cache hit would). Raises ValueError (the caller's
+        reject path) on invalid n or an unsupported combination."""
+        n = int(getattr(req, "n", 1) or 1)
+        g = getattr(req, "grammar", None)
+        if n < 1:
+            raise ValueError(
+                f"request {req.rid!r}: n must be >= 1, got {n}")
+        if g is not None:
+            if getattr(self.slots.engine, "backend", None) == "mega":
+                raise ValueError(
+                    f"request {req.rid!r}: backend='mega' fuses the "
+                    f"greedy paged tick with an in-kernel argmax and "
+                    f"takes no grammar mask operand; serve constrained "
+                    f"requests on the per-op backends")
+            if g.vocab_size != self.slots._vocab_size:
+                raise ValueError(
+                    f"request {req.rid!r}: grammar compiled for vocab "
+                    f"{g.vocab_size}, engine vocab is "
+                    f"{self.slots._vocab_size}")
+        if n == 1:
+            return req
+        if not hasattr(self.slots, "fork"):
+            raise ValueError(
+                f"request {req.rid!r}: n={n} parallel sampling needs "
+                f"the paged KV pool (ContinuousScheduler(paged=True)) "
+                f"— contiguous slots cannot share prefix pages")
+        if n > self.slots.batch:
+            raise ValueError(
+                f"request {req.rid!r}: n={n} exceeds the slot batch "
+                f"{self.slots.batch}")
+        kids = [dataclasses.replace(req, rid=(req.rid, k),
+                                    seed=req.seed + k, n=1)
+                for k in range(n)]
+        dl = self._deadline.pop(req.rid, None)
+        for kid in kids:
+            self.tele.queued(kid.rid, slo=kid.slo)
+            if dl is not None:
+                self._deadline[kid.rid] = dl
+        # the parent rid's lifecycle record closes here — the client
+        # streams under the (rid, k) children from now on
+        self.tele.retire(req.rid, "forked")
+        self._queue[0] = kids[0]
+        self._pending_forks[kids[0].rid] = kids[1:]
+        return kids[0]
+
+    def _spawn_forks(self, slot: int) -> None:
+        """on_armed hook: the instant an n>1 parent (child 0) arms,
+        fork its pages into free slots for the waiting siblings. A
+        sibling that cannot fork NOW (no free slot / pool exhausted)
+        falls back to the FRONT of the queue as an ordinary admission
+        — the parent's prompt pages are in the prefix tree, so it
+        still skips the shared prefill (same streams, degraded
+        sharing)."""
+        rid = self.slots.rids[slot]
+        kids = self._pending_forks.pop(rid, None)
+        if not kids:
+            return
+        from triton_dist_tpu.models.prefix_cache import PoolExhausted
+        overflow: List[Request] = []
+        for i, kid in enumerate(kids):
+            free = self.slots.free
+            if not free:
+                overflow = kids[i:]
+                break
+            try:
+                self.slots.fork(slot, free[0], kid)
+                self.tele.req_event(kid.rid, "admitted", free[0])
+            except (PoolExhausted, ValueError):
+                overflow = kids[i:]
+                break
+        if overflow:
+            with self._lock:
+                for kid in reversed(overflow):
+                    self._queue.appendleft(kid)
+
     def _reject(self, rid, reason: str,
                 status: str = "rejected") -> None:
         import sys
@@ -1998,6 +2446,11 @@ class ContinuousScheduler:
                                   f"expired before admission")
                     self._reject(r.rid, reason, status="expired")
                     done.append(r.rid)
+                    # siblings of an expired fork parent re-queue as
+                    # ordinary admissions (their own copied deadlines
+                    # expire them on the next pass)
+                    for kid in self._pending_forks.pop(r.rid, ()):
+                        keep.append(kid)
                 else:
                     keep.append(r)
             self._queue = keep
@@ -2012,6 +2465,8 @@ class ContinuousScheduler:
                                   f"exceeded after {emitted} tokens",
                              status="expired")
                 done.append(rid)
+                for kid in self._pending_forks.pop(rid, ()):
+                    self._queue.append(kid)
 
     def _eligible_victims(self) -> List[int]:
         """Slots that may be preempted: they emitted at least one token
@@ -2140,6 +2595,7 @@ class ContinuousScheduler:
             try:
                 if self.fault is not None:
                     self.fault.admission(req)
+                req = self._fan_out(req)
                 if self.prefill_budget is not None:
                     self.slots.admit_chunked(free[0], req)
                 else:
@@ -2149,6 +2605,10 @@ class ContinuousScheduler:
                     req.rid,
                     "resume" if req.resume is not None else "admitted",
                     free[0])
+                if self.prefill_budget is None:
+                    # monolithic arming happened inside admit (no
+                    # on_armed site): fan the waiting siblings out now
+                    self._spawn_forks(free[0])
             except PoolExhausted as e:
                 if self.overlap and not self._pipeline_idle():
                     # land + retire first: pages still held by the
@@ -2163,6 +2623,11 @@ class ContinuousScheduler:
                     self._queue.popleft()
                     self._reject(req.rid, reason)
                     done.append(req.rid)
+                    # a hard-rejected fork parent orphans its waiting
+                    # siblings — reject them with the same reason
+                    for kid in self._pending_forks.pop(req.rid, ()):
+                        self._reject(kid.rid, reason)
+                        done.append(kid.rid)
 
                 if not self._preempt_for(req.rid, preempted_now,
                                          str(e), drop=_drop):
@@ -2171,6 +2636,9 @@ class ContinuousScheduler:
                 self._queue.popleft()
                 self._reject(req.rid, str(e))
                 done.append(req.rid)
+                for kid in self._pending_forks.pop(req.rid, ()):
+                    self._reject(kid.rid, str(e))
+                    done.append(kid.rid)
 
     def poll(self) -> Tuple[Dict[object, np.ndarray], List[object]]:
         """One scheduling iteration: expire deadlines, refill free
@@ -2195,6 +2663,26 @@ class ContinuousScheduler:
         histograms."""
         with self.tele.poll_span():
             if self.overlap:
+                if self._grammar_sync_needed():
+                    # spec=0 grammar ticks cannot dispatch-ahead (the
+                    # next mask needs the unlanded token): collapse
+                    # the pipeline and take the sync iteration —
+                    # unconstrained polls return to overlap untouched
+                    if not self._pipeline_idle():
+                        self._drain(self._carry_out, self._carry_done)
+                    carry_out, carry_done = \
+                        self._carry_out, self._carry_done
+                    self._carry_out, self._carry_done = {}, []
+                    out, done = self._poll_sync()
+                    for rid, t in carry_out.items():
+                        if len(t):
+                            self.tele.emit(rid, len(t))
+                            self._c_tokens.inc(len(t))
+                    for rid in carry_done:
+                        self.tele.retire(rid)
+                    for rid, t in out.items():
+                        _merge_out(carry_out, rid, t)
+                    return carry_out, carry_done + done
                 return self._poll_overlap()
             return self._poll_sync()
 
@@ -2231,8 +2719,9 @@ class ContinuousScheduler:
             label = (f"scheduler mixed tick "
                      f"(prefill_budget={self.prefill_budget})")
         else:
-            step = lambda: self.slots.step_chunk(self.chunk)
-            label = f"scheduler chunk (chunk={self.chunk})"
+            ec = self._eff_chunk()
+            step = lambda: self.slots.step_chunk(ec)
+            label = f"scheduler chunk (chunk={ec})"
         self._mark_dispatch()
         with self.tele.phase("step"):
             if self.watchdog_s is not None:
@@ -2262,7 +2751,13 @@ class ContinuousScheduler:
                 self.tele.emit(rid, len(toks))
                 self._c_tokens.inc(len(toks))
         with self.tele.phase("retire"):
+            dead = self.slots.grammar_dead
             for b, rid in finished:
+                msg = dead.pop(b, None)
+                if msg is not None:
+                    # dead-end automaton: the stream ends LOUDLY — the
+                    # serving layer pops the reason off self.rejected
+                    self._reject(rid, msg)
                 self.slots.retire(b)
                 with self._lock:
                     self._deadline.pop(rid, None)
@@ -2342,8 +2837,12 @@ class ContinuousScheduler:
             rid_of = slots.rids
             for b, t in out.items():
                 _merge_out(out_acc, rid_of[b], t)
+            dead = slots.grammar_dead
             with self._lock:
                 for b, rid in finished:
+                    msg = dead.pop(b, None)
+                    if msg is not None:
+                        self._reject(rid, msg)
                     self._deadline.pop(rid, None)
                     done.append(rid)
             self._staged.extend(finished)
@@ -2411,5 +2910,7 @@ class ContinuousScheduler:
         while not self.idle:
             out, _ = self.poll()
             for rid, toks in out.items():
-                acc[rid].extend(toks.tolist())
+                # setdefault: an n>1 request streams under its (rid, k)
+                # fork children, not the submitted rid
+                acc.setdefault(rid, []).extend(toks.tolist())
         return {rid: np.asarray(t, np.int64) for rid, t in acc.items()}

@@ -39,6 +39,20 @@ Acceptance:
   makes the emitted marginal EXACTLY p at every position regardless of
   draft quality (tests/test_spec_decode.py checks the marginal).
 
+Grammar jump-ahead (models/structured.py, ISSUE 17) rides this module
+unchanged: a constrained slot's deterministic automaton continuation
+(closing braces, literal JSON keys) becomes its draft window —
+`structured.constrained_draft` filters any base drafter's proposal at
+the first grammar-illegal token and extends with the forced run, and
+`structured.GrammarDrafter` wraps the same walk behind the `Drafter`
+protocol below for schedulers that compose drafters externally. The
+verify forward scores those windows through the exact programs above
+(with per-position grammar masks on the verify logits,
+`structured.window_masks`), so constrained streams under spec=K stay
+bitwise identical to spec=0 while the forced segments land several
+tokens per forward (`jump_ahead_tokens` counter;
+tests/test_structured.py).
+
 Rollback is positional: the verify wrote KV for every window row, but a
 rejected suffix just stays as dead rows past the slot's rewound length
 — never attended (per-slot kv_lens masks) and overwritten by the next

@@ -1,0 +1,552 @@
+"""Structured generation: grammar-constrained decoding for the slot
+serving stack (models/scheduler.py) + the jump-ahead drafter.
+
+The FSM approach of Outlines (Willard & Louf, 2307.09702 — PAPERS.md):
+a grammar compiles ONCE against the tokenizer vocabulary into a dense
+token-level automaton — per-state boolean masks over token ids plus a
+transition table — and decoding then costs one host-side state advance
+per emitted token plus one boolean mask riding the existing sampling
+operands into the slot programs (engine.py `slot_*`/`paged_slot_*`
+mask threading). No per-step vocabulary scan, no new host round trips,
+no new XLA program per poll: masked greedy is argmax over
+`where(mask, logits, -inf)` inside the same jitted tick.
+
+Two compilation fronts:
+
+- ``GrammarSpec.from_token_fsm``: a caller-provided token-level FSM
+  (states x vocab edges) — the wire format TokenServer accepts as
+  ``{"type": "token_fsm", ...}``.
+- ``GrammarSpec.from_json_schema``: a restricted JSON-schema subset
+  (fixed-key objects in ``properties`` order with compact separators,
+  bounded strings/integers, booleans, enums) compiled character-level:
+  schema -> Thompson epsilon-NFA -> subset-construction DFA -> token
+  LIFTING (walk every vocab string through the DFA — multi-character
+  tokens resolve to multi-step DFA walks, so the same compiler serves
+  byte tokenizers and BPE vocabs). Every DFA state can reach
+  acceptance by construction (all combinators here are bounded), so a
+  masked decode can never paint itself into a dead end — the dead-end
+  case exists only for adversarial hand-built FSMs, and the scheduler
+  turns it into a loud per-request error (runtime/chaos.py
+  ``dead_end_grammar`` pins that path).
+
+Jump-ahead (SGLang, 2312.07104): wherever the automaton's continuation
+is DETERMINISTIC (closing braces, fixed object keys, enum literals,
+``true``/``false``), ``constrained_draft``/``GrammarDrafter`` emit the
+whole forced segment as a speculative draft window verified through
+the existing ``slot_verify_chunk`` path — under a grammar mask the
+forced token is the ONLY legal token at its position, so masked-greedy
+verification accepts the entire segment unconditionally and
+constrained decoding becomes multi-token-per-forward instead of
+slower. ``GrammarDrafter`` implements the models/spec_decode.py
+``Drafter`` protocol; the scheduler's internal path uses
+``constrained_draft`` against the slot's LIVE automaton state instead
+(no per-step re-walk of the history).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# window index sentinel: "no forced tokens in this draft window" — any
+# index comparison against it reads as "past the window end"
+NO_FORCED = 1 << 30
+
+# JSON string payload alphabet: printable ASCII minus the two chars
+# that would need escape handling ('"' closes the string, '\' opens an
+# escape) — the restricted-subset contract, not a JSON limitation
+_STRING_CHARS = [chr(c) for c in range(32, 127) if chr(c) not in '"\\']
+_COMPACT = {"separators": (",", ":")}
+
+
+def byte_vocab(vocab_size: int) -> List[str]:
+    """The decode strings of serving.ByteTokenizer: token i is the
+    single latin-1 character chr(i % 256). The list feeds the token
+    lifting of the char-level grammar compiler."""
+    return [chr(i % 256) for i in range(int(vocab_size))]
+
+
+# ----------------------------------------------------------------------
+# char-level Thompson NFA -> DFA (the JSON-schema compilation front)
+# ----------------------------------------------------------------------
+
+
+class _Nfa:
+    """Thompson construction scratchpad: epsilon edges + labeled char
+    edges; fragments are (start, end) state pairs."""
+
+    def __init__(self):
+        self.eps: List[set] = []
+        self.step: List[Dict[str, set]] = []
+
+    def new(self) -> int:
+        self.eps.append(set())
+        self.step.append({})
+        return len(self.eps) - 1
+
+    def link(self, a: int, b: int) -> None:
+        self.eps[a].add(b)
+
+    def edge(self, a: int, ch: str, b: int) -> None:
+        self.step[a].setdefault(ch, set()).add(b)
+
+    # -- fragment combinators ------------------------------------------
+
+    def lit(self, s: str) -> Tuple[int, int]:
+        a = self.new()
+        cur = a
+        for ch in s:
+            nxt = self.new()
+            self.edge(cur, ch, nxt)
+            cur = nxt
+        return a, cur
+
+    def seq(self, frags: Sequence[Tuple[int, int]]) -> Tuple[int, int]:
+        if not frags:
+            a = self.new()
+            return a, a
+        a, e = frags[0]
+        for a2, e2 in frags[1:]:
+            self.link(e, a2)
+            e = e2
+        return a, e
+
+    def alt(self, frags: Sequence[Tuple[int, int]]) -> Tuple[int, int]:
+        a, e = self.new(), self.new()
+        for a2, e2 in frags:
+            self.link(a, a2)
+            self.link(e2, e)
+        return a, e
+
+    def charclass(self, chars: Sequence[str]) -> Tuple[int, int]:
+        a, e = self.new(), self.new()
+        for ch in chars:
+            self.edge(a, ch, e)
+        return a, e
+
+    def repeat(self, make_frag, lo: int, hi: int) -> Tuple[int, int]:
+        """lo..hi copies of a fragment (hi FINITE — boundedness is what
+        guarantees every DFA state reaches acceptance)."""
+        a, e = self.new(), self.new()
+        cur = a
+        for i in range(hi):
+            if i >= lo:
+                self.link(cur, e)
+            fa, fe = make_frag()
+            self.link(cur, fa)
+            cur = fe
+        self.link(cur, e)
+        return a, e
+
+
+def _eclose(nfa: _Nfa, states) -> frozenset:
+    stack, seen = list(states), set(states)
+    while stack:
+        s = stack.pop()
+        for t in nfa.eps[s]:
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+    return frozenset(seen)
+
+
+def _nfa_to_dfa(nfa: _Nfa, start: int, accept: int):
+    """Subset construction. Returns (trans: List[{char: state}],
+    acc: List[bool]); DFA state 0 is the start."""
+    d0 = _eclose(nfa, {start})
+    ids = {d0: 0}
+    trans: List[Dict[str, int]] = [{}]
+    acc = [accept in d0]
+    work = [d0]
+    while work:
+        cur = work.pop()
+        i = ids[cur]
+        chars = set()
+        for s in cur:
+            chars.update(nfa.step[s].keys())
+        for ch in chars:
+            nxt = set()
+            for s in cur:
+                nxt |= nfa.step[s].get(ch, set())
+            nd = _eclose(nfa, nxt)
+            if nd not in ids:
+                ids[nd] = len(trans)
+                trans.append({})
+                acc.append(accept in nd)
+                work.append(nd)
+            trans[i][ch] = ids[nd]
+    return trans, acc
+
+
+def _schema_frag(nfa: _Nfa, schema) -> Tuple[int, int]:
+    """One schema node -> one NFA fragment matching exactly the
+    compact-separator JSON serializations the schema admits."""
+    if not isinstance(schema, dict):
+        raise ValueError(
+            f"schema node must be an object, got {type(schema).__name__}")
+    if "enum" in schema:
+        lits = schema["enum"]
+        if not isinstance(lits, list) or not lits:
+            raise ValueError("enum must be a non-empty list")
+        return nfa.alt([nfa.lit(json.dumps(v, **_COMPACT))
+                        for v in lits])
+    t = schema.get("type")
+    if t == "object":
+        props = schema.get("properties", {})
+        if not isinstance(props, dict) or not props:
+            raise ValueError(
+                "object schema needs a non-empty 'properties' map "
+                "(fixed keys, emitted in properties order)")
+        frags = [nfa.lit("{")]
+        for i, (key, sub) in enumerate(props.items()):
+            if i:
+                frags.append(nfa.lit(","))
+            frags.append(nfa.lit(json.dumps(str(key)) + ":"))
+            frags.append(_schema_frag(nfa, sub))
+        frags.append(nfa.lit("}"))
+        return nfa.seq(frags)
+    if t == "string":
+        hi = int(schema.get("maxLength", 16))
+        if hi < 0:
+            raise ValueError(f"maxLength must be >= 0, got {hi}")
+        lo = int(schema.get("minLength", 0))
+        if not 0 <= lo <= hi:
+            raise ValueError(f"need 0 <= minLength <= maxLength, got "
+                             f"[{lo}, {hi}]")
+        body = nfa.repeat(lambda: nfa.charclass(_STRING_CHARS), lo, hi)
+        return nfa.seq([nfa.lit('"'), body, nfa.lit('"')])
+    if t == "integer":
+        d = int(schema.get("maxDigits", 4))
+        if d < 1:
+            raise ValueError(f"maxDigits must be >= 1, got {d}")
+        digits = [chr(ord("0") + i) for i in range(10)]
+        mag = nfa.alt([
+            nfa.lit("0"),
+            nfa.seq([nfa.charclass(digits[1:]),
+                     nfa.repeat(lambda: nfa.charclass(digits),
+                                0, d - 1)]),
+        ])
+        if int(schema.get("minimum", -1)) >= 0:
+            return mag
+        a, e = nfa.lit("-")
+        nfa.link(a, e)                 # optional sign
+        return nfa.seq([(a, e), mag])
+    if t == "boolean":
+        return nfa.alt([nfa.lit("true"), nfa.lit("false")])
+    raise ValueError(
+        f"unsupported schema node {schema!r} (supported: enum, object "
+        f"with fixed properties, string, integer, boolean)")
+
+
+def _lift(trans, acc, vocab):
+    """Token lifting: walk every vocab string through the char DFA —
+    token t is legal from state s iff the whole string survives, and
+    its target state is wherever the walk lands (multi-char tokens are
+    just multi-step walks)."""
+    n, V = len(trans), len(vocab)
+    allow = np.zeros((n, V), bool)
+    nxt = np.full((n, V), -1, np.int32)
+    for t, word in enumerate(vocab):
+        if not word:
+            continue
+        for s in range(n):
+            cur = s
+            for ch in word:
+                cur = trans[cur].get(ch, -1)
+                if cur < 0:
+                    break
+            if cur >= 0:
+                allow[s, t] = True
+                nxt[s, t] = cur
+    return allow, nxt, np.asarray(acc, bool)
+
+
+# ----------------------------------------------------------------------
+# the compiled grammar + its live per-slot automaton state
+# ----------------------------------------------------------------------
+
+
+class GrammarSpec:
+    """A compiled token-level grammar: dense per-state allow masks +
+    transition table, precomputed ONCE against the tokenizer vocab.
+    Immutable and shareable across requests/slots; per-request decode
+    state lives in GrammarState."""
+
+    __slots__ = ("allow", "next_state", "accept", "start", "forced_tok")
+
+    def __init__(self, allow, next_state, accept, start: int = 0):
+        self.allow = np.ascontiguousarray(allow, bool)
+        self.next_state = np.ascontiguousarray(next_state, np.int32)
+        self.accept = np.ascontiguousarray(accept, bool)
+        self.start = int(start)
+        n, V = self.allow.shape
+        if self.next_state.shape != (n, V) or self.accept.shape != (n,):
+            raise ValueError(
+                f"shape mismatch: allow {self.allow.shape}, next_state "
+                f"{self.next_state.shape}, accept {self.accept.shape}")
+        if not 0 <= self.start < n:
+            raise ValueError(f"start state {self.start} out of range "
+                             f"[0, {n})")
+        # the jump-ahead table: the single legal token per state (or -1
+        # when the continuation is not deterministic)
+        counts = self.allow.sum(axis=1)
+        self.forced_tok = np.where(
+            counts == 1, np.argmax(self.allow, axis=1), -1
+        ).astype(np.int32)
+
+    @property
+    def n_states(self) -> int:
+        return self.allow.shape[0]
+
+    @property
+    def vocab_size(self) -> int:
+        return self.allow.shape[1]
+
+    def fresh(self) -> "GrammarState":
+        return GrammarState(self)
+
+    # -- constructors ---------------------------------------------------
+
+    @classmethod
+    def from_token_fsm(cls, n_states: int, vocab_size: int, edges,
+                       accept, start: int = 0) -> "GrammarSpec":
+        """Caller-provided token-level FSM: edges is an iterable of
+        (state, token_id, next_state) triples; accept lists the
+        accepting states. Raises ValueError on any out-of-range id —
+        the wire path surfaces that as a structured refusal."""
+        n, V = int(n_states), int(vocab_size)
+        if n < 1 or V < 1:
+            raise ValueError(f"need n_states >= 1 and vocab_size >= 1, "
+                             f"got ({n}, {V})")
+        allow = np.zeros((n, V), bool)
+        nxt = np.full((n, V), -1, np.int32)
+        for e in edges:
+            s, t, ns = (int(x) for x in e)
+            if not (0 <= s < n and 0 <= ns < n and 0 <= t < V):
+                raise ValueError(f"edge {(s, t, ns)} out of range "
+                                 f"(n_states={n}, vocab_size={V})")
+            allow[s, t] = True
+            nxt[s, t] = ns
+        acc = np.zeros((n,), bool)
+        for s in accept:
+            s = int(s)
+            if not 0 <= s < n:
+                raise ValueError(f"accept state {s} out of range "
+                                 f"[0, {n})")
+            acc[s] = True
+        return cls(allow, nxt, acc, start)
+
+    @classmethod
+    def all_tokens(cls, vocab_size: int) -> "GrammarSpec":
+        """The never-prunes grammar: one accepting state allowing every
+        token, self-looping forever — the bitwise differential anchor
+        (masked stream == unconstrained stream, tokens untouched)."""
+        V = int(vocab_size)
+        return cls(np.ones((1, V), bool), np.zeros((1, V), np.int32),
+                   np.ones((1,), bool), 0)
+
+    @classmethod
+    def from_json_schema(cls, schema, vocab) -> "GrammarSpec":
+        """Compile a restricted JSON-schema subset against a tokenizer
+        vocab (vocab[t] = decode string of token t; see byte_vocab for
+        the ByteTokenizer one). Module docstring has the subset."""
+        nfa = _Nfa()
+        a, e = _schema_frag(nfa, schema)
+        end = nfa.new()
+        nfa.link(e, end)
+        trans, acc = _nfa_to_dfa(nfa, a, end)
+        allow, nxt, accv = _lift(trans, acc, list(vocab))
+        return cls(allow, nxt, accv, 0)
+
+    @classmethod
+    def from_wire(cls, obj, vocab) -> "GrammarSpec":
+        """Parse the TokenServer wire form: {"type": "json_schema",
+        "schema": {...}} or {"type": "token_fsm", "n_states": N,
+        "edges": [[s, tok, ns], ...], "accept": [...], "start": 0}.
+        Raises ValueError on anything malformed — the serving layer
+        echoes it as a structured {"done", "error"} refusal."""
+        if not isinstance(obj, dict):
+            raise ValueError(f"grammar must be an object, got "
+                             f"{type(obj).__name__}")
+        t = obj.get("type")
+        if t == "json_schema":
+            if "schema" not in obj:
+                raise ValueError("json_schema grammar needs a 'schema'")
+            return cls.from_json_schema(obj["schema"], vocab)
+        if t == "token_fsm":
+            try:
+                return cls.from_token_fsm(
+                    int(obj["n_states"]), len(vocab), obj["edges"],
+                    obj["accept"], start=int(obj.get("start", 0)))
+            except (KeyError, TypeError) as e:
+                raise ValueError(f"malformed token_fsm grammar: {e}")
+        raise ValueError(f"unknown grammar type {t!r} (expected "
+                         f"'json_schema' or 'token_fsm')")
+
+
+class GrammarState:
+    """The live automaton of one constrained request: a single state
+    index advanced host-side per emitted token. -1 = dead (an illegal
+    token was emitted — only reachable when the mask had to be forced
+    all-True because the state offered no legal token at all)."""
+
+    __slots__ = ("spec", "state")
+
+    def __init__(self, spec: GrammarSpec, state: Optional[int] = None):
+        self.spec = spec
+        self.state = spec.start if state is None else int(state)
+
+    def clone(self) -> "GrammarState":
+        return GrammarState(self.spec, self.state)
+
+    @property
+    def is_dead(self) -> bool:
+        """No legal continuation and no acceptance — the stream can
+        neither continue nor finish cleanly (a grammar bug or an
+        adversarial FSM; the scheduler errors the request loudly)."""
+        if self.state < 0:
+            return True
+        return (not bool(self.spec.accept[self.state])
+                and not bool(self.spec.allow[self.state].any()))
+
+    @property
+    def is_final(self) -> bool:
+        """Accepting with no continuation: the structured output is
+        complete — the scheduler finishes the stream early."""
+        return (self.state >= 0
+                and bool(self.spec.accept[self.state])
+                and not bool(self.spec.allow[self.state].any()))
+
+    def allows(self, tok: int) -> bool:
+        return self.state >= 0 \
+            and bool(self.spec.allow[self.state, int(tok)])
+
+    def allowed_row(self) -> np.ndarray:
+        """[V] bool of legal next tokens (all-False when dead/final —
+        callers force all-True device masks there and let the host
+        decide termination)."""
+        if self.state < 0:
+            return np.zeros((self.spec.vocab_size,), bool)
+        return self.spec.allow[self.state]
+
+    def advance(self, tok: int) -> bool:
+        """Consume one emitted token. False = illegal (state goes
+        dead); the caller turns that into a per-request error."""
+        if self.state < 0:
+            return False
+        ns = int(self.spec.next_state[self.state, int(tok)])
+        self.state = ns
+        return ns >= 0
+
+    def forced_run(self, kmax: int) -> List[int]:
+        """Up to kmax tokens of the deterministic continuation from
+        the CURRENT state (walked on a scratch index — self.state is
+        untouched): the jump-ahead segment."""
+        out: List[int] = []
+        s = self.state
+        while len(out) < int(kmax) and s >= 0:
+            t = int(self.spec.forced_tok[s])
+            if t < 0:
+                break
+            out.append(t)
+            s = int(self.spec.next_state[s, t])
+        return out
+
+
+# ----------------------------------------------------------------------
+# jump-ahead drafting + verify-window masks (the scheduler's hooks)
+# ----------------------------------------------------------------------
+
+
+def constrained_draft(state: GrammarState, t0: int, base, kmax: int
+                      ) -> Tuple[List[int], int]:
+    """One grammar slot's draft window: filter a base drafter's
+    proposal at the first grammar-illegal token (foreign drafts under
+    spec=K compose this way), then extend with the forced jump-ahead
+    run. `state` is the slot's LIVE automaton (cloned here — the real
+    advance happens when tokens are actually emitted); t0 is the
+    pending seed at window column 0. Returns (draft, forced_from):
+    draft is up to kmax tokens following the seed, forced_from the
+    WINDOW index (seed = 0) of the first forced token, NO_FORCED when
+    the window carries none — the jump_ahead_tokens accounting key."""
+    g = state.clone()
+    if not g.advance(int(t0)) or g.is_final or g.is_dead:
+        return [], NO_FORCED
+    draft: List[int] = []
+    for t in base:
+        if len(draft) >= int(kmax):
+            break
+        t = int(t)
+        if not g.allows(t):
+            break
+        g.advance(t)
+        draft.append(t)
+        if g.is_final or g.is_dead:
+            return draft, NO_FORCED
+    n_base = len(draft)
+    forced = g.forced_run(int(kmax) - n_base)
+    draft.extend(forced)
+    return draft, (1 + n_base) if forced else NO_FORCED
+
+
+def window_masks(state: GrammarState, toks, q_len: int) -> np.ndarray:
+    """Per-position verify-window masks for one grammar slot:
+    mask[j] constrains the logits at window position j — the model's
+    prediction AFTER consuming toks[:j+1] — so the acceptance rule and
+    the corrected next seed only ever select grammar-legal tokens.
+    Walked on a clone; positions past a walk break (illegal draft
+    token, final or dead state) stay all-True, which is safe because
+    acceptance truncates at the first mismatch before reaching them
+    (and a final state's pending seed is discarded by the early
+    finish). Returns [len(toks), V] bool."""
+    toks = np.asarray(toks, np.int64).reshape(-1)
+    mask = np.ones((len(toks), state.spec.vocab_size), bool)
+    g = state.clone()
+    for j in range(int(q_len)):
+        if not g.advance(int(toks[j])):
+            break
+        row = g.allowed_row()
+        if not row.any():
+            break
+        mask[j] = row
+    return mask
+
+
+class GrammarDrafter:
+    """models/spec_decode.py ``Drafter`` protocol over a grammar: the
+    proposal is the automaton's forced continuation (optionally seeded
+    by a grammar-FILTERED base drafter's tokens first). Stateless
+    across calls — it re-walks the generated suffix of `history`
+    (which includes the pending seed token, per the protocol) from the
+    start state, so it composes with any scheduler. The scheduler's
+    internal grammar path uses ``constrained_draft`` against the
+    slot's live state instead and skips the re-walk."""
+
+    def __init__(self, spec: GrammarSpec, prompt_len: int = 0,
+                 base=None):
+        self.spec = spec
+        self.prompt_len = int(prompt_len)
+        self.base = base
+
+    def propose(self, history, k: int) -> List[int]:
+        hist = np.asarray(history, np.int64).reshape(-1)
+        g = GrammarState(self.spec)
+        for t in hist[self.prompt_len:]:
+            if not g.advance(int(t)):
+                return []
+        if g.is_final or g.is_dead:
+            return []
+        draft: List[int] = []
+        if self.base is not None:
+            for t in self.base.propose(history, k):
+                t = int(t)
+                if len(draft) >= int(k) or not g.allows(t):
+                    break
+                g.advance(t)
+                draft.append(t)
+                if g.is_final or g.is_dead:
+                    return draft
+        draft.extend(g.forced_run(int(k) - len(draft)))
+        return draft

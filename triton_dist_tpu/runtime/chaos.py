@@ -18,6 +18,12 @@ Pieces:
   - ``FlakyDrafter``: a Drafter wrapper that raises (or babbles
     garbage) on schedule; the scheduler must degrade to plain decode
     for that window, never die (spec=K resilience).
+  - ``dead_end_grammar``: a GrammarSpec that compiles fine but walks
+    into a state with NO legal continuation after a few tokens — the
+    constrained-decoding failure a schema compiler can never emit
+    (models/structured.py bounds its combinators) but a hand-built
+    token FSM can. The scheduler must reject that request with a loud
+    per-request error, retire the slot, and leak nothing.
   - misbehaving clients (host-side socket abusers for a live
     TokenServer): ``malformed_client`` (garbage request line),
     ``oversized_client`` (a request "line" bigger than the server's
@@ -169,6 +175,29 @@ class FlakyDrafter:
         if self.inner is None:
             return []
         return self.inner.propose(history, k)
+
+
+def dead_end_grammar(vocab_size: int, *, after: int = 2):
+    """A grammar that compiles but strands the automaton: every token
+    is legal for ``after`` steps, then state ``after`` allows NOTHING
+    and accepts nothing — a dead end no sampler can escape. The
+    constrained-decoding chaos arm: the scheduler must surface a loud
+    per-request "grammar dead end" error (the request's done message
+    carries it), retire the slot, and keep the zero-leak invariant —
+    never spin forever or crash the poll loop.
+
+    Schema-compiled grammars can never reach this (the JSON subset's
+    combinators are bounded and always terminable), so the arm builds
+    a hand-rolled token FSM — exactly what a buggy or adversarial
+    client-supplied ``{"type": "token_fsm", ...}`` spec can ship."""
+    from triton_dist_tpu.models.structured import GrammarSpec
+    edges = [(s, t, s + 1) for s in range(after)
+             for t in range(vocab_size)]
+    # n_states = after + 1: the last state has no outgoing edges and
+    # is not accepting — is_dead the moment the automaton lands there
+    return GrammarSpec.from_token_fsm(
+        n_states=after + 1, vocab_size=vocab_size, edges=edges,
+        accept=[], start=0)
 
 
 # ----------------------------------------------------------------------

@@ -171,7 +171,8 @@ class TokenServer:
                  trace: Optional[bool] = None,
                  disagg: bool = False, prefill_workers: int = 1,
                  disagg_threads: bool = True, transport=None,
-                 slo_classes: Optional[dict] = None):
+                 slo_classes: Optional[dict] = None,
+                 max_forks: int = 8):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -256,7 +257,18 @@ class TokenServer:
         partition into `slo_goodput`/`slo_violations` counters —
         visible in stats(), `{"op": "stats"}` and `/metrics`. An
         unknown class tag on a request is REFUSED (bounded metric
-        cardinality) with the configured names in the error."""
+        cardinality) with the configured names in the error.
+
+        max_forks caps the in-protocol `"n"` field (parallel sampling:
+        one prefill, n KV-forked decode slots — models/structured.py
+        has the subsystem story). A request may also carry a
+        `"grammar"` spec ({"type": "json_schema", "schema": ...} or
+        {"type": "token_fsm", ...}) compiled server-side against the
+        byte vocab; n<=0, n over the cap, n>1 without paged=True, and
+        a malformed grammar all get the structured {"done", error}
+        refusal with the parse error echoed — never a crashed poll
+        loop. Fork chunks are tagged {"fork": k} and the n streams
+        share ONE fan-in done message once every fork finishes."""
         from triton_dist_tpu.models.disagg import DisaggScheduler
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
@@ -288,6 +300,8 @@ class TokenServer:
                 fault=fault, prefill_budget=prefill_budget,
                 host_pool_pages=host_pool_pages, overlap=overlap,
                 trace=trace, slo_classes=slo_classes)
+        self.max_forks = max_forks
+        self._vocab = None       # lazy byte vocab for grammar compiles
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -324,6 +338,8 @@ class TokenServer:
             self.fh = fh
             self.n = 0
             self.dead = False
+            self.n_left = 1     # forks still streaming (fan-in count)
+            self.errors = []    # per-fork failure reasons, fan-in done
 
     @staticmethod
     def _refuse(conn, f, msg: dict) -> None:
@@ -403,6 +419,29 @@ class TokenServer:
                 ids = self.tok.encode(str(req.get("prompt", ""))) or [0]
                 gen_len = int(req.get("gen_len", 16))
                 seed = int(req.get("seed", 0))
+                n = int(req.get("n", 1))
+                if n < 1:
+                    raise ValueError(f"bad n={n}: must be >= 1")
+                if n > self.max_forks:
+                    raise ValueError(
+                        f"n={n} exceeds max_forks cap {self.max_forks}")
+                if n > 1 and not self.paged:
+                    raise ValueError(
+                        "n>1 parallel sampling needs paged=True (the "
+                        "KV fork shares the prompt's pages)")
+                grammar = req.get("grammar")
+                gspec = None
+                if grammar is not None:
+                    # compiled HERE so a malformed spec refuses at the
+                    # wire with the parse error echoed, never inside
+                    # the poll loop
+                    from triton_dist_tpu.models.structured import \
+                        GrammarSpec
+                    if not isinstance(grammar, dict):
+                        raise ValueError(
+                            "grammar must be a JSON object")
+                    gspec = GrammarSpec.from_wire(
+                        grammar, self._byte_vocab())
                 deadline_ms = req.get("deadline_ms")
                 if deadline_ms is not None:
                     deadline_ms = float(deadline_ms)
@@ -439,10 +478,20 @@ class TokenServer:
                 self._next_rid += 1
                 accepted = self.sched.submit(Request(
                     rid=rid, ids=np.asarray(ids, np.int32),
-                    gen_len=gen_len, seed=seed,
+                    gen_len=gen_len, seed=seed, n=n, grammar=gspec,
                     deadline_ms=deadline_ms, slo=slo))
                 if accepted:
-                    self._conns[rid] = self._ClientStream(conn, f)
+                    cs = self._ClientStream(conn, f)
+                    cs.n_left = n
+                    if n > 1:
+                        # the scheduler fans rid out into kid rids
+                        # (rid, 0)..(rid, n-1); every fork streams to
+                        # this ONE connection and the done message
+                        # fans back in once all n finish
+                        for k in range(n):
+                            self._conns[(rid, k)] = cs
+                    else:
+                        self._conns[rid] = cs
                 else:
                     hint = self._retry_after_ms()
             if not accepted:
@@ -494,6 +543,16 @@ class TokenServer:
                 except OSError:
                     pass
 
+    def _byte_vocab(self):
+        """Byte-string vocab for grammar compiles, built once per
+        server against the model's vocab size (every grammar request
+        shares it — compiling a JSON schema is cheap, rebuilding the
+        vocab per request is not)."""
+        if self._vocab is None:
+            from triton_dist_tpu.models.structured import byte_vocab
+            self._vocab = byte_vocab(self.sched.slots._vocab_size)
+        return self._vocab
+
     def _retry_after_ms(self) -> int:
         """Backpressure hint: the measured poll cadence times the line
         ahead of the client — crude, but it scales with actual load
@@ -511,9 +570,13 @@ class TokenServer:
         if cs is None or cs.dead:
             return
         row = [int(t) for t in toks]
+        msg = {"text": self.tok.decode(row), "token_ids": row}
+        if isinstance(rid, tuple):
+            # fork kid rid (parent, k): tag the chunk so the client
+            # can demux the n interleaved streams
+            msg["fork"] = int(rid[1])
         try:
-            cs.fh.write(json.dumps({"text": self.tok.decode(row),
-                                    "token_ids": row}) + "\n")
+            cs.fh.write(json.dumps(msg) + "\n")
             cs.fh.flush()           # the stream is the point
             cs.n += len(row)
         except OSError:
@@ -563,12 +626,23 @@ class TokenServer:
         with self._lock:
             return self.sched.stats()
 
-    def _finish(self, rid, error: Optional[str] = None) -> None:
+    def _finish(self, rid, error: Optional[str] = None) -> bool:
+        """Close out one finished rid; returns True when the client
+        stream fully closed. A forked request registers one stream
+        under n kid rids — each kid's finish decrements the fan-in
+        count and only the LAST writes the single done message."""
         cs = self._conns.pop(rid, None)
         if cs is None:
-            return
+            return False
         reason = error if error is not None \
             else self.sched.rejected.pop(rid, None)
+        if reason is not None:
+            cs.errors.append(f"fork {rid[1]}: {reason}"
+                             if isinstance(rid, tuple) else reason)
+        cs.n_left -= 1
+        if cs.n_left > 0:
+            return False
+        reason = "; ".join(cs.errors) if cs.errors else None
         try:
             if not cs.dead:
                 msg = {"done": True, "n_tokens": cs.n}
@@ -605,6 +679,7 @@ class TokenServer:
                 closer()
             except OSError:
                 pass
+        return True
 
     def serve_forever(self, max_requests: Optional[int] = None) -> None:
         """Model loop: accept connections (handing each to a reader
@@ -643,8 +718,8 @@ class TokenServer:
                 for rid, toks in out.items():
                     self._emit(rid, toks)
                 for rid in finished:
-                    self._finish(rid)
-                    done_count += 1
+                    if self._finish(rid):
+                        done_count += 1
                 # cancel-on-disconnect: a hung-up client's slot retires
                 # NOW (pages freed / inserted into the prefix tree)
                 # instead of decoding to gen_len for nobody
@@ -654,8 +729,8 @@ class TokenServer:
                 for rid in dead:
                     with self._lock:
                         self.sched.cancel(rid)
-                    self._finish(rid)
-                    done_count += 1
+                    if self._finish(rid):
+                        done_count += 1
                 if max_requests is not None and done_count >= max_requests:
                     break
                 if self.sched.idle:
@@ -694,6 +769,7 @@ def request_stream(host: str, port: int, prompt: str, *,
                    timeout: float = 300.0,
                    deadline_ms: Optional[float] = None,
                    slo: Optional[str] = None,
+                   n: int = 1, grammar: Optional[dict] = None,
                    connect_retries: int = 8,
                    connect_backoff_s: float = 0.05,
                    busy_retries: int = 4) -> Iterator[dict]:
@@ -703,6 +779,12 @@ def request_stream(host: str, port: int, prompt: str, *,
     should check rather than trusting n_tokens). Reference: the chat.py
     client's receive loop.
 
+    n>1 requests parallel sampling (KV fork server-side): chunk
+    messages then carry a "fork" index to demux the n interleaved
+    streams, and ONE fan-in done message closes them all. grammar= is
+    passed through as the wire spec ({"type": "json_schema", ...} or
+    {"type": "token_fsm", ...}) for constrained decoding.
+
     Resilient by default: a refused connect (server still starting —
     the classic flaky-test source) retries with bounded exponential
     backoff, and a {"busy": ...} backpressure reply sleeps the server's
@@ -710,6 +792,10 @@ def request_stream(host: str, port: int, prompt: str, *,
     raising ServerBusy. Busy replies are consumed internally — they are
     NEVER yielded as chunks."""
     payload = {"prompt": prompt, "gen_len": gen_len, "seed": seed}
+    if n != 1:
+        payload["n"] = n
+    if grammar is not None:
+        payload["grammar"] = grammar
     if deadline_ms is not None:
         payload["deadline_ms"] = deadline_ms
     if slo is not None:
