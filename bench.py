@@ -1351,6 +1351,129 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- fleet traffic-plane rows (triton_dist_tpu/fleet/): (a) the
+    # prefix-aware router over 2 replicas on a shared-system-prompt
+    # workload — the row's value is router_prefix_hit_frac with the
+    # fleet-wide prefill_skip_frac (and the round-robin arm's, which
+    # scatters the warm prefixes) alongside; (b) a mixed-SLO storm on
+    # a deliberately tight fleet (batch=1 per replica, no queue) —
+    # interactive p99 TTFT with SLO-aware shedding (batch gives way)
+    # vs the class-blind round-robin arm where interactive queues
+    # behind batch occupants. Both arms serve IDENTICAL request sets;
+    # warm-up storm first, measured storm second.
+    from triton_dist_tpu.fleet import FleetRouter, InprocReplica
+    from triton_dist_tpu.serving import ByteTokenizer
+
+    fl_tok = ByteTokenizer(cfg.vocab_size)
+    fl_gen = 16 if on_tpu else 8
+
+    def fleet(policy, tag, **kw):
+        reps = [InprocReplica(f"{tag}{i}", eng_f, fl_tok, batch=2,
+                              chunk=4, paged=True, page=fs_page)
+                for i in range(2)]
+        return FleetRouter(reps, fl_tok, policy=policy, **kw)
+
+    fl_prompts = ["You are a helpful TPU fleet. " + q
+                  for q in ("alpha?", "beta!", "gamma.", "delta;")]
+    fl_skip = {}
+    for policy in ("prefix", "rr"):
+        router = fleet(policy, f"b_{policy}")
+        try:
+            for i, p in enumerate(fl_prompts):       # warm + measure
+                router.run(p, gen_len=fl_gen, seed=i)
+            fl_skip[policy] = (
+                router.fleet_cache_stats()["prefill_skip_frac"],
+                router.stats()["router_prefix_hit_frac"])
+        finally:
+            router.shutdown()
+    _emit_json({
+        "metric": "fleet_prefix_hit_frac",
+        "value": round(fl_skip["prefix"][1], 4),
+        "unit": "frac",
+        "replicas": 2,
+        "prefill_skip_frac": round(fl_skip["prefix"][0], 4),
+        "rr_prefill_skip_frac": round(fl_skip["rr"][0], 4),
+        "requests": len(fl_prompts),
+        "backend": jax.default_backend(),
+    })
+
+    def storm(router):
+        """A batch wave EXCEEDING fleet capacity (6 long requests onto
+        2 batch=1/queue=1 replicas) takes every slot and queue, then 3
+        short interactive ones arrive; returns (sorted interactive
+        first-chunk TTFTs (s), interactive requests served). TTFT is
+        the FIRST chunk only, and the served count rides along so an
+        arm that drops interactive work can't flatter its latency
+        tail — a dropped request contributes no TTFT sample but shows
+        up as a miss. The overload is the point: shedding only pays
+        when there is MORE batch than capacity — the shed keeps the
+        queues free for interactive, where the class-blind arm's
+        queues stay full of batch backlog."""
+        import threading as _th
+        ttfts = []
+        served = [0]
+
+        def client(slo, i, g):
+            t0 = time.perf_counter()
+            first = True
+            for msg in router.stream(f"storm {slo} {i}",
+                                     gen_len=g, seed=i, slo=slo):
+                if msg.get("done"):
+                    if slo == "interactive" \
+                            and msg.get("error") is None:
+                        served[0] += 1
+                    break
+                if first and slo == "interactive":
+                    ttfts.append(time.perf_counter() - t0)
+                    first = False
+
+        bts = [_th.Thread(target=client,
+                          args=("batch", i, 4 * fl_gen))
+               for i in range(6)]
+        its = [_th.Thread(target=client,
+                          args=("interactive", 6 + i, fl_gen))
+               for i in range(3)]
+        for t in bts:
+            t.start()
+        time.sleep(0.1)
+        for t in its:
+            t.start()
+        for t in bts + its:
+            t.join(timeout=600)
+        return sorted(ttfts), served[0]
+
+    storm_p99 = {}
+    storm_served = {}
+    for arm, policy, kw in (
+            ("router", "prefix", dict(shed_inflight=2,
+                                      busy_retries=40)),
+            ("rr", "rr", dict(busy_retries=40))):
+        router = FleetRouter(
+            [InprocReplica(f"s_{arm}{i}", eng_f, fl_tok, batch=1,
+                           chunk=4, paged=True, page=fs_page,
+                           max_queue=1) for i in range(2)],
+            fl_tok, policy=policy, **kw)
+        try:
+            storm(router)                            # warm
+            ts, n_served = storm(router)             # measure
+            storm_p99[arm] = (ts[min(len(ts) - 1,
+                                     int(0.99 * len(ts)))] * 1e3
+                              if ts else -1.0)
+            storm_served[arm] = n_served
+        finally:
+            router.shutdown()
+    _emit_json({
+        "metric": "router_storm_p99_ttft_ms",
+        "value": round(storm_p99["router"], 2),
+        "unit": "ms",
+        "slo": "interactive",
+        "interactive_served": storm_served["router"],
+        "round_robin_p99_ttft_ms": round(storm_p99["rr"], 2),
+        "round_robin_interactive_served": storm_served["rr"],
+        "replicas": 2,
+        "backend": jax.default_backend(),
+    })
+
     # roofline rows: per-kernel achieved/SOL fractions from
     # tools/perf_report, into the same capture + history ledger so
     # bench_compare --strict gates on same-backend roofline

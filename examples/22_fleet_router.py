@@ -1,0 +1,109 @@
+"""Fleet traffic plane: a prefix-aware, SLO-aware router over N
+TokenServer replicas (triton_dist_tpu/fleet/).
+
+One FleetRouter in front of two in-process replicas — each a real
+TokenServer on its own socket — shows the three policy layers:
+
+  - PREFIX-AWARE PLACEMENT: the router keeps a shadow index of every
+    replica's prefix cache (fed by the done messages it relays), so a
+    request sharing a system prompt with earlier traffic lands on the
+    replica whose radix tree is already warm and skips that prefill.
+    Session affinity (`session` wire field) breaks placement ties so
+    one conversation stays on one replica.
+
+  - ELASTIC MEMBERSHIP: health is probed over the existing
+    `{"op": "stats"}` protocol request. A replica killed MID-STREAM
+    (abrupt socket death, no done) is detected by the EOF, marked
+    dead, and the interrupted request is re-served on a survivor —
+    greedy same-seed decoding makes the spliced stream bitwise
+    seamless. A joining replica is routable the moment add_replica
+    returns.
+
+  - SLO-AWARE SHEDDING: under saturation the router sheds `batch`
+    (and untagged) requests with a structured error while
+    `interactive` traffic keeps its queue slot — the same class
+    priorities that drive preemption-victim choice and prefill-budget
+    splits inside each replica's scheduler.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402
+_common.bootstrap()              # widen the CPU substrate BEFORE jax loads
+
+
+def main():
+    from triton_dist_tpu.fleet import FleetRouter, InprocReplica
+    from triton_dist_tpu.models import AutoLLM, Engine
+    from triton_dist_tpu.models.config import tiny_qwen3
+    from triton_dist_tpu.runtime import initialize_distributed
+    from triton_dist_tpu.serving import ByteTokenizer
+
+    ctx = initialize_distributed()
+    cfg = tiny_qwen3(ctx.tp_size())
+    model = AutoLLM.from_config(cfg, ctx.mesh)
+    engine = Engine(model, max_seq=64, backend="xla")
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    # two replicas, one engine: same-config TokenServers share the
+    # process-wide jitted programs, so the fleet costs one compile
+    replicas = [InprocReplica(f"r{i}", engine, tok, batch=2, chunk=4,
+                              paged=True, page=8) for i in range(2)]
+    router = FleetRouter(replicas, tok, policy="prefix")
+
+    # ---- prefix-aware placement: follow-ups land warm --------------
+    system = "You are a helpful TPU fleet. "
+    for i, q in enumerate(("alpha?", "beta!", "gamma.")):
+        out = router.run(system + q, gen_len=8, seed=i)
+        print(f"prompt {i} -> replica {out['done']['replica']} "
+              f"({len(out['token_ids'])} tokens)")
+    st = router.stats()
+    cache = router.fleet_cache_stats()
+    print(f"router_prefix_hit_frac={st['router_prefix_hit_frac']} "
+          f"fleet prefill_skip_frac={cache['prefill_skip_frac']:.3f}")
+    assert st["router_prefix_hit_frac"] > 0.0
+
+    # ---- session affinity pins a conversation ----------------------
+    homes = {router.run(f"{w} something new", gen_len=6, seed=i,
+                        session="user-1")["done"]["replica"]
+             for i, w in enumerate(("alpha", "bravo", "charlie"))}
+    print(f"session user-1 stayed on {sorted(homes)}")
+    assert len(homes) == 1
+
+    # ---- mid-stream failover: kill a replica, stream survives ------
+    want = router.run("kill me midstream", gen_len=12,
+                      seed=3)["token_ids"]
+    target, _ = router._route(tok.encode("kill me midstream"), None)
+    stream = router.stream("kill me midstream", gen_len=12, seed=3)
+    first = next(stream)                      # first chunk relayed...
+    router.members.replicas[target].kill()    # ...then the home dies
+    router.members.mark_dead(target)
+    got = list(first.get("token_ids", []))
+    done = None
+    for msg in stream:
+        if msg.get("done"):
+            done = msg
+            break
+        got.extend(msg["token_ids"])
+    survivors = router.members.healthy_rids()
+    print(f"replica {target} killed mid-stream -> re-served on "
+          f"{survivors} (resteered={done.get('resteered')})")
+    assert done.get("error") is None and got == want, "splice broke"
+
+    # ---- SLO-aware shedding under saturation -----------------------
+    router.shed_inflight = 0                  # everything is "over"
+    shed = router.run("batch job", gen_len=4, slo="batch")
+    ok = router.run("human waiting", gen_len=4, slo="interactive")
+    print(f"batch under storm: {shed['done']['error']!r}")
+    print(f"interactive under storm: {len(ok['token_ids'])} tokens")
+    assert "shed" in shed["done"]["error"]
+    assert ok["done"].get("error") is None
+
+    router.shutdown()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

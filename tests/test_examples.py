@@ -57,7 +57,10 @@ def test_prefix_cache_example_runs():
     _run_example("10_prefix_cache.py")
 
 
+@pytest.mark.slow
 def test_speculative_decoding_example_runs():
+    # slow: same budget note — test_spec_decode.py gates the
+    # draft/verify differential; the example is a doc artifact.
     _run_example("11_speculative_decoding.py")
 
 
@@ -68,7 +71,10 @@ def test_resilient_serving_example_runs():
     _run_example("12_resilient_serving.py")
 
 
+@pytest.mark.slow
 def test_chunked_prefill_example_runs():
+    # slow: same budget note — test_chunked_prefill.py gates the
+    # chunked-vs-whole matrix; the example is a doc artifact.
     _run_example("13_chunked_prefill.py")
 
 
@@ -85,7 +91,10 @@ def test_overlap_scheduler_example_runs():
     _run_example("15_overlap_scheduler.py")
 
 
+@pytest.mark.slow
 def test_telemetry_example_runs():
+    # slow: same budget note — test_telemetry.py gates counters and
+    # trace spans; the example is a doc artifact.
     _run_example("16_telemetry.py")
 
 
@@ -127,6 +136,14 @@ def test_structured_output_example_runs():
     # in-suite (tests/test_structured.py); tools/struct_smoke.sh and
     # manual runs cover the example itself.
     _run_example("21_structured_output.py")
+
+
+@pytest.mark.slow
+def test_fleet_router_example_runs():
+    # slow: same budget note — the routing/failover/shed differentials
+    # run in-suite (tests/test_fleet.py); tools/fleet_smoke.sh and
+    # manual runs cover the example itself.
+    _run_example("22_fleet_router.py")
 
 
 @pytest.mark.slow

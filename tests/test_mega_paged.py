@@ -112,6 +112,9 @@ def _run_pair(layer, x, pos, w, pk, pv, table, scales):
 # kernel-level differentials
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1's 870 s budget — tools/mega_smoke.sh runs
+# the full kernel-oracle matrix; tier-1 keeps the behavioral guards
+# (trash-page sink, dispatch-count trace, capability errors).
 def test_mega_paged_layer_vs_oracle_per_slot_lens():
     """Per-slot kv_lens: slots at pos 0, mid-page and page-crossing
     positions share ONE launch; each must mask to its own length (the
@@ -127,6 +130,7 @@ def test_mega_paged_layer_vs_oracle_per_slot_lens():
             np.asarray(r, dtype=np.float32), atol=1e-2, rtol=1e-2)
 
 
+@pytest.mark.slow  # same budget note — tools/mega_smoke.sh covers it
 def test_mega_paged_layer_vs_flash_decode_paged():
     """The per-op composition differential (the satellite's oracle
     style): same inputs through the UNFUSED pieces — jnp qk-norm/rope,
@@ -212,6 +216,7 @@ def test_mega_paged_trash_page_write_sink():
             np.testing.assert_array_equal(after_v[pid], before_v[pid])
 
 
+@pytest.mark.slow  # same budget note — tools/mega_smoke.sh covers it
 def test_mega_paged_layer_int8_scale_plane_dequant():
     """INT8 pool: the fused tick's in-kernel dequant (K scales the
     logits, V folds into P) and its quantized row write must match the
@@ -357,6 +362,9 @@ def _near_argmax(model, reqs, streams, tol=0.05):
             assert gap <= tol, (r.rid, i, gap)
 
 
+@pytest.mark.slow  # same budget note — the heaviest serving arm
+# (43 s on the tier-1 substrate); tools/mega_smoke.sh runs it on every
+# loop and the flash-vs-mega tick guard stays via the dispatch trace.
 def test_mega_paged_tick_serves_per_op_streams():
     """The acceptance differential at tp=1: greedy paged+prefix-cache
     streams through backend='mega' vs backend='flash', plus mega

@@ -361,6 +361,30 @@ def test_bench_compare_structured_row_directions():
         == "higher-is-better"
 
 
+def test_bench_compare_fleet_row_directions():
+    """ISSUE 18 satellite: the two fleet traffic-plane bench rows
+    resolve to the right regression direction —
+    `router_storm_p99_ttft_ms` (unit "ms", a latency: UP = regressed)
+    and `fleet_prefix_hit_frac` (unit "frac", a placement hit rate:
+    DOWN = regressed)."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "router_storm_p99_ttft_ms", "value": 40.0,
+          "unit": "ms", "backend": "tpu"},
+         {"metric": "fleet_prefix_hit_frac", "value": 0.75,
+          "unit": "frac", "backend": "tpu"}]
+    b = [{"metric": "router_storm_p99_ttft_ms", "value": 160.0,
+          "unit": "ms", "backend": "tpu"},
+         {"metric": "fleet_prefix_hit_frac", "value": 0.25,
+          "unit": "frac", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["router_storm_p99_ttft_ms"]["flag"] == "regressed"
+    assert res["router_storm_p99_ttft_ms"]["direction"] \
+        == "lower-is-better"
+    assert res["fleet_prefix_hit_frac"]["flag"] == "regressed"
+    assert res["fleet_prefix_hit_frac"]["direction"] \
+        == "higher-is-better"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

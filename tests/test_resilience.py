@@ -820,3 +820,96 @@ def test_chaos_soak_randomized():
     srv.sched.slots.prefix.tree.evict_until(10 ** 9)
     assert pool.pages_in_use == 0
     assert pool.available == num_pages - 1
+
+
+# ----------------------------------------------------------------------
+# SLO-aware preemption-victim choice (models/scheduler.py + fleet PR)
+# ----------------------------------------------------------------------
+
+def _slo_victim_scenario(slos):
+    """Interleaved-admission preemption rig: A (slos[0]) is admitted
+    first and has emitted MORE tokens than B (slos[1]) by the time C
+    (slos[2]) arrives at a free slot under a chaos-forced
+    PoolExhausted — so the old victim-blind key (fewest generated)
+    always evicts B, and any other choice is the SLO rank at work. The
+    victim re-queues and re-admits within the same poll, so it is
+    identified by its traced "preempt" req_event. Returns (streams,
+    the preempted rids)."""
+    import dataclasses as _dc
+    cfg, model = _model()
+    eng = Engine(model, max_seq=64, backend="xla")
+    base = _mixed_requests(cfg, [(10, 24), (8, 24), (7, 6)])
+    reqs = [_dc.replace(r, slo=s) for r, s in zip(base, slos)]
+    # admission ATTEMPTS: A=0, B=1, C=2 (chaos) -> preempt ->
+    # C retry=3 -> victim re-admit=4
+    fault = FaultInjector(exhaust_admissions=(2,))
+    sched = ContinuousScheduler(eng, batch=3, chunk=CHUNK, paged=True,
+                                prefix_cache=True, page=PAGE,
+                                fault=fault, trace=True)
+    acc = {r.rid: [] for r in reqs}
+
+    def polls(n):
+        for _ in range(n):
+            out, _ = sched.poll()
+            for rid, toks in out.items():
+                acc[rid].extend(np.asarray(toks).tolist())
+
+    sched.submit(reqs[0])
+    polls(2)                      # A armed + emitting
+    sched.submit(reqs[1])
+    polls(2)                      # B armed + emitting; A well ahead
+    slots = sched.slots
+    b_a = slots.rids.index(0)
+    b_b = slots.rids.index(1)
+    assert slots.emitted(b_a) > slots.emitted(b_b) > 0, \
+        "rig broke: A must lead B with both victim-eligible"
+    sched.submit(reqs[2])
+    polls(1)                      # attempt 2: PoolExhausted -> preempt
+    assert fault.injected["pool_exhausted"] == 1
+    assert sched.preemptions == 1
+    while not sched.idle:
+        polls(1)
+    _assert_no_leak(sched)
+    preempted = {
+        str(rid) for rid, rec in
+        sched.tele.export().get("requests", {}).items()
+        if any("preempt" in str(ev)
+               for ev in rec.get("events", []))}
+    return {rid: np.asarray(t, np.int32)
+            for rid, t in acc.items()}, preempted
+
+
+def test_slo_victim_batch_preempted_before_interactive():
+    """Under pool pressure the BATCH-class resident is the preemption
+    victim even though the interactive one has generated fewer tokens
+    (the victim-blind key would have evicted it) — and the preempted
+    stream still resumes to bitwise completion."""
+    cfg, model = _model()
+    eng = Engine(model, max_seq=64, backend="xla")
+    clean = ContinuousScheduler(eng, batch=3, chunk=CHUNK, paged=True,
+                                prefix_cache=True, page=PAGE)
+    want = clean.run(_mixed_requests(cfg, [(10, 24), (8, 24), (7, 6)]))
+    got, preempted = _slo_victim_scenario(
+        ("batch", "interactive", "interactive"))
+    assert preempted == {"0"}, \
+        f"victim must be the batch-class A, got {preempted}"
+    for rid, w in want.items():
+        np.testing.assert_array_equal(got[rid], w,
+                                      err_msg=f"rid={rid}")
+
+
+def test_slo_victim_uniform_classes_degenerate_to_blind_bitwise():
+    """Uniform classes make the SLO rank a constant leading key: the
+    victim choice (and therefore every stream, bitwise) must equal the
+    victim-blind baseline — asserted against the UNTAGGED run, which
+    is the pre-SLO scheduler verbatim."""
+    got_blind, preempted_blind = _slo_victim_scenario(
+        (None, None, None))
+    got_uniform, preempted_uniform = _slo_victim_scenario(
+        ("batch", "batch", "batch"))
+    # fewest-generated picks B in both arms
+    assert preempted_blind == preempted_uniform == {"1"}
+    assert set(got_blind) == set(got_uniform)
+    for rid, w in got_blind.items():
+        np.testing.assert_array_equal(got_uniform[rid], w,
+                                      err_msg=f"rid={rid}")

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Fleet traffic-plane smoke — the full router/membership/SLO matrix
+# (tests/test_fleet.py including the slow arms: the mixed-SLO storm
+# differential, the subprocess-replica fleet with the AOT warm join,
+# and the example) plus the SLO-aware preemption-victim tests riding
+# in tests/test_resilience.py. This is the focused loop for iterating
+# on triton_dist_tpu/fleet/ alone; tier-1 (tools/tier1.sh) runs the
+# lean arms under its 870 s budget. Archives the pass count next to
+# the log and reports the delta vs the previous run, tier1.sh-style.
+# Run from the repo root: bash tools/fleet_smoke.sh
+set -o pipefail
+rm -f /tmp/_fleet_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the FULL fleet
+# matrix, including the arms tier-1's budget pushes behind the slow
+# mark (the storm goodput differential, the subprocess replicas —
+# each a fresh process paying its own model build).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_fleet.py \
+    "tests/test_resilience.py::test_slo_victim_batch_preempted_before_interactive" \
+    "tests/test_resilience.py::test_slo_victim_uniform_classes_degenerate_to_blind_bitwise" \
+    "tests/test_observability.py::test_bench_compare_fleet_row_directions" \
+    "tests/test_examples.py::test_fleet_router_example_runs" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_fleet_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_fleet_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_fleet_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "FLEET_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "FLEET_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

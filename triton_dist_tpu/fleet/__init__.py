@@ -1,0 +1,33 @@
+"""Fleet traffic plane: the policy layer ABOVE one TokenServer.
+
+The serving stack (serving.py) is production-shaped inside a single
+scheduler; this package routes traffic ACROSS N replicas — the
+Mooncake / SGLang deployment story where a returning user lands on the
+replica that already holds their KV:
+
+- placement.py — the router's SHADOW radix index of what each
+  replica's prefix cache holds (fed by retire events piggybacked on
+  the done wire), so placement is longest-prefix-match without any
+  side channel into replica internals.
+- membership.py — replica handles (in-process threads for
+  deterministic tests, subprocesses over the real socket protocol for
+  the smoke arm) plus elastic membership: health probes over the
+  existing ``{"op": "stats"}`` protocol, dead replicas routed around,
+  joiners admitted within one probe.
+- router.py — FleetRouter: prefix-aware placement with session
+  affinity as the tiebreak, SLO-aware load shedding (batch before
+  interactive), and mid-stream failover that re-serves a killed
+  replica's requests to completion via the deterministic-splice
+  resteer.
+"""
+from triton_dist_tpu.fleet.membership import (InprocReplica,
+                                              Membership,
+                                              SubprocReplica,
+                                              probe_stats)
+from triton_dist_tpu.fleet.placement import (PlacementIndex,
+                                             ShadowPrefixIndex)
+from triton_dist_tpu.fleet.router import FleetRouter
+
+__all__ = ["FleetRouter", "InprocReplica", "Membership",
+           "PlacementIndex", "ShadowPrefixIndex", "SubprocReplica",
+           "probe_stats"]

@@ -1,0 +1,154 @@
+"""Prefix-aware placement: the router's shadow of each replica's cache.
+
+A replica's radix prefix tree (models/prefix_cache.py) holds the KV of
+every retired prompt it served; routing a request that SHARES a prefix
+with one of those prompts to that replica turns the shared span into a
+prefill skip (the Mooncake/SGLang cache-aware-routing win). The router
+cannot see replica internals — it sees the WIRE. So it keeps a SHADOW
+index per replica: every done message is a retire event ("this replica
+just inserted prompt+generation into its tree"), and the router
+records the token sequence it already knows (it tokenized the prompt
+to route it, and it relayed every generated token). Placement is then
+longest-match over the shadows — approximate by construction (replica
+eviction is invisible until a miss), which costs a misroute at worst,
+never a wrong token: placement changes WHERE a request runs, the
+streams stay bitwise identical (tests/test_fleet.py).
+
+The shadow is deliberately NOT a page-accounting radix tree: entries
+are whole token sequences with an LRU cap, matched with the same
+numpy common-prefix scan the real tree uses. At router scale (entries
+per replica, not pages per pool) the flat scan is cheaper than
+maintaining tree invariants for a structure whose ground truth lives
+elsewhere.
+
+PlacementIndex is internally locked: note_retire() lands on stream
+worker threads while best() runs under the router's placement lock and
+_on_death() drops a whole shadow, so every entry-point serializes on
+one index-wide mutex rather than trusting caller discipline.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+
+
+def common_prefix_len(a: np.ndarray, b: np.ndarray) -> int:
+    """Length of the shared leading span of two token id sequences
+    (the models/prefix_cache.py matching rule, vectorized)."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if len(neq) else n
+
+
+class ShadowPrefixIndex:
+    """One replica's shadow: the token sequences its prefix tree was
+    fed, LRU-capped. insert() folds prefix-related sequences together
+    (a sequence that extends a stored one replaces it; one already
+    covered refreshes recency only) so the entry count tracks DISTINCT
+    conversations, not every turn."""
+
+    def __init__(self, max_entries: int = 256):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, "
+                             f"got {max_entries}")
+        self.max_entries = int(max_entries)
+        # insertion-ordered: oldest first, move_to_end on touch
+        self._entries: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._next_key = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def insert(self, tokens) -> None:
+        seq = np.asarray(tokens, np.int32)
+        if len(seq) == 0:
+            return
+        for key, ent in list(self._entries.items()):
+            m = common_prefix_len(seq, ent)
+            if m == len(seq):
+                # already covered by a stored sequence: refresh it
+                self._entries.move_to_end(key)
+                return
+            if m == len(ent):
+                # extends a stored sequence: the longer one subsumes it
+                del self._entries[key]
+        self._entries[self._next_key] = seq
+        self._next_key += 1
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def match_len(self, tokens) -> int:
+        """Longest shared leading span between `tokens` and any stored
+        sequence — the prefill the replica could skip."""
+        seq = np.asarray(tokens, np.int32)
+        best = 0
+        for ent in self._entries.values():
+            m = common_prefix_len(seq, ent)
+            if m > best:
+                best = m
+        return best
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+class PlacementIndex:
+    """The fleet-wide shadow map: replica id -> ShadowPrefixIndex.
+    best() is the placement decision; note_retire() is the wire-fed
+    update; drop() forgets a dead replica (its tree died with it — a
+    stale shadow would keep steering traffic at a cold restart)."""
+
+    def __init__(self, *, max_entries_per_replica: int = 256):
+        self.max_entries_per_replica = int(max_entries_per_replica)
+        self._shadows: Dict[str, ShadowPrefixIndex] = {}
+        self._lock = threading.Lock()
+
+    def ensure(self, replica_id: str) -> ShadowPrefixIndex:
+        with self._lock:
+            return self._ensure(replica_id)
+
+    def _ensure(self, replica_id: str) -> ShadowPrefixIndex:
+        shadow = self._shadows.get(replica_id)
+        if shadow is None:
+            shadow = self._shadows[replica_id] = ShadowPrefixIndex(
+                self.max_entries_per_replica)
+        return shadow
+
+    def note_retire(self, replica_id: str, tokens) -> None:
+        """One retire event off the done wire: `replica_id` inserted
+        `tokens` (prompt + generated) into its prefix tree."""
+        with self._lock:
+            self._ensure(replica_id).insert(tokens)
+
+    def drop(self, replica_id: str) -> None:
+        with self._lock:
+            self._shadows.pop(replica_id, None)
+
+    def best(self, tokens,
+             candidates: Iterable[str]) -> Tuple[List[str], int]:
+        """Longest-match placement over `candidates` (the healthy
+        replicas, in registration order). Returns (the replicas tying
+        for the longest match — in candidate order, so the caller's
+        tiebreak is deterministic — and the match length in tokens).
+        A fleet with no shadows ties everyone at 0."""
+        seq = np.asarray(tokens, np.int32)
+        best_len = 0
+        best_rids: List[str] = []
+        with self._lock:
+            for rid in candidates:
+                shadow = self._shadows.get(rid)
+                m = shadow.match_len(seq) if shadow is not None else 0
+                if m > best_len:
+                    best_len, best_rids = m, [rid]
+                elif m == best_len:
+                    best_rids.append(rid)
+        return best_rids, best_len
+
+    def shadow_sizes(self) -> Dict[str, int]:
+        with self._lock:
+            return {rid: len(s) for rid, s in self._shadows.items()}

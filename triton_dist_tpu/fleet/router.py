@@ -1,0 +1,534 @@
+"""FleetRouter: prefix-aware, SLO-aware traffic over N replicas.
+
+One router in front of N TokenServer replicas, three policy layers
+deep (the subsystem map is the package docstring):
+
+PLACEMENT — ``policy="prefix"`` routes each request to the replica
+whose shadow index (placement.py) holds the longest matching prefix:
+the replica that can skip that prefill. Ties (including the universal
+0-match tie of a cold fleet) break to SESSION AFFINITY (the ``session``
+wire field pins a conversation where its KV sits), then least-inflight,
+then registration order — every decision deterministic.
+``policy="rr"`` is the round-robin baseline the bench beats.
+
+MEMBERSHIP — health is probed over ``{"op": "stats"}``
+(membership.py); a mid-stream EOF is an immediate out-of-band death
+verdict. A dead replica's in-flight requests RESTEER: the full request
+re-dispatches to a healthy replica and the router SPLICES the streams
+— it drops the first `sent` tokens of the re-served stream (greedy
+decoding of the same prompt/seed regenerates the identical prefix) and
+relays the rest, so the client sees one seamless, bitwise-correct
+stream plus a ``resteered`` count in the done message. Queued work
+behind a busy survivor drains via request_stream's existing busy/retry
+backoff.
+
+SCHEDULING — the router sheds by SLO class under storm: when the
+fleet's in-flight count reaches ``shed_inflight``, requests below the
+most-protected configured class priority (``batch``, and untagged,
+before ``interactive`` — runtime/telemetry.py priorities) get an
+immediate structured shed-done instead of a queue slot, so interactive
+TTFT survives the burst. Inside each replica the same priorities drive
+preemption-victim choice and prefill-budget splits
+(models/scheduler.py).
+
+The router carries its own telemetry bundle: request lifecycle
+(router-level ttft/goodput per SLO class), ``routed_requests{replica=,
+reason=}`` / ``resteer_count`` / ``shed_requests{slo=}`` counters, the
+``replica_healthy{replica=}`` gauge, ``router_prefix_hit_frac``, and —
+with tracing on — one MERGED timeline: a track per replica, a
+route→replica-admit flow arrow per dispatch, and every in-process
+replica's own poll-loop trace spliced in on offset tracks with a
+shared time base (export()).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from triton_dist_tpu.fleet.membership import Membership
+from triton_dist_tpu.fleet.placement import PlacementIndex
+from triton_dist_tpu.runtime.telemetry import (Telemetry,
+                                               UNTAGGED_PRIORITY)
+
+
+class FleetRouter:
+    """The traffic plane over a fleet of TokenServer replicas. Add
+    replicas at construction or elastically via add_replica(); stream()
+    is the client surface — same message shapes as
+    serving.request_stream, so a fleet of one is interchangeable with
+    a bare server (asserted bitwise in tests/test_fleet.py)."""
+
+    def __init__(self, replicas, tokenizer, *, policy: str = "prefix",
+                 session_affinity: bool = True, fault=None,
+                 trace: bool = False, probe_timeout_s: float = 5.0,
+                 shed_inflight: Optional[int] = None,
+                 max_entries_per_replica: int = 256,
+                 busy_retries: int = 8,
+                 prefix_min_frac: float = 0.5,
+                 slo_classes: Optional[dict] = None):
+        if policy not in ("prefix", "rr"):
+            raise ValueError(f"unknown policy {policy!r} "
+                             f"(choose 'prefix' or 'rr')")
+        self.policy = policy
+        self.session_affinity = bool(session_affinity)
+        self.fault = fault
+        self.shed_inflight = shed_inflight
+        self.busy_retries = int(busy_retries)
+        if not 0.0 <= prefix_min_frac <= 1.0:
+            raise ValueError(f"prefix_min_frac must be in [0, 1], "
+                             f"got {prefix_min_frac}")
+        self.prefix_min_frac = float(prefix_min_frac)
+        self.tok = tokenizer
+        self.tele = Telemetry(trace=trace)
+        # router-level goodput partition + shed priorities (None =
+        # DEFAULT_SLO_CLASSES; replicas should be configured with the
+        # same map so wire validation matches)
+        self.tele.configure_slo(slo_classes)
+        self.members = Membership(probe_timeout_s=probe_timeout_s,
+                                  fault=fault,
+                                  registry=self.tele.registry)
+        self.members.on_death = self._on_death
+        self.placement = PlacementIndex(
+            max_entries_per_replica=max_entries_per_replica)
+        self.sessions: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._tids: Dict[str, int] = {}
+        self._rr = 0
+        self._next_rid = 0
+        self._inflight = 0
+        self._inflight_by: Dict[str, int] = {}
+        self._n_routed = 0
+        self._n_prefix_hits = 0
+        reg = self.tele.registry
+        self._c_resteer = reg.counter(
+            "resteer_count", "in-flight requests re-served on another "
+            "replica after a mid-stream death")
+        for replica in replicas:
+            self.add_replica(replica)
+
+    # ------------------------------------------------------------------
+    # membership plumbing
+    # ------------------------------------------------------------------
+
+    def add_replica(self, replica) -> bool:
+        """Elastic join: register + probe (membership.add — routable
+        the moment this returns True). A joiner sharing the fleet's
+        TDTPU_AOT_CACHE warm-starts its programs, which is what makes
+        this a probe period, not a compile."""
+        admitted = self.members.add(replica)
+        with self._lock:
+            self._inflight_by.setdefault(replica.rid, 0)
+            if self.tele.trace:
+                self._tids[replica.rid] = self.tele.track(
+                    f"replica-{replica.rid}")
+        return admitted
+
+    def probe(self) -> Dict[str, bool]:
+        """One probe period over the whole fleet."""
+        return self.members.probe_all()
+
+    def _on_death(self, rid: str) -> None:
+        # the replica's prefix tree died with it: a stale shadow (or
+        # session pin) would keep steering traffic at a cold restart
+        self.placement.drop(rid)
+        with self._lock:
+            for sess in [s for s, r in self.sessions.items()
+                         if r == rid]:
+                del self.sessions[sess]
+
+    def _kill_replica(self, rid: str) -> None:
+        """Chaos arm (FaultInjector kill_replicas): pull the replica
+        down abruptly, mid-stream."""
+        replica = self.members.replicas.get(rid)
+        if replica is not None:
+            replica.kill()
+        self.members.mark_dead(rid)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _route(self, tokens, session: Optional[str],
+               exclude=frozenset()):
+        """One placement decision -> (replica id, reason) or
+        (None, None) when no routable replica remains (unhealthy, or
+        in `exclude` — the replicas that answered busy this round).
+        Deterministic all the way down: longest shadow match, then
+        session pin, then least in-flight, then registration order."""
+        with self._lock:
+            healthy = [r for r in self.members.healthy_rids()
+                       if r not in exclude]
+            if not healthy:
+                return None, None
+            self._n_routed += 1
+            if self.policy == "rr":
+                rid = healthy[self._rr % len(healthy)]
+                self._rr += 1
+                return rid, "rr"
+            tied, matched = self.placement.best(tokens, healthy)
+            if matched < max(1, self.prefix_min_frac * len(tokens)):
+                # a short match doesn't justify a hotspot: below the
+                # threshold the cache value of the match loses to load
+                # balance, so the fleet spreads instead of piling every
+                # request sharing a few boilerplate tokens onto one
+                # replica (the SGLang cache-aware-routing guard)
+                tied, matched = list(healthy), 0
+            if matched > 0:
+                self._n_prefix_hits += 1
+            if matched > 0 and len(tied) == 1:
+                return tied[0], "prefix"
+            if self.session_affinity and session is not None:
+                pin = self.sessions.get(session)
+                if pin in tied:
+                    return pin, "session"
+            rid = min(tied, key=lambda r: self._inflight_by[r])
+            return rid, ("prefix" if matched > 0 else "least_loaded")
+
+    def _priority(self, slo: Optional[str]) -> float:
+        if slo is None:
+            return UNTAGGED_PRIORITY
+        cls = self.tele.slo_classes.get(slo)
+        return cls.priority if cls is not None else UNTAGGED_PRIORITY
+
+    def _count_routed(self, rid: str, reason: str) -> None:
+        self.tele.registry.counter(
+            "routed_requests", "placement decisions",
+            labels={"replica": rid, "reason": reason}).inc()
+
+    # ------------------------------------------------------------------
+    # the client surface
+    # ------------------------------------------------------------------
+
+    def stream(self, prompt: str, *, gen_len: int = 16, seed: int = 0,
+               slo: Optional[str] = None,
+               session: Optional[str] = None,
+               deadline_ms: Optional[float] = None, n: int = 1,
+               grammar: Optional[dict] = None,
+               timeout: float = 300.0) -> Iterator[dict]:
+        """Serve one request through the fleet: yields the replica's
+        chunk messages verbatim (spliced across a resteer), then ONE
+        done message whose n_tokens counts what THIS client actually
+        received. A shed or fully-failed request still gets a
+        structured done with an "error" — the router never silently
+        drops."""
+        from triton_dist_tpu.serving import ServerBusy, request_stream
+        tokens = np.asarray(self.tok.encode(str(prompt)) or [0],
+                            np.int32)
+        with self._lock:
+            rid_req = self._next_rid
+            self._next_rid += 1
+            self._inflight += 1
+            # the shed comparison uses THIS request's post-increment
+            # count, captured under the lock: two racing admissions
+            # can't both read a stale pre-storm value
+            inflight = self._inflight
+        self.tele.queued(rid_req, slo=slo)
+        try:
+            if self.shed_inflight is not None \
+                    and inflight > self.shed_inflight:
+                protected = max(
+                    (c.priority
+                     for c in self.tele.slo_classes.values()),
+                    default=UNTAGGED_PRIORITY)
+                if self._priority(slo) < protected:
+                    # load shedding: below-top classes give way so the
+                    # protected class's TTFT survives the storm; the
+                    # class's goodput/violations partition stays exact
+                    # (a shed is a violation, never a silent drop)
+                    self.tele.registry.counter(
+                        "shed_requests", "requests shed at admission "
+                        "under fleet saturation",
+                        labels={"slo": str(slo)}).inc()
+                    self.tele.retire(rid_req, "rejected")
+                    yield {"done": True, "n_tokens": 0,
+                           "error": f"shed: fleet saturated "
+                                    f"(inflight > "
+                                    f"{self.shed_inflight}, "
+                                    f"slo={slo})"}
+                    return
+            sent = 0
+            gen_ids: list = []
+            resteers = 0
+            busy_excl: set = set()
+            busy_left = self.busy_retries
+            busy_hint_ms: Optional[float] = None
+            max_dispatches = max(2 * len(self.members.replicas), 2)
+            while True:
+                if resteers >= max_dispatches:
+                    self.tele.retire(rid_req, "rejected")
+                    yield {"done": True, "n_tokens": sent,
+                           "error": f"no healthy replica after "
+                                    f"{resteers} resteers"}
+                    return
+                rid, reason = self._route(tokens, session,
+                                          exclude=busy_excl)
+                if rid is None and busy_excl:
+                    # EVERY healthy replica answered busy this round:
+                    # only now is waiting correct — a single busy
+                    # replica just means "try the next one" (below),
+                    # never a sleep while a peer has capacity. The
+                    # server's retry hint is clamped: it scales with
+                    # the replica's measured poll cadence, which a
+                    # compile-heavy warmup inflates for a while
+                    if busy_left <= 0:
+                        self.tele.retire(rid_req, "rejected")
+                        yield {"done": True, "n_tokens": sent,
+                               "busy_rejected": True,
+                               "error": f"busy: whole fleet shed "
+                                        f"after {self.busy_retries} "
+                                        f"retries (retry_after_ms="
+                                        f"{busy_hint_ms:g})"}
+                        return
+                    busy_left -= 1
+                    time.sleep(
+                        min(max(busy_hint_ms or 25.0, 1.0), 100.0)
+                        / 1e3)
+                    busy_excl.clear()
+                    busy_hint_ms = None
+                    continue
+                if rid is None:
+                    self.tele.retire(rid_req, "rejected")
+                    yield {"done": True, "n_tokens": sent,
+                           "error": "no healthy replica"}
+                    return
+                if resteers:
+                    reason = "resteer"
+                self._count_routed(rid, reason)
+                replica = self.members.replicas[rid]
+                kill_arm = (self.fault is not None
+                            and self.fault.router_dispatch(rid)
+                            == "kill")
+                self.tele.flow("route", rid_req, phase="s", tid=0,
+                               args={"replica": rid,
+                                     "reason": reason})
+                with self._lock:
+                    self._inflight_by[rid] += 1
+                t0 = time.monotonic()
+                done_msg = None
+                skip = sent      # resteer splice: drop the re-served
+                n_chunks = 0     # prefix the client already has
+                try:
+                    for msg in request_stream(
+                            replica.host, replica.port, prompt,
+                            gen_len=gen_len, seed=seed, slo=slo,
+                            session=session, deadline_ms=deadline_ms,
+                            n=n, grammar=grammar, timeout=timeout,
+                            busy_retries=0):
+                        if msg.get("done"):
+                            done_msg = msg
+                            break
+                        n_chunks += 1
+                        if n_chunks == 1:
+                            # the arrow lands where the request did
+                            self.tele.flow(
+                                "route", rid_req, phase="f",
+                                tid=self._tids.get(rid, 0))
+                        ids = list(msg.get("token_ids") or ())
+                        if skip >= len(ids) > 0:
+                            skip -= len(ids)
+                        else:
+                            # a token-less chunk (heartbeat/metadata)
+                            # must leave `skip` intact: the undelivered
+                            # prefix debt carries to the next chunk
+                            # that actually bears tokens
+                            if skip and ids:
+                                ids = ids[skip:]
+                                skip = 0
+                                msg = dict(msg)
+                                msg["token_ids"] = ids
+                                msg["text"] = self.tok.decode(ids)
+                            if ids:
+                                sent += len(ids)
+                                gen_ids.extend(ids)
+                                self.tele.emit(rid_req, len(ids))
+                            yield msg
+                        if kill_arm and n_chunks == 1:
+                            kill_arm = False
+                            self._kill_replica(rid)
+                except ServerBusy as e:
+                    # backpressure, NOT death: the replica is alive
+                    # and said so — never resteer (a storm would
+                    # otherwise read as a mass die-off). Set it aside
+                    # for this round and re-route: the next-best
+                    # replica may have a free slot RIGHT NOW, and
+                    # sleeping the busy one's hint while a peer has
+                    # capacity is the routing mistake a fleet exists
+                    # to avoid. Only an all-busy round waits (above).
+                    busy_excl.add(rid)
+                    busy_hint_ms = (e.retry_after_ms
+                                    if busy_hint_ms is None
+                                    else min(busy_hint_ms,
+                                             e.retry_after_ms))
+                    continue
+                except OSError:
+                    done_msg = None
+                finally:
+                    with self._lock:
+                        self._inflight_by[rid] -= 1
+                if done_msg is None:
+                    # EOF without a done message IS the death verdict
+                    # (refusals and rejections always carry done) —
+                    # mark it out-of-band and re-serve the stream's
+                    # remainder elsewhere; greedy same-seed decoding
+                    # makes the splice bitwise seamless
+                    self.members.mark_dead(rid)
+                    self._c_resteer.inc()
+                    resteers += 1
+                    if n > 1 and sent > 0:
+                        # n>1 fork interleaving is not replayable
+                        # chunk-for-chunk: fail visibly rather than
+                        # splice wrong
+                        self.tele.retire(rid_req, "rejected")
+                        yield {"done": True, "n_tokens": sent,
+                               "error": "replica died mid-stream "
+                                        "(n>1 streams cannot be "
+                                        "spliced)"}
+                        return
+                    continue
+                error = done_msg.get("error")
+                done = dict(done_msg)
+                done["n_tokens"] = sent
+                if resteers:
+                    done["resteered"] = resteers
+                self.tele.span("serve", t0, time.monotonic(),
+                               tid=self._tids.get(rid, 0),
+                               args={"rid": rid_req,
+                                     "replica": rid})
+                if error is None:
+                    # the retire event off the wire: the replica just
+                    # inserted this sequence into its prefix tree —
+                    # mirror it into the shadow so the NEXT request
+                    # sharing the prefix lands warm
+                    self.placement.note_retire(
+                        rid, tokens if n > 1 else np.concatenate(
+                            [tokens,
+                             np.asarray(gen_ids, np.int32)]))
+                    if session is not None:
+                        with self._lock:
+                            self.sessions[session] = rid
+                self.tele.retire(rid_req,
+                                 "retired" if error is None
+                                 else "rejected")
+                yield done
+                return
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def run(self, prompt: str, **kw) -> dict:
+        """Convenience: drain one stream; returns {"token_ids": [...],
+        "done": <done message>}."""
+        ids: list = []
+        done: dict = {}
+        for msg in self.stream(prompt, **kw):
+            if msg.get("done"):
+                done = msg
+                break
+            ids.extend(msg.get("token_ids") or ())
+        return {"token_ids": ids, "done": done}
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Deep router-side snapshot: the labeled routing counters,
+        per-class goodput, health gauges, shadow/session occupancy —
+        same flat labeled-key shape as a scheduler stats()."""
+        reg = self.tele.registry
+        with self._lock:
+            frac = (self._n_prefix_hits / self._n_routed
+                    if self._n_routed else 0.0)
+        reg.gauge("router_prefix_hit_frac",
+                  "placement decisions that matched a warm "
+                  "prefix").set(round(frac, 4))
+        out = reg.snapshot()
+        out.update({
+            "policy": self.policy,
+            "router_prefix_hit_frac": round(frac, 4),
+            "routed_total": self._n_routed,
+            "resteers": self._c_resteer.value,
+            "inflight": self._inflight,
+            "sessions": len(self.sessions),
+            "shadow_entries": self.placement.shadow_sizes(),
+            "replicas": {
+                rid: {"healthy": self.members.healthy.get(rid, False),
+                      "host": replica.host, "port": replica.port,
+                      "probe_failures":
+                          self.members.probe_failures.get(rid, 0)}
+                for rid, replica in self.members.replicas.items()},
+            "slo_classes": {
+                name: {"ttft_target_ms": c.ttft_target_ms,
+                       "itl_target_ms": c.itl_target_ms,
+                       "priority": c.priority}
+                for name, c in self.tele.slo_classes.items()},
+        })
+        return out
+
+    def fleet_cache_stats(self) -> dict:
+        """Fleet-wide prefix-cache aggregate over the LIVE replicas'
+        stats probes: the cache-aware-placement win is
+        ``prefill_skip_frac`` here, router-on vs round-robin."""
+        skipped = prompt_tokens = 0
+        for rid in self.members.healthy_rids():
+            st = self.members.replicas[rid].stats()
+            skipped += int(st.get("prefill_tokens_skipped", 0))
+            prompt_tokens += int(st.get("prompt_tokens", 0))
+        return {
+            "prefill_tokens_skipped": skipped,
+            "prompt_tokens": prompt_tokens,
+            "prefill_skip_frac":
+                skipped / max(prompt_tokens, 1),
+        }
+
+    def export(self) -> dict:
+        """ONE merged fleet trace: the router's own timeline (flow
+        arrows route→replica-admit, per-replica serve spans) plus
+        every in-process replica's scheduler trace spliced onto offset
+        tracks, timestamps rebased onto the router's clock so the
+        cross-plane ordering is real."""
+        out = self.tele.export()
+        events = list(out["traceEvents"])
+        requests = dict(out.get("requests", {}))
+        for i, (rid, replica) in enumerate(
+                self.members.replicas.items()):
+            sched = getattr(getattr(replica, "server", None),
+                            "sched", None)
+            tele = getattr(sched, "tele", None)
+            if tele is None or not tele.trace:
+                continue
+            sub = tele.export()
+            base = 64 * (i + 1)
+            dt_us = (tele._t0 - self.tele._t0) * 1e6
+            for ev in sub["traceEvents"]:
+                ev = dict(ev)
+                ev["tid"] = base + int(ev.get("tid", 0))
+                if "ts" in ev:
+                    ev["ts"] = round(ev["ts"] + dt_us, 1)
+                if ev.get("ph") == "M":
+                    ev = dict(ev, args={
+                        "name": f"{rid}:{ev['args']['name']}"})
+                events.append(ev)
+            for k, v in sub.get("requests", {}).items():
+                requests[f"{rid}:{k}"] = v
+        out["traceEvents"] = events
+        out["requests"] = requests
+        return out
+
+    def dump_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.export(), f)
+
+    def shutdown(self) -> None:
+        """Gracefully stop every replica that exposes stop()."""
+        for replica in self.members.replicas.values():
+            stop = getattr(replica, "stop", None)
+            if stop is not None:
+                try:
+                    stop()
+                except Exception:
+                    pass

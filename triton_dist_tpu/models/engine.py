@@ -30,6 +30,7 @@ Backends (reference backend strings engine.py:126-135):
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
@@ -267,7 +268,14 @@ class Engine:
         self._prefill_slot = progs["prefill_slot"]
         self._write_slot = progs["write_slot"]
         # persistent 1-row scratch for prefill_into_slot, donated
-        # through each admission instead of reallocated per request
+        # through each admission instead of reallocated per request.
+        # The scratch is engine-owned while caches are caller-owned,
+        # so when several servers share one engine (fleet replicas),
+        # concurrent admissions would donate the SAME scratch buffer
+        # twice — the lock serializes the scratch-donating section
+        # only (decode ticks touch caller-owned state and stay
+        # lock-free).
+        self._scratch_lock = threading.Lock()
         self._slot_scratch = None
         self._paged_slot_scan = progs["paged_slot_scan"]
         self._paged_admit = progs["paged_admit"]
@@ -414,13 +422,15 @@ class Engine:
         # (max_seq need not be a pad_to multiple)
         P = min(-(-n // pad_to) * pad_to, self.max_seq)
         padded = jnp.zeros((1, P), jnp.int32).at[0, :n].set(ids)
-        if self._slot_scratch is None:
-            self._slot_scratch = self.model.make_cache(
-                1, self.max_seq, dtype=self.kv_dtype)
-        logits, self._slot_scratch = self._prefill_slot(
-            self.model, padded, self._slot_scratch, jnp.int32(n - 1))
-        cache = self._write_slot(cache, self._slot_scratch,
-                                 jnp.int32(slot))
+        with self._scratch_lock:
+            if self._slot_scratch is None:
+                self._slot_scratch = self.model.make_cache(
+                    1, self.max_seq, dtype=self.kv_dtype)
+            logits, self._slot_scratch = self._prefill_slot(
+                self.model, padded, self._slot_scratch,
+                jnp.int32(n - 1))
+            cache = self._write_slot(cache, self._slot_scratch,
+                                     jnp.int32(slot))
         return logits[0], cache
 
     def slot_chunk(self, logits, cache, pos, active, *, chunk: int,
@@ -862,19 +872,21 @@ class Engine:
         s = n - m
         P = -(-s // pad_to) * pad_to
         padded = jnp.zeros((1, P), jnp.int32).at[0, :s].set(ids[m:])
-        scr = self._paged_scratch
-        if scr is None or scr.k[0].shape[2] != T_pool + pad_to:
-            # scratch holds [prefix | suffix bucket]; the + pad_to tail
-            # keeps the bucketed DUS in range at every kv_start
-            self._paged_scratch = self.model.make_cache(
-                1, T_pool + pad_to, dtype=self.kv_dtype)
         self._c_prefills.inc()
-        logits, self._paged_scratch, pcache = self._paged_admit(
-            self.model, padded, self._paged_scratch, pcache,
-            jnp.asarray(rows, jnp.int32), jnp.int32(slot),
-            jnp.int32(m), jnp.int32(n),
-            jnp.asarray(cow_src, jnp.int32),
-            jnp.asarray(cow_dst, jnp.int32), jnp.int32(cow_rows))
+        with self._scratch_lock:
+            scr = self._paged_scratch
+            if scr is None or scr.k[0].shape[2] != T_pool + pad_to:
+                # scratch holds [prefix | suffix bucket]; the + pad_to
+                # tail keeps the bucketed DUS in range at every
+                # kv_start
+                self._paged_scratch = self.model.make_cache(
+                    1, T_pool + pad_to, dtype=self.kv_dtype)
+            logits, self._paged_scratch, pcache = self._paged_admit(
+                self.model, padded, self._paged_scratch, pcache,
+                jnp.asarray(rows, jnp.int32), jnp.int32(slot),
+                jnp.int32(m), jnp.int32(n),
+                jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32), jnp.int32(cow_rows))
         return logits[0], pcache
 
     def paged_slot_chunk(self, logits, pcache, pos, active, *,

@@ -197,7 +197,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from triton_dist_tpu.runtime.telemetry import Telemetry, \
-    trace_env_enabled
+    UNTAGGED_PRIORITY, trace_env_enabled
 from triton_dist_tpu.models.structured import NO_FORCED, \
     constrained_draft, window_masks
 
@@ -698,6 +698,22 @@ class DecodeSlots:
             return base
         return base + req.gen_len - int(self.remaining[slot])
 
+    def slo_priority(self, slot: int) -> float:
+        """Protection rank of the slot's request: its SLO class's
+        configured ``priority`` (runtime/telemetry.py::_SloClass —
+        interactive 2.0 / batch 0.0 by default), UNTAGGED_PRIORITY for
+        requests with no tag. SLO-aware policies (victim choice,
+        prefill-budget splits) displace the LOWEST rank first; when
+        every live request shares one rank the priority key is constant
+        and those policies degenerate bitwise to the class-blind
+        orderings (tests/test_resilience.py asserts this)."""
+        req = self.reqs[slot]
+        slo = req.slo if req is not None else None
+        if slo is None:
+            return UNTAGGED_PRIORITY
+        cls = self.tele.slo_classes.get(slo)
+        return cls.priority if cls is not None else UNTAGGED_PRIORITY
+
     def emitted_since_admit(self, slot: int) -> int:
         """Tokens streamed since this slot's CURRENT admission (a
         resumed request's pre-preemption span excluded — gen_len is
@@ -1186,11 +1202,15 @@ class DecodeSlots:
         return out, finished
 
     def _build_mixed_window(self, budget: int):
-        """One mixed tick's window: prefill chunk rows split FIFO by
-        admission order under the token budget (q_len 0 = starved, no
-        progress). ONE copy of the split arithmetic, shared by the
-        sync step and the overlap dispatch. Returns (tokens, q_lens,
-        pf mask, {slot: chunk len})."""
+        """One mixed tick's window: prefill chunk rows split by SLO
+        protection rank (highest class first — an interactive prompt
+        absorbs budget before a batch one), FIFO by admission order
+        within a rank, under the token budget (q_len 0 = starved, no
+        progress). Uniform classes make the rank key constant, so the
+        split is the original pure-FIFO one bitwise. ONE copy of the
+        split arithmetic, shared by the sync step and the overlap
+        dispatch. Returns (tokens, q_lens, pf mask,
+        {slot: chunk len})."""
         S = max(int(budget), (self.spec + 1) if self.spec else 1)
         tokens = np.zeros((self.batch, S), np.int32)
         q_lens = np.ones((self.batch,), np.int32)
@@ -1198,7 +1218,8 @@ class DecodeSlots:
         left = int(budget)
         chunks: Dict[int, int] = {}
         for b in sorted(self.prefill_slots,
-                        key=lambda b: self.admit_tick[b]):
+                        key=lambda b: (-self.slo_priority(b),
+                                       self.admit_tick[b])):
             ids = self._pf_ids[b]
             off = int(self._pf_off[b])
             c = min(len(ids) - off, left, S)
@@ -2261,7 +2282,8 @@ class ContinuousScheduler:
                 "device_wait_s_by_kind": by_kind,
                 "slo_classes": {
                     name: {"ttft_target_ms": c.ttft_target_ms,
-                           "itl_target_ms": c.itl_target_ms}
+                           "itl_target_ms": c.itl_target_ms,
+                           "priority": c.priority}
                     for name, c in self.tele.slo_classes.items()},
             })
             if self._hang is not None:
@@ -2480,12 +2502,17 @@ class ContinuousScheduler:
                 if slots.emitted_since_admit(b) > 0]
 
     def _pick_victim(self, candidates: List[int]) -> int:
-        """Preemption victim policy: fewest generated tokens (least
-        recompute thrown away — the long-running streams finish), ties
-        to the most recently admitted (it displaced the least)."""
+        """Preemption victim policy: lowest SLO protection rank first
+        (a "batch" stream is displaced before an "interactive" one —
+        DecodeSlots.slo_priority; uniform classes collapse the leading
+        key and the choice is the class-blind one bitwise), then fewest
+        generated tokens (least recompute thrown away — the
+        long-running streams finish), ties to the most recently
+        admitted (it displaced the least)."""
         slots = self.slots
         return min(candidates,
-                   key=lambda b: (slots.emitted(b),
+                   key=lambda b: (slots.slo_priority(b),
+                                  slots.emitted(b),
                                   -int(slots.admit_tick[b])))
 
     def _preempt_for(self, rid, preempted_now: set, reason: str, *,

@@ -78,26 +78,47 @@ class FaultInjector:
     forward, before delivery — so the job's staging pages must be
     released by the worker's own cleanup and the request must retry.
     The zero-leak invariant must hold on BOTH the staging and decode
-    pools throughout (tests/test_disagg.py)."""
+    pools throughout (tests/test_disagg.py).
+
+    FLEET faults (the traffic plane — fleet/router.py):
+    ``kill_replicas`` names 0-based router DISPATCH indices (every
+    consult of the ``router_dispatch`` hook counts — one per routed
+    request attempt, resteers included) at which the chosen replica is
+    killed MID-STREAM: the router arms the kill and pulls the
+    replica's listener down right after the first relayed chunk, so
+    the in-flight request must be re-served to completion on a
+    surviving replica (the resteer path) with zero-leak pool
+    invariants everywhere. ``slow_replicas`` names 0-based PROBE
+    indices (every consult of ``router_probe`` counts) at which a
+    health probe behaves as timed out — the membership layer must mark
+    the replica unhealthy and route around it until a clean probe
+    readmits it (tests/test_fleet.py)."""
 
     def __init__(self, *, exhaust_admissions: Iterable[int] = (),
                  exhaust_host_demotions: Iterable[int] = (),
                  drop_transfers: Iterable[int] = (),
                  dup_transfers: Iterable[int] = (),
-                 kill_prefills: Iterable[int] = ()):
+                 kill_prefills: Iterable[int] = (),
+                 kill_replicas: Iterable[int] = (),
+                 slow_replicas: Iterable[int] = ()):
         self.exhaust_admissions = {int(i) for i in exhaust_admissions}
         self.exhaust_host_demotions = {int(i)
                                        for i in exhaust_host_demotions}
         self.drop_transfers = {int(i) for i in drop_transfers}
         self.dup_transfers = {int(i) for i in dup_transfers}
         self.kill_prefills = {int(i) for i in kill_prefills}
+        self.kill_replicas = {int(i) for i in kill_replicas}
+        self.slow_replicas = {int(i) for i in slow_replicas}
         self.admissions_seen = 0
         self.host_demotions_seen = 0
         self.transfers_seen = 0
         self.prefills_seen = 0
+        self.router_dispatches_seen = 0
+        self.router_probes_seen = 0
         self.injected = {"pool_exhausted": 0, "host_exhausted": 0,
                          "transfer_drop": 0, "transfer_dup": 0,
-                         "prefill_death": 0}
+                         "prefill_death": 0, "replica_kill": 0,
+                         "probe_slow": 0}
 
     def admission(self, req) -> None:
         i = self.admissions_seen
@@ -144,6 +165,32 @@ class FaultInjector:
         self.prefills_seen += 1
         if i in self.kill_prefills:
             self.injected["prefill_death"] += 1
+            return True
+        return False
+
+    def router_dispatch(self, replica_id) -> Optional[str]:
+        """Consulted by the fleet router once per routed dispatch
+        attempt (resteers included), AFTER placement chose
+        ``replica_id``. Returns "kill" — the router kills that replica
+        mid-stream (right after the first relayed chunk) so the resteer
+        path must re-serve the request elsewhere — or None (dispatch
+        normally)."""
+        i = self.router_dispatches_seen
+        self.router_dispatches_seen += 1
+        if i in self.kill_replicas:
+            self.injected["replica_kill"] += 1
+            return "kill"
+        return None
+
+    def router_probe(self, replica_id) -> bool:
+        """Consulted by the membership layer once per health probe of
+        ``replica_id``; True = the probe behaves as TIMED OUT (the
+        replica is slow/partitioned — mark it unhealthy and route
+        around it without touching its process)."""
+        i = self.router_probes_seen
+        self.router_probes_seen += 1
+        if i in self.slow_replicas:
+            self.injected["probe_slow"] += 1
             return True
         return False
 

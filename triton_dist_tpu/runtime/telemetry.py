@@ -388,9 +388,20 @@ def prometheus_text(*registries: MetricsRegistry) -> str:
 # Schedulers override via configure_slo / ContinuousScheduler(
 # slo_classes=...); targets are milliseconds.
 DEFAULT_SLO_CLASSES = {
-    "interactive": {"ttft_target_ms": 200.0, "itl_target_ms": 100.0},
-    "batch": {"ttft_target_ms": 30000.0, "itl_target_ms": 5000.0},
+    "interactive": {"ttft_target_ms": 200.0, "itl_target_ms": 100.0,
+                    "priority": 2.0},
+    "batch": {"ttft_target_ms": 30000.0, "itl_target_ms": 5000.0,
+              "priority": 0.0},
 }
+
+# Protection rank for requests with NO slo tag (and ad-hoc classes
+# registered without a "priority" target): between the default "batch"
+# (0) and "interactive" (2) classes, so untagged traffic is displaced
+# before a human-facing stream but after throughput work. A workload
+# whose requests all share one class (or are all untagged) sees equal
+# priorities everywhere, so every priority-leading sort degenerates to
+# the class-blind ordering — the bitwise-differential contract.
+UNTAGGED_PRIORITY = 1.0
 
 
 class _SloClass:
@@ -398,8 +409,8 @@ class _SloClass:
     handles (created once at configure time, so the emit/retire hot
     paths never take the registry lock)."""
 
-    __slots__ = ("name", "ttft_target_ms", "itl_target_ms", "h_ttft",
-                 "h_itl", "c_good", "c_viol")
+    __slots__ = ("name", "ttft_target_ms", "itl_target_ms", "priority",
+                 "h_ttft", "h_itl", "c_good", "c_viol")
 
     def __init__(self, name: str, targets: dict, registry):
         self.name = name
@@ -407,6 +418,11 @@ class _SloClass:
             targets.get("ttft_target_ms", math.inf))
         self.itl_target_ms = float(
             targets.get("itl_target_ms", math.inf))
+        # protection rank: SLO-aware schedulers (preemption-victim
+        # choice, prefill-budget splits, router shedding) displace the
+        # LOWEST priority first
+        self.priority = float(targets.get("priority",
+                                          UNTAGGED_PRIORITY))
         lb = {"slo": name}
         self.h_ttft = registry.histogram(
             "ttft_ms", "queued -> first token, per request",

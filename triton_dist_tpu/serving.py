@@ -172,7 +172,8 @@ class TokenServer:
                  disagg: bool = False, prefill_workers: int = 1,
                  disagg_threads: bool = True, transport=None,
                  slo_classes: Optional[dict] = None,
-                 max_forks: int = 8):
+                 max_forks: int = 8,
+                 replica_id: Optional[str] = None):
         """paged=True serves over the paged KV pool with the
         shared-prefix radix cache (models/prefix_cache.py): concurrent
         prompts sharing a system-prompt/few-shot prefix reuse its
@@ -268,7 +269,17 @@ class TokenServer:
         a malformed grammar all get the structured {"done", error}
         refusal with the parse error echoed — never a crashed poll
         loop. Fork chunks are tagged {"fork": k} and the n streams
-        share ONE fan-in done message once every fork finishes."""
+        share ONE fan-in done message once every fork finishes.
+
+        replica_id names this server inside a FLEET (fleet/router.py):
+        when set, every done message and stats() snapshot carries
+        ``"replica"`` — the retire event a router's shadow placement
+        index consumes — and `{"op": "stats"}` doubles as the identity
+        handshake of a membership health probe. Requests may also tag a
+        ``"session"`` field (any string up to 128 chars): the server
+        accepts and ignores it, the ROUTER uses it for session
+        affinity, so one client codepath speaks to both a bare server
+        and a fleet."""
         from triton_dist_tpu.models.disagg import DisaggScheduler
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
@@ -301,6 +312,7 @@ class TokenServer:
                 host_pool_pages=host_pool_pages, overlap=overlap,
                 trace=trace, slo_classes=slo_classes)
         self.max_forks = max_forks
+        self.replica_id = replica_id
         self._vocab = None       # lazy byte vocab for grammar compiles
         self._poll_ema = 0.05    # measured poll cadence, seeds retry_after
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -445,6 +457,15 @@ class TokenServer:
                 deadline_ms = req.get("deadline_ms")
                 if deadline_ms is not None:
                     deadline_ms = float(deadline_ms)
+                session = req.get("session")
+                if session is not None:
+                    # accepted (and bounded) so one client codepath
+                    # works against a bare server and a fleet router;
+                    # affinity itself is ROUTER state (fleet/router.py)
+                    if not isinstance(session, str) or \
+                            len(session) > 128:
+                        raise ValueError(
+                            "session must be a string of <= 128 chars")
                 slo = req.get("slo")
                 if slo is not None:
                     slo = str(slo)
@@ -624,7 +645,10 @@ class TokenServer:
         /metrics listener, test hammers) can iterate and serialize it
         while the driver keeps polling."""
         with self._lock:
-            return self.sched.stats()
+            st = self.sched.stats()
+        if self.replica_id is not None:
+            st["replica_id"] = self.replica_id
+        return st
 
     def _finish(self, rid, error: Optional[str] = None) -> bool:
         """Close out one finished rid; returns True when the client
@@ -646,6 +670,10 @@ class TokenServer:
         try:
             if not cs.dead:
                 msg = {"done": True, "n_tokens": cs.n}
+                if self.replica_id is not None:
+                    # fleet identity echo: the router feeds its shadow
+                    # placement index from this retire event
+                    msg["replica"] = self.replica_id
                 if reason is not None:
                     # a scheduler-rejected request (pool exhausted,
                     # over capacity) must not look like a legitimate
@@ -769,6 +797,7 @@ def request_stream(host: str, port: int, prompt: str, *,
                    timeout: float = 300.0,
                    deadline_ms: Optional[float] = None,
                    slo: Optional[str] = None,
+                   session: Optional[str] = None,
                    n: int = 1, grammar: Optional[dict] = None,
                    connect_retries: int = 8,
                    connect_backoff_s: float = 0.05,
@@ -800,6 +829,10 @@ def request_stream(host: str, port: int, prompt: str, *,
         payload["deadline_ms"] = deadline_ms
     if slo is not None:
         payload["slo"] = slo
+    if session is not None:
+        # affinity hint: a bare server validates and ignores it; a
+        # fleet router (fleet/router.py) pins the session to a replica
+        payload["session"] = session
     connects = 0
     busy_left = busy_retries
     while True:
