@@ -1474,6 +1474,54 @@ def _bench():
         "backend": jax.default_backend(),
     })
 
+    # --- fleet HA rows (triton_dist_tpu/fleet/ha.py): (a) failover
+    # recovery — kill the active router mid-stream (chaos
+    # kill_routers arm) and report the journal-splice promotion
+    # latency the client rode through without seeing an error; (b)
+    # exactly-once dedup — resubmit K COMPLETED request_ids and report
+    # the fraction answered straight from the dedup window (1.0 means
+    # every retry cost zero re-served tokens). Both rows ride the same
+    # capture + history ledger, so bench_compare gates failover
+    # latency (ms, lower better) and dedup coverage (frac, higher
+    # better) like any other metric.
+    from triton_dist_tpu.fleet import ReplicatedRouter
+    from triton_dist_tpu.runtime.chaos import FaultInjector
+
+    ha_fault = FaultInjector(kill_routers=[1])
+    ha_pair = ReplicatedRouter(
+        [InprocReplica(f"ha{i}", eng_f, fl_tok, batch=2, chunk=4,
+                       paged=True, page=fs_page) for i in range(2)],
+        fl_tok, fault=ha_fault)
+    try:
+        ha_ids = [f"bench-ha-{i}" for i in range(4)]
+        for i, rid in enumerate(ha_ids):         # first serve (the
+            ha_pair.run(f"ha bench {i}",         # kill fires in req 0)
+                        gen_len=fl_gen, seed=i, request_id=rid)
+        ha_st = ha_pair.stats()
+        _emit_json({
+            "metric": "failover_recovery_ms",
+            "value": ha_st["last_failover_ms"],
+            "unit": "ms",
+            "failover_count": ha_st["failover_count"],
+            "replayed_requests": ha_st["replayed_requests"],
+            "journal_entries": ha_st.get("journal_entries"),
+            "backend": jax.default_backend(),
+        })
+        for rid in ha_ids:                       # exactly-once retry
+            ha_pair.run("retry ignored", gen_len=fl_gen, seed=0,
+                        request_id=rid)
+        ha_hits = ha_pair.stats()["dedup_hits"] - ha_st["dedup_hits"]
+        _emit_json({
+            "metric": "dedup_hit_rate",
+            "value": round(ha_hits / len(ha_ids), 4),
+            "unit": "frac",
+            "retries": len(ha_ids),
+            "dedup_hits": ha_hits,
+            "backend": jax.default_backend(),
+        })
+    finally:
+        ha_pair.shutdown()
+
     # roofline rows: per-kernel achieved/SOL fractions from
     # tools/perf_report, into the same capture + history ledger so
     # bench_compare --strict gates on same-backend roofline

@@ -1,0 +1,559 @@
+"""Fleet high availability (triton_dist_tpu/fleet/ha.py): replicated
+router failover, the durable request journal with exactly-once replay,
+and per-replica circuit breakers.
+
+The contracts pinned here:
+- CircuitBreaker is a real closed/open/half-open machine: exactly
+  `fail_threshold` consecutive failures (failed probes, mid-stream
+  errors, or a probe-latency EMA above threshold — the brownout
+  signal) trip it; `cooldown_probes` later it half-opens and admits
+  ONE trial whose verdict closes or re-opens it.
+- ChaosSchedule is replayable: the same seed yields the identical
+  fault sequence regardless of rates-dict insertion order.
+- RequestJournal survives compaction and a process restart: tail() is
+  incremental, compact() keeps live state + the dedup window and bumps
+  the generation, a file-backed journal rebuilds from disk, and a
+  WarmStandby that sees the generation move resyncs from offset 0.
+- Killing the ACTIVE router mid-stream (chaos kill_routers) is
+  invisible to the client: ReplicatedRouter promotes the warm standby
+  and the journal-watermark splice makes the stream BITWISE identical
+  to a no-failover run. A retried request_id after completion is
+  served from the dedup window (suffix only — never a second serve).
+- A partitioned replica (chaos partition_replicas) resteers like a
+  death but READMITS on the next clean probe — the process survived.
+- The promoted router inherits the shadow prefix index: a repeated
+  prompt routes warm (reason="prefix") through the NEW router.
+- The seeded HA soak (kill_routers + kill_replicas + slow_replicas +
+  partition_replicas + drop/dup transfers under one ChaosSchedule)
+  ends with zero lost and zero duplicated tokens, dedup hits asserted,
+  and `available + outstanding == num_pages` on every survivor.
+
+Heavy arms are marked slow (tier-1 budget — tools/ha_smoke.sh runs the
+full matrix).
+"""
+
+import json
+
+import jax
+import pytest
+
+from triton_dist_tpu.fleet import (FleetRouter, InprocReplica,
+                                   ReplicatedRouter, RequestJournal,
+                                   WarmStandby)
+from triton_dist_tpu.fleet.ha import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                      BREAKER_OPEN, BreakerConfig,
+                                      CircuitBreaker)
+from triton_dist_tpu.models import AutoLLM, Engine
+from triton_dist_tpu.models.config import tiny_qwen3
+from triton_dist_tpu.runtime.chaos import ChaosSchedule, FaultInjector
+from triton_dist_tpu.serving import ByteTokenizer
+
+mesh1 = None
+_STATE = {}
+
+PAGE, CHUNK = 8, 4
+
+
+def setup_module(module):
+    global mesh1
+    mesh1 = jax.make_mesh((1,), ("tp",))
+
+
+def _engine():
+    if "eng" not in _STATE:
+        cfg = tiny_qwen3(1)
+        model = AutoLLM.from_config(cfg, mesh1)
+        _STATE["eng"] = (cfg, Engine(model, max_seq=64, backend="xla"),
+                         ByteTokenizer(cfg.vocab_size))
+    return _STATE["eng"]
+
+
+def _replicas(n, prefix, *, fault=None, disagg_last=False):
+    cfg, eng, tok = _engine()
+    reps = []
+    for i in range(n):
+        kw = {}
+        if disagg_last and i == n - 1:
+            kw = {"disagg": True, "fault": fault}
+        reps.append(InprocReplica(f"{prefix}{i}", eng, tok, batch=2,
+                                  chunk=CHUNK, paged=True, page=PAGE,
+                                  **kw))
+    return reps, tok
+
+
+def _assert_no_leak(replica):
+    sched = replica.server.sched
+    pool = sched.slots.prefix.pool
+    assert pool.available + pool.outstanding == pool.num_pages
+    assert not sched.slots.occupied
+
+
+# ----------------------------------------------------------------------
+# circuit breaker state machine (pure host logic)
+# ----------------------------------------------------------------------
+
+def test_breaker_trips_open_on_threshold():
+    seen = []
+    br = CircuitBreaker(BreakerConfig(fail_threshold=3),
+                        on_transition=seen.append)
+    for i in range(2):
+        br.record_probe(False, 0.01)
+        assert br.state == BREAKER_CLOSED, i
+    br.record_probe(False, 0.01)
+    assert br.state == BREAKER_OPEN
+    assert br.trips == 1
+    assert not br.routable() and not br.admit()
+    assert seen == [BREAKER_OPEN]
+    # a healthy probe string resets the consecutive counter
+    br2 = CircuitBreaker(BreakerConfig(fail_threshold=3))
+    br2.record_probe(False, 0.01)
+    br2.record_probe(False, 0.01)
+    br2.record_probe(True, 0.01)
+    br2.record_probe(False, 0.01)
+    br2.record_probe(False, 0.01)
+    assert br2.state == BREAKER_CLOSED
+
+
+def test_breaker_half_open_trial_success_and_failure():
+    cfg = BreakerConfig(fail_threshold=1, cooldown_probes=2)
+    br = CircuitBreaker(cfg)
+    br.record_error()
+    assert br.state == BREAKER_OPEN
+    br.record_probe(True, 0.01)
+    assert br.state == BREAKER_OPEN          # still cooling down
+    br.record_probe(True, 0.01)
+    assert br.state == BREAKER_HALF_OPEN
+    # exactly ONE trial slot, claimed atomically
+    assert br.routable() and br.admit()
+    assert not br.routable() and not br.admit()
+    br.record_success()
+    assert br.state == BREAKER_CLOSED
+    assert br.readmissions == 1
+    assert br.ema_latency_s is None          # fresh slate after close
+    # the failure arm: the trial's error re-opens immediately
+    br.record_error()
+    br.record_probe(True, 0.01)
+    br.record_probe(True, 0.01)
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.admit()
+    br.record_error()
+    assert br.state == BREAKER_OPEN
+    assert br.trips == 3        # open, re-open, failed-trial re-open
+
+
+def test_breaker_latency_ema_brownout_and_decay():
+    cfg = BreakerConfig(fail_threshold=2, latency_threshold_s=1.0,
+                        ema_alpha=0.5)
+    br = CircuitBreaker(cfg)
+    # healthy verdicts, browned-out latency: the EMA is the signal
+    br.record_probe(True, 4.0)
+    assert br.ema_latency_s == pytest.approx(4.0)
+    br.record_probe(True, 4.0)
+    assert br.state == BREAKER_OPEN          # 2 EMA-over-threshold fails
+    # EMA geometric decay with alpha=0.5: 4 -> 2 -> 1; once the EMA
+    # decays back to the threshold the failure streak RESETS (the
+    # fail_threshold=3 headroom keeps the breaker closed meanwhile)
+    cfg3 = BreakerConfig(fail_threshold=3, latency_threshold_s=1.0,
+                         ema_alpha=0.5)
+    br2 = CircuitBreaker(cfg3)
+    br2.record_probe(True, 4.0)
+    br2.record_probe(True, 0.0)
+    assert br2.ema_latency_s == pytest.approx(2.0)
+    br2.record_probe(True, 0.0)
+    assert br2.ema_latency_s == pytest.approx(1.0)
+    assert br2.state == BREAKER_CLOSED       # decayed back under
+    assert br2.snapshot()["consecutive_failures"] == 0
+
+
+def test_breaker_release_trial_and_config_validation():
+    br = CircuitBreaker(BreakerConfig(fail_threshold=1,
+                                      cooldown_probes=1))
+    br.record_error()
+    br.record_probe(True, 0.01)
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.admit()
+    br.release_trial()                       # busy reroute: no verdict
+    assert br.admit()                        # slot is free again
+    with pytest.raises(ValueError):
+        BreakerConfig(fail_threshold=0)
+    with pytest.raises(ValueError):
+        BreakerConfig(ema_alpha=0.0)
+
+
+# ----------------------------------------------------------------------
+# seeded chaos schedules (pure host logic)
+# ----------------------------------------------------------------------
+
+def test_chaos_schedule_same_seed_identical_fires():
+    rates = {"kill_replicas": 0.3, "kill_routers": 0.15,
+             "slow_replicas": 0.4}
+    a = ChaosSchedule(1234, horizon=64, rates=rates)
+    b = ChaosSchedule(1234, horizon=64, rates=dict(
+        reversed(list(rates.items()))))      # insertion order flipped
+    assert a.fires == b.fires
+    assert a.describe() == b.describe()
+    json.dumps(a.describe())                 # repro is copy/pasteable
+    c = ChaosSchedule(1235, horizon=64, rates=rates)
+    assert a.fires != c.fires                # a new seed moves the draw
+    inj = a.injector(drop_transfers=[7])
+    assert inj.kill_replicas == set(a.fires["kill_replicas"])
+    assert inj.kill_routers == set(a.fires["kill_routers"])
+    assert inj.drop_transfers == {7}
+    with pytest.raises(ValueError):
+        ChaosSchedule(0, rates={"no_such_arm": 0.5})
+    with pytest.raises(ValueError):
+        ChaosSchedule(0, rates={"kill_routers": 1.5})
+
+
+def test_fault_injector_partition_and_router_chunk_arms():
+    inj = FaultInjector(partition_replicas=[1], kill_routers=[2])
+    assert inj.router_dispatch("r0") is None
+    assert inj.router_dispatch("r0") == "partition"
+    assert inj.injected["replica_partition"] == 1
+    assert [inj.router_chunk() for _ in range(4)] == [
+        False, False, True, False]
+    assert inj.injected["router_kill"] == 1
+
+
+# ----------------------------------------------------------------------
+# the durable journal + warm standby (pure host logic)
+# ----------------------------------------------------------------------
+
+def test_journal_tail_and_compact_keeps_live_state():
+    j = RequestJournal(keep_done=1)
+    j.append({"e": "member", "rid": "r0", "host": "h", "port": 1,
+              "ok": True})
+    j.append({"e": "member", "rid": "r0", "host": "h", "port": 1,
+              "ok": False})
+    for i in range(3):
+        j.append({"e": "route", "id": f"q{i}", "client": True,
+                  "replica": "r0", "prompt": "p", "gen_len": 4,
+                  "seed": 0, "slo": None, "session": None, "n": 1,
+                  "resteer": 0})
+    j.append({"e": "wm", "id": "q0", "n": 2})
+    j.append({"e": "wm", "id": "q0", "n": 4})
+    j.append({"e": "done", "id": "q1", "client": True,
+              "replica": "r0", "tokens": [1], "error": None,
+              "done_msg": {"done": True}})
+    j.append({"e": "done", "id": "q2", "client": True,
+              "replica": "r0", "tokens": [2], "error": None,
+              "done_msg": {"done": True}})
+    ents, off = j.tail(0)
+    assert len(ents) == len(j) == 9
+    more, off2 = j.tail(off)
+    assert more == [] and off2 == off
+    dropped = j.compact()
+    assert dropped > 0 and j.generation == 1
+    kept = j.entries()
+    # latest member only; in-flight q0 keeps its LATEST watermark;
+    # keep_done=1 keeps q2 (route + done) and evicts completed q1
+    members = [e for e in kept if e["e"] == "member"]
+    assert members == [{"e": "member", "rid": "r0", "host": "h",
+                        "port": 1, "ok": False}]
+    ids = {e["id"] for e in kept if e["e"] == "route"}
+    assert ids == {"q0", "q2"}
+    wms = [e for e in kept if e["e"] == "wm"]
+    assert wms == [{"e": "wm", "id": "q0", "n": 4}]
+    assert {e["id"] for e in kept if e["e"] == "done"} == {"q2"}
+
+
+def test_journal_file_roundtrip(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = RequestJournal(path, keep_done=8)
+    j.append({"e": "member", "rid": "r0", "host": "h", "port": 9,
+              "ok": True})
+    j.append({"e": "route", "id": "a", "client": True,
+              "replica": "r0", "prompt": "p", "gen_len": 4, "seed": 0,
+              "slo": None, "session": None, "n": 1, "resteer": 0})
+    j.append({"e": "wm", "id": "a", "n": 3})
+    j.compact()
+    j.append({"e": "wm", "id": "a", "n": 5})
+    j.close()
+    # crash recovery: a fresh process resumes log AND generation
+    j2 = RequestJournal(path)
+    assert j2.generation == 1
+    assert j2.entries() == j.entries()
+    j2.close()
+
+
+def test_journal_rotate_every_autocompacts():
+    j = RequestJournal(rotate_every=4, keep_done=2)
+    for i in range(12):
+        j.append({"e": "route", "id": f"x{i}", "client": False,
+                  "replica": "r0", "prompt": "p", "gen_len": 1,
+                  "seed": 0, "slo": None, "session": None, "n": 1,
+                  "resteer": 0})
+        j.append({"e": "done", "id": f"x{i}", "client": False,
+                  "replica": "r0", "tokens": [], "error": None,
+                  "done_msg": {"done": True}})
+    assert len(j) <= 8                       # bounded, not unbounded
+    assert j.generation >= 1
+
+
+def test_warm_standby_rebuild_and_generation_resync():
+    tok = ByteTokenizer(256)
+    j = RequestJournal()
+    sb = WarmStandby(tok, j)
+    j.append({"e": "member", "rid": "r0", "host": "h", "port": 9,
+              "ok": True})
+    j.append({"e": "route", "id": "a", "client": True,
+              "replica": "r0", "prompt": "hi", "gen_len": 4,
+              "seed": 0, "slo": None, "session": "s1", "n": 1,
+              "resteer": 0})
+    j.append({"e": "wm", "id": "a", "n": 2})
+    assert sb.lag == 3
+    assert sb.poll() == 3 and sb.lag == 0
+    assert sb.roster["r0"]["port"] == 9
+    assert sb.sessions == {"s1": "r0"}
+    assert sb.dedup["a"]["wm"] == 2
+    j.append({"e": "done", "id": "a", "client": True,
+              "replica": "r0", "tokens": [5, 6], "error": None,
+              "done_msg": {"done": True, "n_tokens": 2}})
+    sb.poll()
+    assert sb.dedup["a"]["tokens"] == [5, 6]
+    assert sb.dedup["a"]["done"]["done"] is True
+    # shadow rebuilt: prompt tokens + generation inserted for r0
+    assert sb.placement.shadow_sizes().get("r0", 0) >= 1
+    # compaction moves the generation -> the standby resyncs from 0
+    j.compact()
+    j.append({"e": "member", "rid": "r1", "host": "h", "port": 10,
+              "ok": True})
+    assert sb.lag == len(j)
+    sb.poll()
+    assert set(sb.roster) == {"r0", "r1"}
+    assert sb.dedup["a"]["wm"] == 2          # re-applied, not lost
+
+
+# ----------------------------------------------------------------------
+# trace_view surfaces the HA instants
+# ----------------------------------------------------------------------
+
+def test_trace_view_ha_events_section():
+    import tools.trace_view as tv
+    dump = {"traceEvents": [
+        {"ph": "i", "name": "replica_death", "ts": 1.0, "tid": 0,
+         "s": "g"},
+        {"ph": "i", "name": "breaker_open", "ts": 2.0, "tid": 0,
+         "s": "g"},
+        {"ph": "i", "name": "breaker_close", "ts": 3.0, "tid": 0,
+         "s": "g"},
+        {"ph": "i", "name": "router_failover", "ts": 4.0, "tid": 0,
+         "s": "g"},
+        {"ph": "i", "name": "kv_push", "ts": 5.0, "tid": 0, "s": "g"},
+    ], "requests": {}, "metrics": {}}
+    a = tv.analyze(dump)
+    assert a["ha_events"] == {"replica_death": 1, "breaker_open": 1,
+                              "breaker_close": 1, "router_failover": 1}
+    text = tv.summarize(dump)
+    assert "fleet ha events:" in text
+    assert "router_failover=1" in text
+
+
+# ----------------------------------------------------------------------
+# failover (engine-backed): kill the router mid-stream
+# ----------------------------------------------------------------------
+
+def test_router_kill_failover_bitwise_with_dedup():
+    reps0, tok = _replicas(2, "hb")
+    base = FleetRouter(reps0, tok)
+    ref = base.run("hello ha", gen_len=12, seed=3)["token_ids"]
+    assert len(ref) == 12
+    base.shutdown()
+
+    fault = FaultInjector(kill_routers=[1])
+    reps, tok = _replicas(2, "hk")
+    pair = ReplicatedRouter(reps, tok, fault=fault, trace=True)
+    out = pair.run("hello ha", gen_len=12, seed=3,
+                   request_id="req-1")
+    assert out["done"].get("error") is None, out["done"]
+    # BITWISE: the journal-watermark splice across the promoted
+    # standby reproduces the no-failover stream exactly
+    assert out["token_ids"] == ref
+    st = pair.stats()
+    assert st["failover_count"] == 1
+    assert st["replayed_requests"] == 1
+    assert fault.injected["router_kill"] == 1
+    assert st["journal_entries"] > 0
+
+    # exactly-once: a retried submit of the COMPLETED id is answered
+    # from the dedup window — zero new tokens, dedup-tagged done
+    out2 = pair.run("hello ha", gen_len=12, seed=3,
+                    request_id="req-1")
+    assert out2["token_ids"] == []
+    assert out2["done"].get("dedup") is True
+    assert out2["done"]["n_tokens"] == 12
+    assert pair.stats()["dedup_hits"] == 1
+
+    # a SECOND router kill fails over again (fresh standby re-armed)
+    fault.kill_routers.add(fault.router_chunks_seen + 1)
+    out3 = pair.run("hello ha again", gen_len=12, seed=3)
+    assert out3["done"].get("error") is None
+    assert pair.stats()["failover_count"] == 2
+
+    # the merged trace carries the failover instant across generations
+    dump = pair.export()
+    instants = [e for e in dump["traceEvents"] if e.get("ph") == "i"]
+    assert any(e["name"] == "router_failover" for e in instants)
+    for r in reps:
+        _assert_no_leak(r)
+    pair.shutdown()
+
+
+@pytest.mark.slow
+def test_partition_resteers_then_clean_probe_readmits():
+    fault = FaultInjector(partition_replicas=[0])
+    reps, tok = _replicas(2, "hp")
+    router = FleetRouter(reps, tok, fault=fault)
+    ref_router = FleetRouter(reps, tok, breakers=False)
+    ref = ref_router.run("partition me", gen_len=8,
+                         seed=1)["token_ids"]
+    out = router.run("partition me", gen_len=8, seed=1)
+    assert out["done"].get("error") is None
+    assert out["done"].get("resteered") == 1
+    assert out["token_ids"] == ref
+    assert fault.injected["replica_partition"] == 1
+    # the partitioned replica's PROCESS survived: one clean probe
+    # readmits it (unlike a kill)
+    assert router.probe() == {"hp0": True, "hp1": True}
+    br = router.stats()["breakers"]["hp0"]
+    assert br["state"] == "closed"
+    for r in reps:
+        _assert_no_leak(r)
+    router.shutdown()
+
+
+@pytest.mark.slow
+def test_promoted_router_inherits_shadow_and_sessions():
+    reps, tok = _replicas(2, "hw")
+    journal = RequestJournal()
+    router = FleetRouter(reps, tok, journal=journal)
+    warm = "the warm prompt we will repeat"
+    out = router.run(warm, gen_len=8, seed=0, session="sess-a")
+    assert out["done"].get("error") is None
+    warm_rid = router.sessions["sess-a"]
+    sb = WarmStandby(tok, journal, replicas=reps)
+    promoted = sb.promote(name="rt1")
+    # the standby rebuilt the shadow index from the journal alone:
+    # the repeat routes to the SAME warm replica, reason "prefix"
+    out2 = promoted.run(warm, gen_len=8, seed=0)
+    assert out2["done"].get("error") is None
+    snap = promoted.stats()
+    key = f"routed_requests{{reason=prefix,replica={warm_rid}}}"
+    assert snap.get(key, 0) >= 1, sorted(
+        k for k in snap if k.startswith("routed_requests"))
+    assert promoted.sessions.get("sess-a") == warm_rid
+    promoted.shutdown()
+
+
+# ----------------------------------------------------------------------
+# breaker brownout drain + readmission (engine-backed, slow)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_breaker_brownout_drains_then_halfopen_readmits():
+    # slow EVERY ho0 probe for the first 3 rounds: probe order is
+    # registration order, so ho0's consults land on even indices
+    # (0 = the ctor probe, then 2 and 4)
+    fault = FaultInjector(slow_replicas=[0, 2, 4])
+    reps, tok = _replicas(2, "ho")
+    router = FleetRouter(
+        reps, tok, fault=fault,
+        breaker_config=BreakerConfig(fail_threshold=2,
+                                     cooldown_probes=1,
+                                     latency_threshold_s=30.0))
+    router.probe()      # ho0's 2nd consecutive slow probe -> open
+    assert router.stats()["breakers"]["ho0"]["state"] == "open"
+    # browned-out replica DRAINED: traffic still flows via ho1
+    out = router.run("during brownout", gen_len=8, seed=0)
+    assert out["done"].get("error") is None
+    snap = router.stats()
+    assert not any("ho0" in k for k in snap
+                   if k.startswith("routed_requests"))
+    # one more probe period ticks the cooldown -> half-open; a CLEAN
+    # probe then readmits membership, and the next request IS the
+    # trial — its success closes the breaker (readmission)
+    router.probe()
+    assert router.stats()["breakers"]["ho0"]["state"] == "half_open"
+    router.probe()      # consult 6: clean -> membership healthy again
+    assert router.members.healthy["ho0"] is True
+    out2 = router.run("trial request lands here", gen_len=8, seed=0)
+    assert out2["done"].get("error") is None
+    br = router.stats()["breakers"]["ho0"]
+    assert br["state"] == "closed"
+    assert br["readmissions"] == 1
+    for r in reps:
+        _assert_no_leak(r)
+    router.shutdown()
+
+
+# ----------------------------------------------------------------------
+# the seeded HA soak (slow): every arm at once, replayable by seed
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ha_soak_seeded_zero_lost_zero_duplicated():
+    prompts = [f"soak prompt {i % 3}" for i in range(10)]
+    # reference streams from a clean fleet (no chaos, no failover)
+    ref_reps, tok = _replicas(4, "hr", disagg_last=True)
+    ref_router = FleetRouter(ref_reps, tok, breakers=False)
+    refs = [ref_router.run(p, gen_len=10, seed=7)["token_ids"]
+            for p in prompts]
+    ref_router.shutdown()
+
+    sched = ChaosSchedule(20240807, horizon=200, rates={
+        "kill_routers": 0.06, "kill_replicas": 0.04,
+        "partition_replicas": 0.08, "slow_replicas": 0.1,
+        "drop_transfers": 0.2, "dup_transfers": 0.2})
+    fault = sched.injector()
+    # one injector drives EVERY plane: the router's kill/partition/
+    # probe arms AND the disagg replica's transfer drop/dup arms
+    reps, tok = _replicas(4, "hs", fault=fault, disagg_last=True)
+    pair = ReplicatedRouter(
+        reps, tok, fault=fault,
+        breaker_config=BreakerConfig(fail_threshold=3,
+                                     cooldown_probes=1,
+                                     latency_threshold_s=2.0))
+    got = []
+    for i, p in enumerate(prompts):
+        out = pair.run(p, gen_len=10, seed=7, request_id=f"soak-{i}")
+        assert out["done"].get("error") is None, (i, out["done"])
+        got.append(out["token_ids"])
+        # a probe round per request: clean probes readmit partitioned
+        # replicas and walk open breakers through their cooldown
+        pair.probe()
+    # zero lost, zero duplicated: bitwise against the clean fleet
+    assert got == refs
+    # retried ids are dedup hits, not second serves
+    for i in (0, 4, 9):
+        out = pair.run(prompts[i], gen_len=10, seed=7,
+                       request_id=f"soak-{i}")
+        assert out["token_ids"] == []
+        assert out["done"].get("dedup") is True
+    st = pair.stats()
+    assert st["dedup_hits"] == 3
+    desc = sched.describe()                  # the repro line
+    assert ChaosSchedule(20240807, horizon=200,
+                         rates=sched.rates).describe() == desc
+    # clean probe rounds walk every tripped breaker to half-open;
+    # the trial REQUEST is what closes it — steer one at each
+    # half-open replica via a session pin (readmission under load,
+    # not by decree)
+    for _ in range(6):
+        pair.probe()
+    for rid, br in sorted(pair.stats()["breakers"].items()):
+        if br["state"] == "closed" \
+                or not pair.active.members.healthy.get(rid):
+            continue
+        pair.active.sessions[f"readmit-{rid}"] = rid
+        out = pair.run(f"readmit {rid}", gen_len=6, seed=1,
+                       session=f"readmit-{rid}")
+        assert out["done"].get("error") is None
+    for rid, br in pair.stats()["breakers"].items():
+        if pair.active.members.healthy.get(rid, False):
+            assert br["state"] == "closed", (rid, br)
+    # the zero-leak invariant on every SURVIVING pool
+    killed = {r.rid for r in reps if r.server._stop.is_set()}
+    for r in reps:
+        if r.rid not in killed:
+            _assert_no_leak(r)
+    pair.shutdown()

@@ -385,6 +385,29 @@ def test_bench_compare_fleet_row_directions():
         == "higher-is-better"
 
 
+def test_bench_compare_ha_row_directions():
+    """ISSUE 19 satellite: the two fleet HA bench rows resolve to the
+    right regression direction — `failover_recovery_ms` (unit "ms",
+    the standby-promotion latency: UP = regressed) and
+    `dedup_hit_rate` (unit "frac", the exactly-once window's retry
+    absorption: DOWN = regressed)."""
+    bc = _load_tool("bench_compare")
+    a = [{"metric": "failover_recovery_ms", "value": 12.0,
+          "unit": "ms", "backend": "tpu"},
+         {"metric": "dedup_hit_rate", "value": 1.0,
+          "unit": "frac", "backend": "tpu"}]
+    b = [{"metric": "failover_recovery_ms", "value": 48.0,
+          "unit": "ms", "backend": "tpu"},
+         {"metric": "dedup_hit_rate", "value": 0.25,
+          "unit": "frac", "backend": "tpu"}]
+    res = {r["metric"]: r for r in bc.compare(a, b)}
+    assert res["failover_recovery_ms"]["flag"] == "regressed"
+    assert res["failover_recovery_ms"]["direction"] \
+        == "lower-is-better"
+    assert res["dedup_hit_rate"]["flag"] == "regressed"
+    assert res["dedup_hit_rate"]["direction"] == "higher-is-better"
+
+
 def test_bench_compare_history_mode(tmp_path):
     """--history groups the ledger by run id and diffs the last two
     runs."""

@@ -244,3 +244,25 @@ def test_token_server_cancel_on_disconnect():
     # would have inserted
     st = srv.stats()
     assert st["pages_in_use"] < 15, st
+
+
+def test_full_jitter_backoff_distribution():
+    """request_stream's retry backoff is FULL-jitter (uniform over
+    [0, delay]): N clients that lost their router at the same instant
+    must not reconnect in lockstep, so the draws have to actually
+    spread — not just scale the deterministic delay."""
+    import random
+
+    from triton_dist_tpu.serving import full_jitter
+
+    rng = random.Random(0)
+    draws = [full_jitter(0.8, rand=rng.random) for _ in range(2000)]
+    assert all(0.0 <= d <= 0.8 for d in draws)
+    # uniform over [0, 0.8]: mean ~0.4, and the tails are inhabited
+    mean = sum(draws) / len(draws)
+    assert abs(mean - 0.4) < 0.02, mean
+    assert min(draws) < 0.08 and max(draws) > 0.72
+    assert len({round(d, 6) for d in draws}) > 1900   # not quantized
+    # degenerate delays stay degenerate (and never go negative)
+    assert full_jitter(0.0, rand=rng.random) == 0.0
+    assert full_jitter(-1.0, rand=rng.random) == 0.0

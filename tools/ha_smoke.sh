@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Fleet HA smoke — the full high-availability matrix
+# (tests/test_fleet_ha.py including the slow arms: the partition ->
+# resteer -> readmit cycle, the promoted-router shadow/session
+# inheritance, the latency-brownout drain, and the seeded chaos soak)
+# plus the HA satellites riding in other modules (the full-jitter
+# backoff distribution, the bench_compare HA-row directions). This is
+# the focused loop for iterating on triton_dist_tpu/fleet/ha.py and
+# the router/breaker surgery alone; tier-1 (tools/tier1.sh) runs only
+# the lean arms under its 870 s budget. Archives the pass count next
+# to the log and reports the delta vs the previous run, tier1.sh-style.
+# Run from the repo root: bash tools/ha_smoke.sh
+set -o pipefail
+rm -f /tmp/_ha_smoke.log
+# NO `-m 'not slow'` here: this loop exists to run the FULL HA matrix,
+# including the arms tier-1's budget pushes behind the slow mark (the
+# chaos soak alone replays a 200-coin schedule over a live fleet).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_fleet_ha.py \
+    "tests/test_serving.py::test_full_jitter_backoff_distribution" \
+    "tests/test_observability.py::test_bench_compare_ha_row_directions" \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_ha_smoke.log
+rc=${PIPESTATUS[0]}
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_ha_smoke.log | tr -cd . | wc -c)
+last_file=/tmp/_ha_smoke.last
+if [ -f "$last_file" ]; then
+    last=$(cat "$last_file")
+    delta=$((passed - last))
+    [ "$delta" -ge 0 ] && delta="+$delta"
+    echo "HA_SMOKE_PASSED=$passed (prev $last, delta $delta)"
+else
+    echo "HA_SMOKE_PASSED=$passed"
+fi
+echo "$passed" > "$last_file"
+exit $rc

@@ -15,7 +15,10 @@ This CLI reads one dump and prints:
   kv_install arrow chains) with per-request transfer latency,
 - a per-request table (status, tokens, ttft_ms, transfer_ms) plus the
   ttft_ms / inter_token_ms histogram summary from the embedded
-  metrics snapshot.
+  metrics snapshot,
+- the fleet HA event line (replica_death / breaker_open /
+  breaker_close / router_failover instants) so a resteer or failover
+  is visible in the terminal report, not only in perfetto.
 
 Usage: python tools/trace_view.py /path/to/trace.json [--top 5]
        python tools/trace_view.py /path/to/trace.json --json
@@ -129,6 +132,14 @@ def analyze(dump: dict, top_k: int = 5) -> dict:
         out["instants"][e["name"]] = out["instants"].get(
             e["name"], 0) + 1
 
+    # fleet HA events pulled out of the generic instant counts: the
+    # resteer/failover story of a merged fleet trace, otherwise
+    # invisible among the kv_push/kv_install traffic
+    _HA = ("replica_death", "breaker_open", "breaker_close",
+           "router_failover")
+    out["ha_events"] = {k: out["instants"][k] for k in _HA
+                        if k in out["instants"]}
+
     # flow chains (cross-plane request journeys): group by id, order
     # by ts; transfer latency = last push step -> the "f" arrowhead
     # (kv_install). rid rides in args on every event of a chain.
@@ -213,6 +224,12 @@ def summarize(dump: dict, top_k: int = 5) -> str:
     if a["instants"]:
         out.append("instants: " + "  ".join(
             f"{k}={v}" for k, v in sorted(a["instants"].items())))
+
+    # HA timeline events (replica deaths, breaker trips/readmissions,
+    # router failovers) — the "what went wrong and when" line
+    if a.get("ha_events"):
+        out.append("fleet ha events: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(a["ha_events"].items())))
 
     # cross-plane flow chains (disagg: route -> compute -> kv_push ->
     # kv_install per request)

@@ -19,7 +19,18 @@ replica that already holds their KV:
   interactive), and mid-stream failover that re-serves a killed
   replica's requests to completion via the deterministic-splice
   resteer.
+- ha.py — the high-availability tier: a durable RequestJournal the
+  router appends route/watermark/done records to, a WarmStandby that
+  tails it to keep a promotable shadow of the router's state, a
+  ReplicatedRouter pairing active + standby with bitwise stream
+  resumption across failover, per-replica CircuitBreakers
+  (closed/open/half-open on probe-latency EMA + mid-stream errors),
+  and the exactly-once request_id dedup window.
 """
+from triton_dist_tpu.fleet.ha import (BreakerConfig, CircuitBreaker,
+                                      RemoteReplica, ReplicatedRouter,
+                                      RequestJournal, RouterDied,
+                                      WarmStandby)
 from triton_dist_tpu.fleet.membership import (InprocReplica,
                                               Membership,
                                               SubprocReplica,
@@ -28,6 +39,8 @@ from triton_dist_tpu.fleet.placement import (PlacementIndex,
                                              ShadowPrefixIndex)
 from triton_dist_tpu.fleet.router import FleetRouter
 
-__all__ = ["FleetRouter", "InprocReplica", "Membership",
-           "PlacementIndex", "ShadowPrefixIndex", "SubprocReplica",
-           "probe_stats"]
+__all__ = ["BreakerConfig", "CircuitBreaker", "FleetRouter",
+           "InprocReplica", "Membership", "PlacementIndex",
+           "RemoteReplica", "ReplicatedRouter", "RequestJournal",
+           "RouterDied", "ShadowPrefixIndex", "SubprocReplica",
+           "WarmStandby", "probe_stats"]

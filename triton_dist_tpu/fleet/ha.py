@@ -1,0 +1,640 @@
+"""Fleet high availability: journaled routers, failover, breakers.
+
+PR 18's traffic plane left the router itself a single point: a router
+crash dropped every in-flight stream even though every replica
+underneath survived, and an ambiguous client retry could double-serve
+a request. This module closes that hole the way production disagg
+fleets do (Mooncake's conductor tier, DistServe's placement plane —
+PAPERS.md): the router's SOFT state is a durable append-only JOURNAL,
+and everything above it is rebuildable.
+
+- RequestJournal — the durable log: membership transitions, route
+  decisions (with the request parameters needed to re-serve), one
+  emitted-token WATERMARK entry per relayed chunk (one poll's worth of
+  tokens — never per token), and done records carrying the full
+  generated sequence for the bounded dedup window. Optionally
+  file-backed (JSONL, flushed per append) so a fresh process can
+  rebuild a router from disk; compact() is the rotation story — it
+  rewrites the log down to live state (latest membership, in-flight
+  routes + watermarks, the last `keep_done` completed requests) and
+  bumps `generation` so a tailing standby knows to resync.
+
+- CircuitBreaker — per-replica closed/open/half-open hysteresis ON TOP
+  of membership's binary health verdict. Fed by probe latency (EMA)
+  and mid-stream error counts: a browned-out replica (slow-not-dead,
+  the `slow_replicas` chaos arm) trips the breaker after
+  `fail_threshold` consecutive failures and DRAINS — no new traffic,
+  in-flight streams finish — instead of flapping healthy/dead with
+  every alternating probe. After `cooldown_probes` probe periods the
+  breaker goes half-open and admits exactly ONE trial request; the
+  trial's outcome closes the breaker (re-admission) or re-opens it.
+
+- ReplicatedRouter — the client surface of the HA pair: an active
+  FleetRouter journaling into the log plus a WarmStandby tailing it.
+  When chaos (`kill_routers`) kills the active router mid-stream,
+  every in-flight stream raises RouterDied; the first one through
+  promotes the standby (rebuilding the shadow prefix index, session
+  pins, membership view and dedup window from the journal) and the
+  stream is RE-ISSUED under the same request_id — the promoted router
+  finds the journal watermark and re-serves with that skip debt, so
+  the spliced stream is bitwise identical to a no-failover run (the
+  PR-18 resteer splice, generalized to router death). A fresh standby
+  is re-armed after every promotion, so repeated router kills under a
+  ChaosSchedule keep failing over.
+
+Exactly-once: a client-supplied `request_id` makes a request
+idempotent. While it is in flight the router journals its watermark;
+after an ambiguous EOF a retried submit resumes at the watermark
+(`replayed_requests`), and a retry of a COMPLETED request is answered
+straight from the dedup window (`dedup_hits`) — the undelivered suffix
+plus the recorded done, never a second serve.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from triton_dist_tpu.fleet.membership import probe_stats
+from triton_dist_tpu.fleet.placement import PlacementIndex
+
+
+class RouterDied(RuntimeError):
+    """The active router was killed (chaos `kill_routers`): every
+    in-flight stream raises this at its next chunk, and new stream()
+    calls raise it at entry. ReplicatedRouter catches it, promotes the
+    standby, and resumes the stream against the journal watermark."""
+
+
+# ----------------------------------------------------------------------
+# the durable request journal
+# ----------------------------------------------------------------------
+
+
+class RequestJournal:
+    """Append-only router journal (thread-safe; optionally JSONL
+    file-backed). Entries are flat dicts tagged by "e":
+
+      {"e": "member", "rid", "host", "port", "ok"}   health transition
+      {"e": "route", "id", "client", "replica", "prompt", "gen_len",
+       "seed", "slo", "session", "n", "resteer"}     route decision
+      {"e": "wm", "id", "n"}         delivered-token watermark (one per
+                                     relayed chunk — one poll's tokens)
+      {"e": "done", "id", "client", "replica", "tokens", "error",
+       "done_msg"}                   completion (the dedup record)
+
+    tail(offset) is the standby's incremental read; compact() is
+    rotation — it rewrites the log down to live state and bumps
+    `generation` (a tailing standby that sees the generation move
+    resets and re-applies from offset 0). With `rotate_every` set,
+    append() auto-compacts past that many entries."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 rotate_every: Optional[int] = None,
+                 keep_done: int = 256):
+        self.path = path
+        self.rotate_every = rotate_every
+        self.keep_done = int(keep_done)
+        self.generation = 0
+        self._entries: List[dict] = []
+        self._lock = threading.Lock()
+        self._f = None
+        if path is not None:
+            if os.path.exists(path):
+                # crash recovery: a fresh process resumes the log
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ent = json.loads(line)
+                        if ent.get("e") == "gen":
+                            self.generation = int(ent["n"])
+                        else:
+                            self._entries.append(ent)
+            self._f = open(path, "a")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def append(self, entry: dict) -> None:
+        with self._lock:
+            self._entries.append(entry)
+            if self._f is not None:
+                self._f.write(json.dumps(entry) + "\n")
+                self._f.flush()
+            if self.rotate_every is not None \
+                    and len(self._entries) > self.rotate_every:
+                self._compact_locked()
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return list(self._entries)
+
+    def tail(self, offset: int):
+        """Entries appended since `offset` plus the new offset — the
+        standby's incremental read."""
+        with self._lock:
+            return list(self._entries[offset:]), len(self._entries)
+
+    def compact(self) -> int:
+        """Rotation: rewrite the log down to live state. Keeps the
+        latest member entry per replica, every surviving route with
+        its latest watermark (in-flight AND completed — a completed
+        request's watermark is the delivered count a post-rotation
+        retry resumes against), and the last `keep_done` completed
+        requests (route + done — the durable dedup window). Returns
+        the number of entries dropped; bumps `generation`."""
+        with self._lock:
+            return self._compact_locked()
+
+    def _compact_locked(self) -> int:
+        members: "OrderedDict[str, dict]" = OrderedDict()
+        routes: "OrderedDict[str, dict]" = OrderedDict()
+        wms: Dict[str, dict] = {}
+        dones: "OrderedDict[str, dict]" = OrderedDict()
+        for ent in self._entries:
+            e = ent.get("e")
+            if e == "member":
+                members[ent["rid"]] = ent
+            elif e == "route":
+                routes[ent["id"]] = ent
+            elif e == "wm":
+                wms[ent["id"]] = ent
+            elif e == "done":
+                dones[ent["id"]] = ent
+                dones.move_to_end(ent["id"])
+        kept_done = list(dones.items())[-self.keep_done:]
+        kept_ids = {i for i, _ in kept_done}
+        new: List[dict] = list(members.values())
+        for id_, route in routes.items():
+            if id_ in dones and id_ not in kept_ids:
+                continue            # evicted from the dedup window
+            new.append(route)
+            if id_ in wms:
+                # the latest watermark survives for COMPLETED requests
+                # too: it is the delivered count a post-rotation retry
+                # resumes against (dropping it would re-deliver the
+                # whole sequence as a "suffix")
+                new.append(wms[id_])
+        for _, done in kept_done:
+            new.append(done)
+        dropped = len(self._entries) - len(new)
+        self._entries = new
+        self.generation += 1
+        if self._f is not None:
+            self._f.close()
+            with open(self.path, "w") as f:
+                f.write(json.dumps({"e": "gen",
+                                    "n": self.generation}) + "\n")
+                for ent in new:
+                    f.write(json.dumps(ent) + "\n")
+            self._f = open(self.path, "a")
+        return dropped
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+# ----------------------------------------------------------------------
+# per-replica circuit breakers
+# ----------------------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+# breaker_state{replica=} gauge encoding
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0.0, BREAKER_HALF_OPEN: 1.0,
+                  BREAKER_OPEN: 2.0}
+
+
+class BreakerConfig:
+    """Breaker tuning. `fail_threshold` consecutive failures (failed
+    probes, mid-stream errors, or healthy probes whose latency EMA
+    sits above `latency_threshold_s` — the brownout signal) trip the
+    breaker open; `cooldown_probes` probe periods later it goes
+    half-open and admits one trial request."""
+
+    def __init__(self, *, fail_threshold: int = 3,
+                 latency_threshold_s: float = 1.0,
+                 ema_alpha: float = 0.5,
+                 cooldown_probes: int = 2):
+        if fail_threshold < 1:
+            raise ValueError(f"fail_threshold must be >= 1, "
+                             f"got {fail_threshold}")
+        if not 0.0 < ema_alpha <= 1.0:
+            raise ValueError(f"ema_alpha must be in (0, 1], "
+                             f"got {ema_alpha}")
+        self.fail_threshold = int(fail_threshold)
+        self.latency_threshold_s = float(latency_threshold_s)
+        self.ema_alpha = float(ema_alpha)
+        self.cooldown_probes = int(cooldown_probes)
+
+
+class CircuitBreaker:
+    """Closed / open / half-open hysteresis for one replica, layered
+    over membership's binary health verdict: routable = healthy AND
+    the breaker admits. `on_transition(new_state)` fires on every
+    state change (the router wires it to the `breaker_state{replica=}`
+    gauge and the `breaker_open` trace instant)."""
+
+    def __init__(self, config: Optional[BreakerConfig] = None, *,
+                 on_transition: Optional[Callable[[str], None]] = None):
+        self.cfg = config or BreakerConfig()
+        self.on_transition = on_transition
+        self.state = BREAKER_CLOSED
+        self.ema_latency_s: Optional[float] = None
+        self.trips = 0
+        self.readmissions = 0
+        self._fails = 0
+        self._cool = 0
+        self._trial = False
+        self._lock = threading.Lock()
+
+    # -- state transitions (call with self._lock held) -----------------
+
+    def _to(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        if state == BREAKER_OPEN:
+            self.trips += 1
+            self._cool = 0
+            self._trial = False
+        elif state == BREAKER_CLOSED:
+            self.readmissions += 1
+            self._fails = 0
+            self._trial = False
+            self.ema_latency_s = None
+        if self.on_transition is not None:
+            self.on_transition(state)
+
+    def _failure(self) -> None:
+        self._fails += 1
+        if self._fails >= self.cfg.fail_threshold:
+            self._to(BREAKER_OPEN)
+
+    # -- inputs --------------------------------------------------------
+
+    def record_probe(self, ok: bool, latency_s: float) -> None:
+        """One membership probe result. A chaos-slowed probe reports
+        ok=False with the probe timeout as its latency, so both
+        failure signals (the verdict and the EMA) move together."""
+        with self._lock:
+            a = self.cfg.ema_alpha
+            self.ema_latency_s = (
+                latency_s if self.ema_latency_s is None
+                else (1.0 - a) * self.ema_latency_s + a * latency_s)
+            if self.state == BREAKER_OPEN:
+                self._cool += 1
+                if self._cool >= self.cfg.cooldown_probes:
+                    self._to(BREAKER_HALF_OPEN)
+                return
+            if self.state == BREAKER_HALF_OPEN:
+                return              # the trial request decides, not probes
+            if not ok or self.ema_latency_s \
+                    > self.cfg.latency_threshold_s:
+                self._failure()
+            else:
+                self._fails = 0
+
+    def record_error(self) -> None:
+        """A mid-stream death or unreachable dispatch. In half-open
+        this IS the trial verdict: re-open."""
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._to(BREAKER_OPEN)
+            elif self.state == BREAKER_CLOSED:
+                self._failure()
+
+    def record_success(self) -> None:
+        """A dispatch that came back with a done message (the replica
+        is alive and serving, whatever the request-level verdict). In
+        half-open this closes the breaker (re-admission)."""
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._to(BREAKER_CLOSED)
+            elif self.state == BREAKER_CLOSED \
+                    and (self.ema_latency_s is None
+                         or self.ema_latency_s
+                         <= self.cfg.latency_threshold_s):
+                self._fails = 0
+
+    # -- routing consults ----------------------------------------------
+
+    def routable(self) -> bool:
+        """Pure check for placement filtering: may traffic be routed
+        here right now?"""
+        with self._lock:
+            if self.state == BREAKER_CLOSED:
+                return True
+            if self.state == BREAKER_HALF_OPEN:
+                return not self._trial
+            return False
+
+    def admit(self) -> bool:
+        """Admission for a CHOSEN replica: True in closed; in
+        half-open, atomically claims the single trial slot (first
+        caller wins); False in open or when the trial is taken."""
+        with self._lock:
+            if self.state == BREAKER_CLOSED:
+                return True
+            if self.state == BREAKER_HALF_OPEN and not self._trial:
+                self._trial = True
+                return True
+            return False
+
+    def release_trial(self) -> None:
+        """The claimed trial never got a verdict (busy reroute) —
+        free the slot for the next candidate."""
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._trial = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state,
+                    "ema_latency_s": self.ema_latency_s,
+                    "consecutive_failures": self._fails,
+                    "trips": self.trips,
+                    "readmissions": self.readmissions}
+
+
+def breaker_gauge_value(state: str) -> float:
+    """The `breaker_state{replica=}` gauge encoding: 0 closed,
+    1 half-open, 2 open."""
+    return _BREAKER_GAUGE[state]
+
+
+# ----------------------------------------------------------------------
+# standby + failover
+# ----------------------------------------------------------------------
+
+
+class RemoteReplica:
+    """A replica handle rebuilt purely from journal member entries
+    (rid, host, port) — what a standby in a DIFFERENT process promotes
+    with. stats() is the in-protocol probe; there is no process to
+    kill() from here, so the chaos arm degrades to the mark-dead that
+    follows it."""
+
+    def __init__(self, rid: str, host: str, port: int):
+        self.rid = str(rid)
+        self.host = host
+        self.port = int(port)
+
+    def stats(self) -> dict:
+        return probe_stats(self.host, self.port)
+
+    def kill(self) -> None:
+        pass
+
+
+class WarmStandby:
+    """A standby router's state, kept warm by tailing the journal:
+    the shadow prefix index (rebuilt from route+done entries — the
+    standby re-tokenizes the journaled prompt and appends the recorded
+    generation), session pins, the membership roster, and the dedup
+    window with per-request watermarks. promote() turns it into a live
+    FleetRouter that adopts all of that, so failover costs one probe
+    round, not a cold cache."""
+
+    def __init__(self, tokenizer, journal: RequestJournal, *,
+                 replicas=(), max_entries_per_replica: int = 256):
+        self.tok = tokenizer
+        self.journal = journal
+        self.max_entries_per_replica = int(max_entries_per_replica)
+        self._live = {r.rid: r for r in replicas}
+        self.reset()
+
+    def reset(self) -> None:
+        """Start over from offset 0 (initial state, or the journal
+        compacted out from under us — generation moved)."""
+        self._offset = 0
+        self._gen = self.journal.generation
+        self.placement = PlacementIndex(
+            max_entries_per_replica=self.max_entries_per_replica)
+        self.sessions: Dict[str, str] = {}
+        self.dedup: "OrderedDict[str, dict]" = OrderedDict()
+        self.roster: "OrderedDict[str, dict]" = OrderedDict()
+        self._routes: Dict[str, dict] = {}
+
+    @property
+    def lag(self) -> int:
+        """journal_lag_entries: appended but not yet applied here."""
+        if self.journal.generation != self._gen:
+            return len(self.journal)
+        return max(0, len(self.journal) - self._offset)
+
+    def poll(self) -> int:
+        """Apply everything new; returns the entry count applied."""
+        if self.journal.generation != self._gen:
+            self.reset()
+        ents, self._offset = self.journal.tail(self._offset)
+        for ent in ents:
+            self._apply(ent)
+        return len(ents)
+
+    def _apply(self, ent: dict) -> None:
+        e = ent.get("e")
+        if e == "member":
+            self.roster[ent["rid"]] = {"host": ent["host"],
+                                       "port": ent["port"],
+                                       "ok": bool(ent.get("ok"))}
+        elif e == "route":
+            self._routes[ent["id"]] = ent
+            sess = ent.get("session")
+            if sess:
+                self.sessions[sess] = ent["replica"]
+            if ent.get("client"):
+                self.dedup.setdefault(
+                    ent["id"], {"wm": 0, "tokens": [], "done": None})
+        elif e == "wm":
+            rec = self.dedup.get(ent["id"])
+            if rec is not None:
+                rec["wm"] = int(ent["n"])
+        elif e == "done":
+            route = self._routes.get(ent["id"])
+            toks = list(ent.get("tokens") or ())
+            if ent.get("error") is None and route is not None:
+                seq = list(self.tok.encode(
+                    str(route.get("prompt", ""))) or [0])
+                if int(route.get("n", 1)) == 1:
+                    seq = seq + toks
+                self.placement.note_retire(
+                    ent["replica"], np.asarray(seq, np.int32))
+            if ent.get("client"):
+                rec = self.dedup.setdefault(
+                    ent["id"], {"wm": 0, "tokens": [], "done": None})
+                rec["tokens"] = toks
+                rec["done"] = dict(ent.get("done_msg") or
+                                   {"done": True,
+                                    "error": ent.get("error")})
+
+    def promote(self, *, fault=None, **router_kw):
+        """Build a live FleetRouter from this state: live replica
+        handles where we have them, RemoteReplica from the journaled
+        (host, port) otherwise. The new router probes on construction
+        (so a replica that died while we tailed reads unhealthy) and
+        adopts the rebuilt shadow/session/dedup state."""
+        from triton_dist_tpu.fleet.router import FleetRouter
+        self.poll()
+        reps = []
+        for rid, info in self.roster.items():
+            rep = self._live.get(rid)
+            if rep is None:
+                rep = RemoteReplica(rid, info["host"], info["port"])
+            reps.append(rep)
+        router = FleetRouter(reps, self.tok, journal=self.journal,
+                             fault=fault, **router_kw)
+        router.adopt_state(placement=self.placement,
+                           sessions=self.sessions, dedup=self.dedup)
+        return router
+
+
+class ReplicatedRouter:
+    """The HA pair: an active FleetRouter journaling every decision +
+    a WarmStandby tailing the journal. stream() is the client surface
+    — every request gets a request_id (client-supplied or
+    auto-assigned) so a router death mid-stream is survivable: catch
+    RouterDied, promote the standby, re-issue the same request_id, and
+    the journal watermark makes the splice bitwise exact. A fresh
+    standby is re-armed after each promotion."""
+
+    _MAX_FAILOVERS_PER_REQUEST = 8
+
+    def __init__(self, replicas, tokenizer, *,
+                 journal: Optional[RequestJournal] = None,
+                 fault=None, **router_kw):
+        from triton_dist_tpu.fleet.router import FleetRouter
+        self.journal = journal if journal is not None \
+            else RequestJournal()
+        self.tok = tokenizer
+        self.fault = fault
+        self._kw = dict(router_kw)
+        self._replicas = list(replicas)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.failovers = 0
+        self.last_failover_ms: Optional[float] = None
+        self.active = FleetRouter(replicas, tokenizer,
+                                  journal=self.journal, fault=fault,
+                                  **router_kw)
+        self.standby = self._arm_standby()
+        self._retired_routers: List[object] = []
+        self._sync_gauges()
+
+    def _arm_standby(self) -> WarmStandby:
+        return WarmStandby(
+            self.tok, self.journal, replicas=self._replicas,
+            max_entries_per_replica=self._kw.get(
+                "max_entries_per_replica", 256))
+
+    def _auto_id(self) -> str:
+        with self._lock:
+            self._next_id += 1
+            return f"ha{self._next_id}"
+
+    def _sync_gauges(self) -> None:
+        reg = self.active.tele.registry
+        reg.gauge("failover_count", "standby promotions after a "
+                  "router death").set(float(self.failovers))
+        reg.gauge("journal_lag_entries", "journal entries the warm "
+                  "standby has not applied yet").set(
+            float(self.standby.lag))
+
+    def stream(self, prompt: str, *,
+               request_id: Optional[str] = None, **kw):
+        """One request through the HA pair, surviving router death:
+        the stream a client sees is bitwise identical to a no-failover
+        run (journal-watermark splice)."""
+        rid = request_id if request_id is not None else self._auto_id()
+        for _ in range(self._MAX_FAILOVERS_PER_REQUEST):
+            active = self.active
+            try:
+                for msg in active.stream(prompt, request_id=rid, **kw):
+                    yield msg
+                    if msg.get("done"):
+                        return
+                return
+            except RouterDied:
+                self._failover(active)
+        raise RouterDied(
+            f"request {rid!r}: router kept dying "
+            f"({self._MAX_FAILOVERS_PER_REQUEST} failovers)")
+
+    def _failover(self, dead) -> None:
+        """Promote the standby (idempotent: racing streams that all
+        caught RouterDied promote once)."""
+        with self._lock:
+            if self.active is not dead:
+                return              # a peer already promoted
+            t0 = time.monotonic()
+            self.standby.poll()
+            kw = dict(self._kw)
+            # each generation journals internal ids under its own
+            # name scope — rt1.0 can never collide with rt0.0
+            kw["name"] = f"rt{len(self._retired_routers) + 1}"
+            new = self.standby.promote(fault=self.fault, **kw)
+            self._retired_routers.append(dead)
+            self.active = new
+            self.failovers += 1
+            self.last_failover_ms = round(
+                (time.monotonic() - t0) * 1e3, 3)
+            new.tele.instant("router_failover", f"gen={self.failovers}")
+            self.standby = self._arm_standby()
+            self._sync_gauges()
+
+    def run(self, prompt: str, **kw) -> dict:
+        ids: list = []
+        done: dict = {}
+        for msg in self.stream(prompt, **kw):
+            if msg.get("done"):
+                done = msg
+                break
+            ids.extend(msg.get("token_ids") or ())
+        return {"token_ids": ids, "done": done}
+
+    def probe(self):
+        return self.active.probe()
+
+    def stats(self) -> dict:
+        self.standby.poll()
+        self._sync_gauges()
+        out = self.active.stats()
+        out["failover_count"] = self.failovers
+        out["journal_lag_entries"] = self.standby.lag
+        out["journal_entries"] = len(self.journal)
+        out["last_failover_ms"] = self.last_failover_ms
+        return out
+
+    def fleet_cache_stats(self) -> dict:
+        return self.active.fleet_cache_stats()
+
+    def export(self) -> dict:
+        """One merged trace across router generations: the active
+        router's merged fleet trace plus every retired (killed)
+        router's events on offset tracks, rebased onto the active
+        clock."""
+        from triton_dist_tpu.runtime.telemetry import splice_trace
+        out = self.active.export()
+        for i, dead in enumerate(self._retired_routers):
+            splice_trace(
+                out, dead.tele.export(), tid_base=4096 * (i + 1),
+                label=f"rt{i}",
+                dt_us=(dead.tele._t0 - self.active.tele._t0) * 1e6)
+        return out
+
+    def shutdown(self) -> None:
+        self.active.shutdown()
+        self.journal.close()

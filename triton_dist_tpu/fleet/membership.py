@@ -38,6 +38,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
@@ -187,6 +188,13 @@ class Membership:
         self.probe_failures: Dict[str, int] = {}
         self.on_death: Optional[Callable[[str], None]] = None
         self.on_join: Optional[Callable[[str], None]] = None
+        # on_probe(rid, ok, latency_s) after EVERY probe verdict — the
+        # circuit breaker's EMA feed (fleet/ha.py). on_change(rid, ok)
+        # only on health TRANSITIONS — the HA journal's membership
+        # feed, so a standby can rebuild the roster from edges alone.
+        self.on_probe: Optional[
+            Callable[[str, bool, float], None]] = None
+        self.on_change: Optional[Callable[[str, bool], None]] = None
 
     def add(self, replica) -> bool:
         """Register + synchronously probe: a joiner that answers its
@@ -224,8 +232,13 @@ class Membership:
         echo matches is healthy; anything else is not."""
         replica = self.replicas[rid]
         ok = False
-        if not (self.fault is not None
-                and self.fault.router_probe(rid)):
+        t0 = time.monotonic()
+        if self.fault is not None and self.fault.router_probe(rid):
+            # a chaos-slowed probe is a TIMEOUT, and it must look like
+            # one to the breaker's latency EMA too — report the full
+            # timeout budget, not the instant chaos verdict
+            latency_s = self.probe_timeout_s
+        else:
             try:
                 st = probe_stats(replica.host, replica.port,
                                  timeout=self.probe_timeout_s)
@@ -238,8 +251,11 @@ class Membership:
                     ok = True
             except (OSError, ValueError):
                 ok = False
+            latency_s = time.monotonic() - t0
         if not ok:
             self.probe_failures[rid] += 1
+        if self.on_probe is not None:
+            self.on_probe(rid, ok, latency_s)
         self._set_health(rid, ok)
         return ok
 
@@ -258,6 +274,8 @@ class Membership:
             self.on_death(rid)
         if was is False and ok and self.on_join is not None:
             self.on_join(rid)
+        if was is not ok and self.on_change is not None:
+            self.on_change(rid, ok)
 
 
 def _main(argv: Optional[List[str]] = None) -> int:

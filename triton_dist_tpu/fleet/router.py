@@ -49,9 +49,13 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from collections import OrderedDict
+
+from triton_dist_tpu.fleet.ha import (BreakerConfig, CircuitBreaker,
+                                      RouterDied, breaker_gauge_value)
 from triton_dist_tpu.fleet.membership import Membership
 from triton_dist_tpu.fleet.placement import PlacementIndex
-from triton_dist_tpu.runtime.telemetry import (Telemetry,
+from triton_dist_tpu.runtime.telemetry import (Telemetry, splice_trace,
                                                UNTAGGED_PRIORITY)
 
 
@@ -69,7 +73,11 @@ class FleetRouter:
                  max_entries_per_replica: int = 256,
                  busy_retries: int = 8,
                  prefix_min_frac: float = 0.5,
-                 slo_classes: Optional[dict] = None):
+                 slo_classes: Optional[dict] = None,
+                 journal=None, dedup_window: int = 256,
+                 breakers: bool = True,
+                 breaker_config: Optional[BreakerConfig] = None,
+                 name: str = "rt0"):
         if policy not in ("prefix", "rr"):
             raise ValueError(f"unknown policy {policy!r} "
                              f"(choose 'prefix' or 'rr')")
@@ -83,15 +91,28 @@ class FleetRouter:
                              f"got {prefix_min_frac}")
         self.prefix_min_frac = float(prefix_min_frac)
         self.tok = tokenizer
+        self.name = str(name)
         self.tele = Telemetry(trace=trace)
         # router-level goodput partition + shed priorities (None =
         # DEFAULT_SLO_CLASSES; replicas should be configured with the
         # same map so wire validation matches)
         self.tele.configure_slo(slo_classes)
+        self.journal = journal
+        self.dedup_window = int(dedup_window)
+        # request_id -> {"wm": delivered watermark, "tokens": the full
+        # generated sequence, "done": the recorded done message (None
+        # while in flight)} — the exactly-once window (fleet/ha.py)
+        self._dedup: "OrderedDict[str, dict]" = OrderedDict()
+        self._killed = False
+        self._breaker_cfg = breaker_config or BreakerConfig()
+        self._breakers: Optional[Dict[str, CircuitBreaker]] = (
+            {} if breakers else None)
         self.members = Membership(probe_timeout_s=probe_timeout_s,
                                   fault=fault,
                                   registry=self.tele.registry)
         self.members.on_death = self._on_death
+        self.members.on_probe = self._on_probe
+        self.members.on_change = self._on_member_change
         self.placement = PlacementIndex(
             max_entries_per_replica=max_entries_per_replica)
         self.sessions: Dict[str, str] = {}
@@ -107,6 +128,12 @@ class FleetRouter:
         self._c_resteer = reg.counter(
             "resteer_count", "in-flight requests re-served on another "
             "replica after a mid-stream death")
+        self._c_dedup = reg.counter(
+            "dedup_hits", "retried request_ids answered from the "
+            "dedup window without a second serve")
+        self._c_replayed = reg.counter(
+            "replayed_requests", "in-flight request_ids resumed "
+            "against the journal watermark (skip-debt splice)")
         for replica in replicas:
             self.add_replica(replica)
 
@@ -119,6 +146,8 @@ class FleetRouter:
         the moment this returns True). A joiner sharing the fleet's
         TDTPU_AOT_CACHE warm-starts its programs, which is what makes
         this a probe period, not a compile."""
+        with self._lock:
+            self._breaker_for_locked(replica.rid)
         admitted = self.members.add(replica)
         with self._lock:
             self._inflight_by.setdefault(replica.rid, 0)
@@ -134,11 +163,75 @@ class FleetRouter:
     def _on_death(self, rid: str) -> None:
         # the replica's prefix tree died with it: a stale shadow (or
         # session pin) would keep steering traffic at a cold restart
+        self.tele.instant("replica_death", rid)
         self.placement.drop(rid)
         with self._lock:
             for sess in [s for s, r in self.sessions.items()
                          if r == rid]:
                 del self.sessions[sess]
+
+    # ------------------------------------------------------------------
+    # circuit breakers + journal feeds (fleet/ha.py)
+    # ------------------------------------------------------------------
+
+    def _breaker_for_locked(self, rid: str):
+        """The replica's breaker (created on first touch); None when
+        breakers are disabled. Caller holds self._lock."""
+        if self._breakers is None:
+            return None
+        br = self._breakers.get(rid)
+        if br is None:
+            br = self._breakers[rid] = CircuitBreaker(
+                self._breaker_cfg,
+                on_transition=lambda state, rid=rid:
+                    self._breaker_transition(rid, state))
+        return br
+
+    def _breaker(self, rid: str):
+        with self._lock:
+            return self._breaker_for_locked(rid)
+
+    def _breaker_transition(self, rid: str, state: str) -> None:
+        reg = self.tele.registry
+        reg.gauge("breaker_state",
+                  "per-replica circuit breaker: 0 closed, "
+                  "1 half-open, 2 open",
+                  labels={"replica": rid}).set(
+            breaker_gauge_value(state))
+        if state == "open":
+            reg.counter("breaker_trips", "breaker transitions to "
+                        "open (replica drained)").inc()
+            self.tele.instant("breaker_open", rid)
+        elif state == "closed":
+            self.tele.instant("breaker_close", rid)
+
+    def _on_probe(self, rid: str, ok: bool, latency_s: float) -> None:
+        br = self._breaker(rid)
+        if br is not None:
+            br.record_probe(ok, latency_s)
+
+    def _on_member_change(self, rid: str, ok: bool) -> None:
+        if self.journal is None:
+            return
+        replica = self.members.replicas.get(rid)
+        if replica is None:
+            return
+        self.journal.append({"e": "member", "rid": rid,
+                             "host": replica.host,
+                             "port": replica.port, "ok": bool(ok)})
+
+    def adopt_state(self, *, placement=None, sessions=None,
+                    dedup=None) -> None:
+        """Transplant standby-rebuilt soft state (fleet/ha.py
+        WarmStandby.promote): the shadow prefix index, session pins,
+        and the dedup window with its in-flight watermarks."""
+        with self._lock:
+            if placement is not None:
+                self.placement = placement
+            if sessions is not None:
+                self.sessions = dict(sessions)
+            if dedup is not None:
+                self._dedup = OrderedDict(dedup)
 
     def _kill_replica(self, rid: str) -> None:
         """Chaos arm (FaultInjector kill_replicas): pull the replica
@@ -161,7 +254,9 @@ class FleetRouter:
         session pin, then least in-flight, then registration order."""
         with self._lock:
             healthy = [r for r in self.members.healthy_rids()
-                       if r not in exclude]
+                       if r not in exclude
+                       and (self._breakers is None
+                            or self._breaker_for_locked(r).routable())]
             if not healthy:
                 return None, None
             self._n_routed += 1
@@ -199,6 +294,25 @@ class FleetRouter:
             "routed_requests", "placement decisions",
             labels={"replica": rid, "reason": reason}).inc()
 
+    def _jappend(self, entry: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(entry)
+
+    def _trim_dedup_locked(self) -> None:
+        """Bound the dedup window: evict the oldest COMPLETED records
+        past `dedup_window` (in-flight records must survive — their
+        watermark is the resume state)."""
+        completed = sum(1 for rec in self._dedup.values()
+                        if rec.get("done") is not None)
+        if completed <= self.dedup_window:
+            return
+        for key in list(self._dedup):
+            if completed <= self.dedup_window:
+                break
+            if self._dedup[key].get("done") is not None:
+                del self._dedup[key]
+                completed -= 1
+
     # ------------------------------------------------------------------
     # the client surface
     # ------------------------------------------------------------------
@@ -208,14 +322,32 @@ class FleetRouter:
                session: Optional[str] = None,
                deadline_ms: Optional[float] = None, n: int = 1,
                grammar: Optional[dict] = None,
-               timeout: float = 300.0) -> Iterator[dict]:
+               timeout: float = 300.0,
+               request_id: Optional[str] = None) -> Iterator[dict]:
         """Serve one request through the fleet: yields the replica's
         chunk messages verbatim (spliced across a resteer), then ONE
         done message whose n_tokens counts what THIS client actually
         received. A shed or fully-failed request still gets a
         structured done with an "error" — the router never silently
-        drops."""
+        drops.
+
+        request_id makes the request IDEMPOTENT (fleet/ha.py): a retry
+        of a completed id is answered from the dedup window (only the
+        undelivered suffix — never a second serve), and a retry of an
+        in-flight id resumes at the journal watermark via the same
+        skip-debt splice a resteer uses."""
         from triton_dist_tpu.serving import ServerBusy, request_stream
+        if self._killed:
+            raise RouterDied(f"router {self.name} was killed "
+                             f"(chaos kill_routers)")
+        if request_id is not None:
+            if not isinstance(request_id, str) or not request_id \
+                    or len(request_id) > 128:
+                raise ValueError("request_id must be a non-empty "
+                                 "string of <= 128 chars")
+            if n != 1:
+                raise ValueError("request_id replay needs n=1 "
+                                 "(forked streams are not replayable)")
         tokens = np.asarray(self.tok.encode(str(prompt)) or [0],
                             np.int32)
         with self._lock:
@@ -226,8 +358,40 @@ class FleetRouter:
             # count, captured under the lock: two racing admissions
             # can't both read a stale pre-storm value
             inflight = self._inflight
+            ded = (self._dedup.get(request_id)
+                   if request_id is not None else None)
+        # the journal key: the client's id when supplied (resumable
+        # across router generations), else a router-generation-scoped
+        # internal id (journaled for the shadow rebuild only)
+        jid = (request_id if request_id is not None
+               else f"{self.name}.{rid_req}")
+        is_client = request_id is not None
         self.tele.queued(rid_req, slo=slo)
         try:
+            if ded is not None and ded.get("done") is not None:
+                # exactly-once replay: the id already completed — serve
+                # the undelivered suffix straight from the dedup
+                # window, never a second serve
+                with self._lock:
+                    toks = list(ded["tokens"])
+                    wm = int(ded["wm"])
+                self._c_dedup.inc()
+                suffix = toks[wm:]
+                if suffix:
+                    yield {"text": self.tok.decode(suffix),
+                           "token_ids": suffix, "dedup": True}
+                    self.tele.emit(rid_req, len(suffix))
+                with self._lock:
+                    ded["wm"] = len(toks)
+                self._jappend({"e": "wm", "id": jid, "n": len(toks)})
+                done = dict(ded["done"])
+                done["n_tokens"] = len(toks)
+                done["dedup"] = True
+                self.tele.retire(rid_req,
+                                 "retired" if done.get("error") is None
+                                 else "rejected")
+                yield done
+                return
             if self.shed_inflight is not None \
                     and inflight > self.shed_inflight:
                 protected = max(
@@ -251,7 +415,23 @@ class FleetRouter:
                                     f"slo={slo})"}
                     return
             sent = 0
-            gen_ids: list = []
+            if ded is not None:
+                # journal-fed resume: the id is in flight from a dead
+                # router generation (or an ambiguous EOF) — the skip
+                # debt below starts at the journal watermark instead
+                # of a live chunk count
+                sent = int(ded["wm"])
+                self._c_replayed.inc()
+            elif is_client:
+                with self._lock:
+                    ded = self._dedup.setdefault(
+                        request_id,
+                        {"wm": 0, "tokens": [], "done": None})
+            # seq_ids is the FULL generated sequence as served by the
+            # current dispatch (including any skipped splice prefix) —
+            # what the shadow index and the dedup record need; `sent`
+            # counts only what THIS stream delivered
+            seq_ids: list = []
             resteers = 0
             busy_excl: set = set()
             busy_left = self.busy_retries
@@ -297,11 +477,31 @@ class FleetRouter:
                     return
                 if resteers:
                     reason = "resteer"
+                # half-open breaker admission: the chosen replica may
+                # only take the single trial request — when the trial
+                # slot is already claimed, set the replica aside for
+                # this round exactly like a busy reply
+                trial_br = None
+                if self._breakers is not None:
+                    br = self._breaker(rid)
+                    if not br.admit():
+                        busy_excl.add(rid)
+                        busy_hint_ms = (25.0 if busy_hint_ms is None
+                                        else busy_hint_ms)
+                        continue
+                    if br.state == "half_open":
+                        trial_br = br
                 self._count_routed(rid, reason)
                 replica = self.members.replicas[rid]
-                kill_arm = (self.fault is not None
-                            and self.fault.router_dispatch(rid)
-                            == "kill")
+                dispatch_arm = (self.fault.router_dispatch(rid)
+                                if self.fault is not None else None)
+                kill_arm = dispatch_arm == "kill"
+                self._jappend({"e": "route", "id": jid,
+                               "client": is_client, "replica": rid,
+                               "prompt": str(prompt),
+                               "gen_len": gen_len, "seed": seed,
+                               "slo": slo, "session": session,
+                               "n": n, "resteer": resteers})
                 self.tele.flow("route", rid_req, phase="s", tid=0,
                                args={"replica": rid,
                                      "reason": reason})
@@ -309,9 +509,16 @@ class FleetRouter:
                     self._inflight_by[rid] += 1
                 t0 = time.monotonic()
                 done_msg = None
-                skip = sent      # resteer splice: drop the re-served
-                n_chunks = 0     # prefix the client already has
+                skip = sent      # splice: drop the re-served prefix
+                n_chunks = 0     # the client already has (live chunk
+                pos = 0          # counts, or the journal watermark)
                 try:
+                    if dispatch_arm == "partition":
+                        # the replica is unreachable but ALIVE (chaos
+                        # partition_replicas): the dispatch reads as a
+                        # death verdict — resteer + breaker error —
+                        # while a later probe can readmit the process
+                        raise OSError("chaos: replica partitioned")
                     for msg in request_stream(
                             replica.host, replica.port, prompt,
                             gen_len=gen_len, seed=seed, slo=slo,
@@ -321,6 +528,18 @@ class FleetRouter:
                         if msg.get("done"):
                             done_msg = msg
                             break
+                        if self._killed or (
+                                self.fault is not None
+                                and self.fault.router_chunk(rid_req)):
+                            # chaos kill_routers: THIS router dies at a
+                            # chunk boundary — the undelivered chunk is
+                            # lost with it, so the journal watermark
+                            # equals exactly what the client received
+                            self._killed = True
+                            raise RouterDied(
+                                f"router {self.name} killed at "
+                                f"watermark {sent} (chaos "
+                                f"kill_routers)")
                         n_chunks += 1
                         if n_chunks == 1:
                             # the arrow lands where the request did
@@ -328,6 +547,17 @@ class FleetRouter:
                                 "route", rid_req, phase="f",
                                 tid=self._tids.get(rid, 0))
                         ids = list(msg.get("token_ids") or ())
+                        if ids and n == 1:
+                            # full-sequence record (splice prefixes
+                            # included): this dispatch re-serves from
+                            # position 0, so overwrite-at-pos keeps it
+                            # exact across resteers
+                            need = pos + len(ids)
+                            if need > len(seq_ids):
+                                seq_ids.extend(
+                                    [0] * (need - len(seq_ids)))
+                            seq_ids[pos:need] = ids
+                            pos = need
                         if skip >= len(ids) > 0:
                             skip -= len(ids)
                         else:
@@ -343,8 +573,19 @@ class FleetRouter:
                                 msg["text"] = self.tok.decode(ids)
                             if ids:
                                 sent += len(ids)
-                                gen_ids.extend(ids)
                                 self.tele.emit(rid_req, len(ids))
+                                if is_client:
+                                    with self._lock:
+                                        ded["wm"] = sent
+                                    # the watermark is journaled per
+                                    # relayed chunk (one poll's worth
+                                    # of tokens), BEFORE the yield: a
+                                    # kill only fires at the next
+                                    # chunk boundary, so journal and
+                                    # delivery cannot tear
+                                    self._jappend({"e": "wm",
+                                                   "id": jid,
+                                                   "n": sent})
                             yield msg
                         if kill_arm and n_chunks == 1:
                             kill_arm = False
@@ -358,6 +599,9 @@ class FleetRouter:
                     # sleeping the busy one's hint while a peer has
                     # capacity is the routing mistake a fleet exists
                     # to avoid. Only an all-busy round waits (above).
+                    if trial_br is not None:
+                        # the trial got no verdict — free the slot
+                        trial_br.release_trial()
                     busy_excl.add(rid)
                     busy_hint_ms = (e.retry_after_ms
                                     if busy_hint_ms is None
@@ -376,6 +620,10 @@ class FleetRouter:
                     # remainder elsewhere; greedy same-seed decoding
                     # makes the splice bitwise seamless
                     self.members.mark_dead(rid)
+                    if self._breakers is not None:
+                        # feeds the error count; in half-open this IS
+                        # the failed trial verdict (re-open)
+                        self._breaker(rid).record_error()
                     self._c_resteer.inc()
                     resteers += 1
                     if n > 1 and sent > 0:
@@ -389,6 +637,10 @@ class FleetRouter:
                                         "spliced)"}
                         return
                     continue
+                if self._breakers is not None:
+                    # a done message means the replica is alive and
+                    # serving — in half-open this closes the breaker
+                    self._breaker(rid).record_success()
                 error = done_msg.get("error")
                 done = dict(done_msg)
                 done["n_tokens"] = sent
@@ -406,10 +658,21 @@ class FleetRouter:
                     self.placement.note_retire(
                         rid, tokens if n > 1 else np.concatenate(
                             [tokens,
-                             np.asarray(gen_ids, np.int32)]))
+                             np.asarray(seq_ids, np.int32)]))
                     if session is not None:
                         with self._lock:
                             self.sessions[session] = rid
+                if is_client:
+                    with self._lock:
+                        ded["tokens"] = list(seq_ids)
+                        ded["done"] = dict(done)
+                        self._dedup.move_to_end(request_id)
+                        self._trim_dedup_locked()
+                self._jappend({"e": "done", "id": jid,
+                               "client": is_client, "replica": rid,
+                               "tokens": [int(t) for t in seq_ids],
+                               "error": error,
+                               "done_msg": dict(done)})
                 self.tele.retire(rid_req,
                                  "retired" if error is None
                                  else "rejected")
@@ -447,6 +710,13 @@ class FleetRouter:
                   "placement decisions that matched a warm "
                   "prefix").set(round(frac, 4))
         out = reg.snapshot()
+        with self._lock:
+            dedup_live = sum(1 for rec in self._dedup.values()
+                             if rec.get("done") is None)
+            dedup_done = len(self._dedup) - dedup_live
+            breakers = ({rid: br.snapshot()
+                         for rid, br in self._breakers.items()}
+                        if self._breakers is not None else {})
         out.update({
             "policy": self.policy,
             "router_prefix_hit_frac": round(frac, 4),
@@ -455,6 +725,14 @@ class FleetRouter:
             "inflight": self._inflight,
             "sessions": len(self.sessions),
             "shadow_entries": self.placement.shadow_sizes(),
+            "dedup_hits": self._c_dedup.value,
+            "replayed_requests": self._c_replayed.value,
+            "dedup_window": {"completed": dedup_done,
+                             "inflight": dedup_live,
+                             "cap": self.dedup_window},
+            "breakers": breakers,
+            "journal_entries": (len(self.journal)
+                                if self.journal is not None else 0),
             "replicas": {
                 rid: {"healthy": self.members.healthy.get(rid, False),
                       "host": replica.host, "port": replica.port,
@@ -492,8 +770,8 @@ class FleetRouter:
         tracks, timestamps rebased onto the router's clock so the
         cross-plane ordering is real."""
         out = self.tele.export()
-        events = list(out["traceEvents"])
-        requests = dict(out.get("requests", {}))
+        out["traceEvents"] = list(out["traceEvents"])
+        out["requests"] = dict(out.get("requests", {}))
         for i, (rid, replica) in enumerate(
                 self.members.replicas.items()):
             sched = getattr(getattr(replica, "server", None),
@@ -501,22 +779,9 @@ class FleetRouter:
             tele = getattr(sched, "tele", None)
             if tele is None or not tele.trace:
                 continue
-            sub = tele.export()
-            base = 64 * (i + 1)
-            dt_us = (tele._t0 - self.tele._t0) * 1e6
-            for ev in sub["traceEvents"]:
-                ev = dict(ev)
-                ev["tid"] = base + int(ev.get("tid", 0))
-                if "ts" in ev:
-                    ev["ts"] = round(ev["ts"] + dt_us, 1)
-                if ev.get("ph") == "M":
-                    ev = dict(ev, args={
-                        "name": f"{rid}:{ev['args']['name']}"})
-                events.append(ev)
-            for k, v in sub.get("requests", {}).items():
-                requests[f"{rid}:{k}"] = v
-        out["traceEvents"] = events
-        out["requests"] = requests
+            splice_trace(out, tele.export(), tid_base=64 * (i + 1),
+                         label=rid,
+                         dt_us=(tele._t0 - self.tele._t0) * 1e6)
         return out
 
     def dump_trace(self, path: str) -> None:

@@ -15,6 +15,10 @@ Pieces:
     (``ContinuousScheduler(fault=...)``) that forces PoolExhausted at
     chosen admission indices — exercises the preemption/requeue path
     deterministically, without actually draining the pool.
+  - ``ChaosSchedule``: a seeded fault schedule — which arm fires at
+    which attempt index, drawn once from ``random.Random(seed)`` so
+    the randomized HA soak (tests/test_fleet_ha.py) replays
+    identically from its seed.
   - ``FlakyDrafter``: a Drafter wrapper that raises (or babbles
     garbage) on schedule; the scheduler must degrade to plain decode
     for that window, never die (spec=K resilience).
@@ -39,9 +43,10 @@ tests/test_resilience.py (marked slow).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import time
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 
 class FaultInjector:
@@ -92,7 +97,23 @@ class FaultInjector:
     indices (every consult of ``router_probe`` counts) at which a
     health probe behaves as timed out — the membership layer must mark
     the replica unhealthy and route around it until a clean probe
-    readmits it (tests/test_fleet.py)."""
+    readmits it (tests/test_fleet.py).
+
+    HA faults (the failover plane — fleet/ha.py):
+    ``partition_replicas`` names 0-based router DISPATCH indices
+    (the same counter ``kill_replicas`` consults) at which the chosen
+    replica is PARTITIONED from the router for that one dispatch: the
+    connection attempt fails outright (OSError before any chunk), the
+    replica process stays alive, and the router must mark it dead and
+    resteer — the asymmetric-partition arm, distinct from a kill
+    because the replica comes back on the next clean probe.
+    ``kill_routers`` names 0-based router CHUNK-RELAY indices (every
+    consult of the ``router_chunk`` hook counts — one per relayed
+    chunk across all streams) at which the ROUTER ITSELF dies at a
+    chunk boundary: every live stream sees RouterDied, and a
+    ReplicatedRouter must promote its warm standby and resume each
+    stream bitwise against the journal watermark
+    (tests/test_fleet_ha.py)."""
 
     def __init__(self, *, exhaust_admissions: Iterable[int] = (),
                  exhaust_host_demotions: Iterable[int] = (),
@@ -100,7 +121,9 @@ class FaultInjector:
                  dup_transfers: Iterable[int] = (),
                  kill_prefills: Iterable[int] = (),
                  kill_replicas: Iterable[int] = (),
-                 slow_replicas: Iterable[int] = ()):
+                 slow_replicas: Iterable[int] = (),
+                 partition_replicas: Iterable[int] = (),
+                 kill_routers: Iterable[int] = ()):
         self.exhaust_admissions = {int(i) for i in exhaust_admissions}
         self.exhaust_host_demotions = {int(i)
                                        for i in exhaust_host_demotions}
@@ -109,16 +132,21 @@ class FaultInjector:
         self.kill_prefills = {int(i) for i in kill_prefills}
         self.kill_replicas = {int(i) for i in kill_replicas}
         self.slow_replicas = {int(i) for i in slow_replicas}
+        self.partition_replicas = {int(i)
+                                   for i in partition_replicas}
+        self.kill_routers = {int(i) for i in kill_routers}
         self.admissions_seen = 0
         self.host_demotions_seen = 0
         self.transfers_seen = 0
         self.prefills_seen = 0
         self.router_dispatches_seen = 0
         self.router_probes_seen = 0
+        self.router_chunks_seen = 0
         self.injected = {"pool_exhausted": 0, "host_exhausted": 0,
                          "transfer_drop": 0, "transfer_dup": 0,
                          "prefill_death": 0, "replica_kill": 0,
-                         "probe_slow": 0}
+                         "probe_slow": 0, "replica_partition": 0,
+                         "router_kill": 0}
 
     def admission(self, req) -> None:
         i = self.admissions_seen
@@ -173,14 +201,35 @@ class FaultInjector:
         attempt (resteers included), AFTER placement chose
         ``replica_id``. Returns "kill" — the router kills that replica
         mid-stream (right after the first relayed chunk) so the resteer
-        path must re-serve the request elsewhere — or None (dispatch
-        normally)."""
+        path must re-serve the request elsewhere — "partition" — the
+        connection attempt itself fails (OSError, replica untouched)
+        and the router must resteer — or None (dispatch normally)."""
         i = self.router_dispatches_seen
         self.router_dispatches_seen += 1
         if i in self.kill_replicas:
             self.injected["replica_kill"] += 1
             return "kill"
+        if i in self.partition_replicas:
+            self.injected["replica_partition"] += 1
+            return "partition"
         return None
+
+    def router_chunk(self, request_id=None) -> bool:
+        """Consulted by the fleet router once per relayed chunk,
+        BEFORE the chunk is processed; True = the router dies NOW, at
+        this chunk boundary (fleet/router.py raises RouterDied for
+        every live stream). Chunk boundaries are the only legal death
+        sites because the journal watermark is appended before each
+        yield — dying between the two would tear the exactly-once
+        window, and a real crash can't do that either (the append and
+        the socket write are one critical section under the router
+        lock)."""
+        i = self.router_chunks_seen
+        self.router_chunks_seen += 1
+        if i in self.kill_routers:
+            self.injected["router_kill"] += 1
+            return True
+        return False
 
     def router_probe(self, replica_id) -> bool:
         """Consulted by the membership layer once per health probe of
@@ -193,6 +242,65 @@ class FaultInjector:
             self.injected["probe_slow"] += 1
             return True
         return False
+
+
+class ChaosSchedule:
+    """Seeded, replayable fault schedule: which arm fires at which
+    attempt index, decided up front from one ``random.Random(seed)``
+    stream so the same seed ALWAYS yields the same fault sequence —
+    the property that turns a randomized HA soak into a reproducible
+    regression test (fail once, rerun forever with the same seed).
+
+    ``rates`` maps FaultInjector arm names to per-index fire
+    probabilities; each arm draws ``horizon`` independent coins, arms
+    consumed in sorted-name order so insertion order of the rates dict
+    cannot perturb the stream. ``injector()`` materialises the
+    schedule as a plain FaultInjector (extra kwargs pass through for
+    arms outside the schedule); ``describe()`` is the full schedule as
+    JSON-able data — print it on soak failure and the repro is one
+    copy/paste away."""
+
+    ARMS = ("exhaust_admissions", "exhaust_host_demotions",
+            "drop_transfers", "dup_transfers", "kill_prefills",
+            "kill_replicas", "slow_replicas", "partition_replicas",
+            "kill_routers")
+
+    def __init__(self, seed: int, *, horizon: int = 64,
+                 rates: Optional[Dict[str, float]] = None):
+        self.seed = int(seed)
+        self.horizon = int(horizon)
+        if self.horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        self.rates = {str(k): float(v)
+                      for k, v in (rates or {}).items()}
+        for arm, p in self.rates.items():
+            if arm not in self.ARMS:
+                raise ValueError(
+                    f"unknown chaos arm {arm!r} (known: "
+                    f"{', '.join(self.ARMS)})")
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"rate for {arm!r} must be in [0, 1], got {p}")
+        rng = random.Random(self.seed)
+        self.fires: Dict[str, frozenset] = {}
+        for arm in sorted(self.rates):
+            p = self.rates[arm]
+            self.fires[arm] = frozenset(
+                i for i in range(self.horizon) if rng.random() < p)
+
+    def injector(self, **extra) -> FaultInjector:
+        """One FaultInjector carrying this schedule; ``extra`` adds or
+        overrides arms outside it (e.g. a pinned kill index on top of
+        randomized background faults)."""
+        kw = {arm: sorted(ix) for arm, ix in self.fires.items()}
+        kw.update(extra)
+        return FaultInjector(**kw)
+
+    def describe(self) -> dict:
+        return {"seed": self.seed, "horizon": self.horizon,
+                "rates": dict(sorted(self.rates.items())),
+                "fires": {arm: sorted(ix)
+                          for arm, ix in sorted(self.fires.items())}}
 
 
 class FlakyDrafter:

@@ -827,6 +827,31 @@ class Telemetry:
             json.dump(self.export(), f)
 
 
+def splice_trace(out: dict, sub: dict, *, tid_base: int, label: str,
+                 dt_us: float) -> None:
+    """Splice one Telemetry export into another IN PLACE: `sub`'s
+    events land on tracks offset by `tid_base`, timestamps rebased by
+    `dt_us` (the difference of the two bundles' _t0 clocks, in µs) so
+    cross-plane ordering is real, and track metadata + request records
+    are namespaced under `label`. One merge rule for every composite
+    timeline: a router splicing its replicas' poll loops
+    (fleet/router.py export) and the HA pair splicing its retired
+    router generations (fleet/ha.py ReplicatedRouter.export)."""
+    events = out["traceEvents"]
+    for ev in sub.get("traceEvents", ()):
+        ev = dict(ev)
+        ev["tid"] = tid_base + int(ev.get("tid", 0))
+        if "ts" in ev:
+            ev["ts"] = round(ev["ts"] + dt_us, 1)
+        if ev.get("ph") == "M":
+            ev = dict(ev, args={
+                "name": f"{label}:{ev['args']['name']}"})
+        events.append(ev)
+    requests = out.setdefault("requests", {})
+    for k, v in sub.get("requests", {}).items():
+        requests[f"{label}:{k}"] = v
+
+
 def trace_comm_kernel(kernel: str, nbytes) -> None:
     """Comm-kernel trace accounting, called from kernels/* each time a
     comm kernel is BUILT into a program (python call = jit trace

@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import threading
 import time
@@ -279,7 +280,10 @@ class TokenServer:
         ``"session"`` field (any string up to 128 chars): the server
         accepts and ignores it, the ROUTER uses it for session
         affinity, so one client codepath speaks to both a bare server
-        and a fleet."""
+        and a fleet. A ``"request_id"`` field (non-empty string up to
+        128 chars) rides the same contract: validated and ignored
+        here, it is the idempotency key the HA router tier
+        (fleet/ha.py) dedups on for exactly-once delivery."""
         from triton_dist_tpu.models.disagg import DisaggScheduler
         from triton_dist_tpu.models.scheduler import ContinuousScheduler
         self.engine = engine
@@ -466,6 +470,16 @@ class TokenServer:
                             len(session) > 128:
                         raise ValueError(
                             "session must be a string of <= 128 chars")
+                request_id = req.get("request_id")
+                if request_id is not None:
+                    # same contract as session: validated + ignored by
+                    # a bare server; the exactly-once dedup window is
+                    # ROUTER state (fleet/ha.py journal watermarks)
+                    if not isinstance(request_id, str) or \
+                            not request_id or len(request_id) > 128:
+                        raise ValueError("request_id must be a "
+                                         "non-empty string of "
+                                         "<= 128 chars")
                 slo = req.get("slo")
                 if slo is not None:
                     slo = str(slo)
@@ -792,12 +806,26 @@ class TokenServer:
                 pass
 
 
+def full_jitter(delay_s: float, rand=None) -> float:
+    """Full-jitter backoff (AWS architecture-blog flavor): a uniform
+    draw over [0, delay_s]. The deterministic alternative — sleeping
+    exactly delay_s — means N clients that failed TOGETHER (a router
+    death severs every stream at once) retry together forever, each
+    round a synchronized thundering herd; the uniform draw decorrelates
+    them in one round. ``rand`` is an injectable () -> [0, 1) for
+    distribution tests (tests/test_serving.py)."""
+    if rand is None:
+        rand = random.random
+    return max(0.0, float(delay_s)) * rand()
+
+
 def request_stream(host: str, port: int, prompt: str, *,
                    gen_len: int = 16, seed: int = 0,
                    timeout: float = 300.0,
                    deadline_ms: Optional[float] = None,
                    slo: Optional[str] = None,
                    session: Optional[str] = None,
+                   request_id: Optional[str] = None,
                    n: int = 1, grammar: Optional[dict] = None,
                    connect_retries: int = 8,
                    connect_backoff_s: float = 0.05,
@@ -833,6 +861,11 @@ def request_stream(host: str, port: int, prompt: str, *,
         # affinity hint: a bare server validates and ignores it; a
         # fleet router (fleet/router.py) pins the session to a replica
         payload["session"] = session
+    if request_id is not None:
+        # idempotency key: a bare server validates and ignores it; a
+        # fleet router dedups on it (fleet/ha.py) so a retried submit
+        # after an ambiguous EOF never double-serves
+        payload["request_id"] = request_id
     connects = 0
     busy_left = busy_retries
     while True:
@@ -841,7 +874,10 @@ def request_stream(host: str, port: int, prompt: str, *,
         except OSError:
             if connects >= connect_retries:
                 raise
-            time.sleep(min(connect_backoff_s * (2 ** connects), 2.0))
+            # full jitter: every client that lost its router at the
+            # same instant must NOT reconnect at the same instant
+            time.sleep(full_jitter(
+                min(connect_backoff_s * (2 ** connects), 2.0)))
             connects += 1
             continue
         retry_ms = None
@@ -863,4 +899,4 @@ def request_stream(host: str, port: int, prompt: str, *,
         if busy_left <= 0:
             raise ServerBusy(retry_ms)
         busy_left -= 1
-        time.sleep(retry_ms / 1e3)
+        time.sleep(full_jitter(retry_ms / 1e3))
